@@ -1,0 +1,22 @@
+"""eges-tpu: a TPU-native framework with the capabilities of socc2019-no92/eges.
+
+The reference system is a go-ethereum 1.8.2 fork implementing the Geec
+("trustedHW") permissioned-blockchain consensus engine.  This package is a
+ground-up rebuild, not a port:
+
+- The consensus control plane (leader election, validate/ACK gathering,
+  registration/TTL membership, timeout/empty-block recovery, confidence
+  finality) is implemented as deterministic, single-threaded, event-driven
+  state machines with injectable clocks and transports
+  (``eges_tpu.consensus``, ``eges_tpu.core``) instead of the reference's
+  goroutine-and-mutex topology (ref: core/geec_state.go,
+  consensus/geec/election/election_go.go).
+
+- The cryptographic hot path -- secp256k1 ECDSA public-key recovery and
+  Keccak-256 for transaction-sender recovery and vote checking (ref:
+  crypto/secp256k1/secp256.go:105, core/types/transaction_signing.go:222) --
+  is a batched JAX computation (``eges_tpu.ops``) that vmaps over signature
+  rows and shards across TPU chips via ``jax.sharding`` (``eges_tpu.parallel``).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,34 @@
+"""Host-side cryptography.
+
+This mirrors the role of the reference's ``crypto/`` front door
+(ref: crypto/crypto.go:43 Keccak256, crypto/signature_cgo.go:31 Ecrecover):
+a small, always-available implementation used by the control plane for
+one-off hashes/signatures and as the golden reference for the batched TPU
+kernels in :mod:`eges_tpu.ops`.  A native C++ implementation (``native/``)
+is loaded transparently when built; the pure-Python code is the fallback
+and the source of truth for tests.
+"""
+
+from eges_tpu.crypto.keccak import keccak256
+from eges_tpu.crypto.secp256k1 import (
+    N,
+    P,
+    ecdsa_recover,
+    ecdsa_sign,
+    ecdsa_verify,
+    privkey_to_pubkey,
+    pubkey_to_address,
+    recover_address,
+)
+
+__all__ = [
+    "keccak256",
+    "P",
+    "N",
+    "ecdsa_sign",
+    "ecdsa_recover",
+    "ecdsa_verify",
+    "privkey_to_pubkey",
+    "pubkey_to_address",
+    "recover_address",
+]

@@ -1,0 +1,192 @@
+"""secp256k1 ECDSA: sign / verify / public-key recovery, pure Python.
+
+Host-side equivalent of the reference's cgo-wrapped libsecp256k1
+(ref: crypto/secp256k1/secp256.go:70,105,126) and the golden model the
+batched TPU kernels in :mod:`eges_tpu.ops` are tested against.  Signatures
+use the Ethereum 65-byte wire format ``r[32] || s[32] || v[1]`` with
+``v in {0,1}`` (recovery id), matching ``crypto.Ecrecover``
+(ref: crypto/signature_cgo.go:31).
+
+Nonces are deterministic (RFC 6979 with HMAC-SHA256) so tests are
+reproducible without an entropy source.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+
+from eges_tpu.crypto.keccak import keccak256
+
+# Curve parameters: y^2 = x^3 + 7 over F_P, group order N.
+P = 2**256 - 2**32 - 977
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+G = (GX, GY)
+
+Point = tuple[int, int] | None  # None = point at infinity
+
+
+def _inv(a: int, m: int) -> int:
+    return pow(a, -1, m)
+
+
+def point_add(p1: Point, p2: Point) -> Point:
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None
+        # doubling
+        lam = (3 * x1 * x1) * _inv(2 * y1, P) % P
+    else:
+        lam = (y2 - y1) * _inv(x2 - x1, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    y3 = (lam * (x1 - x3) - y1) % P
+    return (x3, y3)
+
+
+def point_mul(k: int, p: Point) -> Point:
+    acc: Point = None
+    add = p
+    while k:
+        if k & 1:
+            acc = point_add(acc, add)
+        add = point_add(add, add)
+        k >>= 1
+    return acc
+
+
+def privkey_to_pubkey(priv: bytes) -> bytes:
+    """64-byte uncompressed public key (x || y) for a 32-byte private key."""
+    d = int.from_bytes(priv, "big")
+    if not 1 <= d < N:
+        raise ValueError("private key out of range")
+    pub = point_mul(d, G)
+    assert pub is not None
+    return pub[0].to_bytes(32, "big") + pub[1].to_bytes(32, "big")
+
+
+def pubkey_to_address(pub: bytes) -> bytes:
+    """Ethereum address: last 20 bytes of keccak256 of the 64-byte pubkey
+    (ref: crypto/crypto.go:194 PubkeyToAddress)."""
+    if len(pub) == 65 and pub[0] == 4:
+        pub = pub[1:]
+    if len(pub) != 64:
+        raise ValueError("expected 64-byte public key")
+    return keccak256(pub)[12:]
+
+
+def _rfc6979_nonce(msg_hash: bytes, priv: bytes) -> int:
+    """Deterministic nonce per RFC 6979 (HMAC-SHA256, qlen = 256)."""
+    holen = 32
+    x = priv.rjust(32, b"\x00")
+    h1 = msg_hash
+    v = b"\x01" * holen
+    k = b"\x00" * holen
+    k = hmac.new(k, v + b"\x00" + x + h1, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    k = hmac.new(k, v + b"\x01" + x + h1, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    while True:
+        v = hmac.new(k, v, hashlib.sha256).digest()
+        t = int.from_bytes(v, "big")
+        if 1 <= t < N:
+            return t
+        k = hmac.new(k, v + b"\x00", hashlib.sha256).digest()
+        v = hmac.new(k, v, hashlib.sha256).digest()
+
+
+def ecdsa_sign(msg_hash: bytes, priv: bytes) -> bytes:
+    """Sign a 32-byte hash; returns 65 bytes ``r || s || v`` with low-s
+    normalization and v the recovery id (ref: secp256.go:70 Sign)."""
+    if len(msg_hash) != 32:
+        raise ValueError("message hash must be 32 bytes")
+    d = int.from_bytes(priv, "big")
+    z = int.from_bytes(msg_hash, "big")
+    while True:
+        k = _rfc6979_nonce(msg_hash, priv)
+        R = point_mul(k, G)
+        assert R is not None
+        r = R[0] % N
+        if r == 0:
+            msg_hash = hashlib.sha256(msg_hash).digest()
+            continue
+        s = _inv(k, N) * (z + r * d) % N
+        if s == 0:
+            msg_hash = hashlib.sha256(msg_hash).digest()
+            continue
+        # recid = (overflow << 1) | (R.y & 1), per libsecp256k1's
+        # ecdsa_sign_recoverable semantics
+        v = (R[1] & 1) | (2 if R[0] >= N else 0)
+        if s > N // 2:  # low-s normalization flips the recovery parity
+            s = N - s
+            v ^= 1
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([v])
+
+
+def ecdsa_recover(msg_hash: bytes, sig: bytes) -> bytes:
+    """Recover the 64-byte public key from a 65-byte ``r||s||v`` signature
+    (ref: secp256.go:105 RecoverPubkey)."""
+    if len(sig) != 65:
+        raise ValueError("signature must be 65 bytes")
+    r = int.from_bytes(sig[0:32], "big")
+    s = int.from_bytes(sig[32:64], "big")
+    v = sig[64]
+    if v >= 4:
+        raise ValueError("invalid recovery id")
+    if not (1 <= r < N and 1 <= s < N):
+        raise ValueError("r/s out of range")
+    x = r + N if v & 2 else r
+    if x >= P:
+        raise ValueError("invalid r for this recovery id")
+    y_sq = (pow(x, 3, P) + 7) % P
+    y = pow(y_sq, (P + 1) // 4, P)
+    if y * y % P != y_sq:
+        raise ValueError("r does not correspond to a curve point")
+    if (y & 1) != (v & 1):
+        y = P - y
+    z = int.from_bytes(msg_hash, "big")
+    r_inv = _inv(r, N)
+    u1 = (-z * r_inv) % N
+    u2 = (s * r_inv) % N
+    q = point_add(point_mul(u1, G), point_mul(u2, (x, y)))
+    if q is None:
+        raise ValueError("recovered point at infinity")
+    return q[0].to_bytes(32, "big") + q[1].to_bytes(32, "big")
+
+
+def ecdsa_verify(msg_hash: bytes, sig: bytes, pub: bytes) -> bool:
+    """Classic ECDSA verify of ``r||s`` against a 64-byte public key
+    (ref: secp256.go:126 VerifySignature)."""
+    try:
+        r = int.from_bytes(sig[0:32], "big")
+        s = int.from_bytes(sig[32:64], "big")
+        if not (1 <= r < N and 1 <= s < N):
+            return False
+        qx = int.from_bytes(pub[-64:-32], "big")
+        qy = int.from_bytes(pub[-32:], "big")
+        if (qy * qy - qx * qx * qx - 7) % P != 0:
+            return False
+        z = int.from_bytes(msg_hash, "big")
+        s_inv = _inv(s, N)
+        u1 = z * s_inv % N
+        u2 = r * s_inv % N
+        pt = point_add(point_mul(u1, G), point_mul(u2, (qx, qy)))
+        if pt is None:
+            return False
+        return pt[0] % N == r
+    except (ValueError, AssertionError):
+        return False
+
+
+def recover_address(msg_hash: bytes, sig: bytes) -> bytes:
+    """Sender recovery: signature -> 20-byte address, the per-transaction hot
+    path the TPU batches (ref: core/types/transaction_signing.go:222
+    recoverPlain -> Ecrecover -> Keccak256(pub)[12:])."""
+    return pubkey_to_address(ecdsa_recover(msg_hash, sig))

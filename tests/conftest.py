@@ -1,0 +1,24 @@
+"""Test configuration.
+
+Force JAX onto CPU with 8 virtual devices so the multi-chip sharding path
+(mesh/pjit) is exercised without TPU hardware, and enable the persistent
+compilation cache so the big secp256k1 graphs compile once per machine.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def pytest_configure(config):
+    try:
+        import jax
+
+        cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
