@@ -50,6 +50,8 @@ def load_or_create(path: str, seed: bytes | None = None) -> KeyPair:
         return keypair_from_priv(raw)
     kp = generate_keypair(seed)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "wb") as fh:
+    # O_EXCL closes the exists-check race; 0600 keeps the raw key private.
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+    with os.fdopen(fd, "wb") as fh:
         fh.write(kp.priv)
     return kp

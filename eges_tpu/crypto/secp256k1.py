@@ -107,7 +107,11 @@ def ecdsa_sign(msg_hash: bytes, priv: bytes) -> bytes:
     normalization and v the recovery id (ref: secp256.go:70 Sign)."""
     if len(msg_hash) != 32:
         raise ValueError("message hash must be 32 bytes")
+    if len(priv) != 32:
+        raise ValueError("private key must be 32 bytes")
     d = int.from_bytes(priv, "big")
+    if not 1 <= d < N:
+        raise ValueError("private key out of range")
     z = int.from_bytes(msg_hash, "big")
     while True:
         k = _rfc6979_nonce(msg_hash, priv)
@@ -135,6 +139,8 @@ def ecdsa_recover(msg_hash: bytes, sig: bytes) -> bytes:
     (ref: secp256.go:105 RecoverPubkey)."""
     if len(sig) != 65:
         raise ValueError("signature must be 65 bytes")
+    if len(msg_hash) != 32:
+        raise ValueError("message hash must be 32 bytes")
     r = int.from_bytes(sig[0:32], "big")
     s = int.from_bytes(sig[32:64], "big")
     v = sig[64]
@@ -164,10 +170,14 @@ def ecdsa_recover(msg_hash: bytes, sig: bytes) -> bytes:
 def ecdsa_verify(msg_hash: bytes, sig: bytes, pub: bytes) -> bool:
     """Classic ECDSA verify of ``r||s`` against a 64-byte public key
     (ref: secp256.go:126 VerifySignature)."""
+    if len(msg_hash) != 32:
+        return False
     try:
         r = int.from_bytes(sig[0:32], "big")
         s = int.from_bytes(sig[32:64], "big")
-        if not (1 <= r < N and 1 <= s < N):
+        # libsecp256k1's verify rejects malleable high-s signatures
+        # (ref: secp256.go:126 comment "does not allow malleable signatures").
+        if not (1 <= r < N and 1 <= s <= N // 2):
             return False
         qx = int.from_bytes(pub[-64:-32], "big")
         qy = int.from_bytes(pub[-32:], "big")

@@ -1,0 +1,283 @@
+"""Fixed-width 256-bit modular arithmetic for TPU (JAX).
+
+The reference does all of this inside C libsecp256k1 with 64-bit limbs and
+carry chains (ref: crypto/secp256k1/libsecp256k1/src/field_5x52_impl.h role).
+TPUs have no native 64-bit integer datapath, so the TPU-native design is
+different: a 256-bit integer is a vector of **16 little-endian limbs of 16
+bits each, stored as uint32**.  Every op below is shape-polymorphic over
+leading batch dimensions (``[..., 16]``), so a batch of B field elements is a
+``[B, 16]`` uint32 array — rows map onto VPU lanes, and the whole pipeline
+stays in native int32 hardware ops (no XLA 64-bit emulation):
+
+* 16b x 16b limb products are < 2^32: a single uint32 multiply never wraps.
+* Column accumulation splits products into lo/hi 16-bit halves, so every
+  partial sum stays far below 2^32 (max ~2^21 for a 16x16 schoolbook).
+* Carry propagation is a short static chain of shifts/masks.
+
+Reduction uses the pseudo-Mersenne shape of both secp256k1 moduli
+(``m = 2^256 - delta``): fold ``hi * delta`` back into the low words a fixed
+number of times, then conditionally subtract.  Inverse and sqrt go through
+Fermat (``a^(m-2)``, ``a^((m+1)/4)``) with a rolled ``lax.fori_loop`` over the
+constant exponent bits so the compiled graph stays small.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LIMB_BITS = 16
+NLIMBS = 16  # 256 bits
+MASK = (1 << LIMB_BITS) - 1
+
+# secp256k1 field prime and group order (ref: crypto/secp256k1 constants).
+P = 2**256 - 2**32 - 977
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+
+# ---------------------------------------------------------------------------
+# host-side conversions (trace-time constants and tests)
+# ---------------------------------------------------------------------------
+
+def int_to_limbs(x: int, n: int = NLIMBS) -> np.ndarray:
+    """Python int -> n little-endian 16-bit limbs (numpy uint32)."""
+    if x < 0 or x >= 1 << (LIMB_BITS * n):
+        raise ValueError("out of range")
+    return np.array([(x >> (LIMB_BITS * i)) & MASK for i in range(n)], dtype=np.uint32)
+
+
+def limbs_to_int(a) -> int:
+    """Limb array (last axis) -> Python int.  Host/test use only."""
+    a = np.asarray(a)
+    return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(a.reshape(-1)))
+
+
+def bytes_be_to_limbs(b: jnp.ndarray) -> jnp.ndarray:
+    """``[..., 32]`` big-endian bytes (uint8) -> ``[..., 16]`` limbs (uint32).
+
+    In-graph unpacking for wire-format inputs (r/s/hash fields of the 65-byte
+    signatures the reference passes to RecoverPubkey, secp256.go:105).
+    """
+    le = b[..., ::-1].astype(jnp.uint32)  # little-endian bytes
+    pairs = le.reshape(*le.shape[:-1], NLIMBS, 2)
+    return pairs[..., 0] | (pairs[..., 1] << 8)
+
+
+def limbs_to_bytes_be(a: jnp.ndarray) -> jnp.ndarray:
+    """``[..., 16]`` limbs -> ``[..., 32]`` big-endian bytes (uint8)."""
+    lo = (a & 0xFF).astype(jnp.uint8)
+    hi = ((a >> 8) & 0xFF).astype(jnp.uint8)
+    le = jnp.stack([lo, hi], axis=-1).reshape(*a.shape[:-1], 2 * NLIMBS)
+    return le[..., ::-1]
+
+
+# ---------------------------------------------------------------------------
+# carry chains and wide helpers
+# ---------------------------------------------------------------------------
+
+def _carry(cols: jnp.ndarray, n_out: int) -> jnp.ndarray:
+    """Propagate carries over a column vector of small (<2^31) sums.
+
+    Sequential but only ``cols.shape[-1]`` static steps of shift/mask.
+    """
+    out = []
+    c = jnp.zeros(cols.shape[:-1], jnp.uint32)
+    for k in range(cols.shape[-1]):
+        t = cols[..., k] + c
+        out.append(t & MASK)
+        c = t >> LIMB_BITS
+    while len(out) < n_out:
+        out.append(c & MASK)
+        c = c >> LIMB_BITS
+    return jnp.stack(out[:n_out], axis=-1)
+
+
+def big_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Full product of two limb vectors: ``[..., na] x [..., nb] -> [..., na+nb]``.
+
+    Schoolbook with lo/hi split so all accumulators stay far below 2^32.
+    """
+    na, nb = a.shape[-1], b.shape[-1]
+    prod = a[..., :, None] * b[..., None, :]  # [..., na, nb], each < 2^32
+    lo = prod & MASK
+    hi = prod >> LIMB_BITS
+    cols = jnp.zeros((*prod.shape[:-2], na + nb + 1), jnp.uint32)
+    for i in range(na):
+        cols = cols.at[..., i : i + nb].add(lo[..., i, :])
+        cols = cols.at[..., i + 1 : i + nb + 1].add(hi[..., i, :])
+    return _carry(cols, na + nb)
+
+
+def big_add(a: jnp.ndarray, b: jnp.ndarray, n_out: int | None = None) -> jnp.ndarray:
+    """Uncarried limb add then carry-fix; output width ``n_out``."""
+    na, nb = a.shape[-1], b.shape[-1]
+    w = max(na, nb)
+    if n_out is None:
+        n_out = w + 1
+    pa = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, w - na)])
+    pb = jnp.pad(b, [(0, 0)] * (b.ndim - 1) + [(0, w - nb)])
+    return _carry(pa + pb, n_out)
+
+
+def big_sub(a: jnp.ndarray, b: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """``a - b`` with borrow chain (same width).  Returns (diff, borrow_flag).
+
+    borrow_flag is 1 where ``a < b`` (diff then holds ``a - b + 2^(16n)``).
+    """
+    n = a.shape[-1]
+    assert b.shape[-1] == n
+    out = []
+    borrow = jnp.zeros(a.shape[:-1], jnp.uint32)
+    for k in range(n):
+        # Work in uint32: add 2^16 headroom so the subtraction never wraps.
+        t = a[..., k] + jnp.uint32(1 << LIMB_BITS) - b[..., k] - borrow
+        out.append(t & MASK)
+        borrow = jnp.uint32(1) - (t >> LIMB_BITS)
+    return jnp.stack(out, axis=-1), borrow
+
+
+def big_lt(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Per-row ``a < b`` as a uint32 0/1 flag."""
+    _, borrow = big_sub(a, b)
+    return borrow
+
+
+def select(flag: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Limb-select: ``flag ? a : b`` with flag broadcast over the limb axis."""
+    return jnp.where(flag[..., None].astype(bool), a, b)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    """Per-row all-limbs-zero flag (uint32 0/1)."""
+    return (jnp.max(a, axis=-1) == 0).astype(jnp.uint32)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Per-row limbwise equality flag (uint32 0/1)."""
+    return jnp.all(a == b, axis=-1).astype(jnp.uint32)
+
+
+# ---------------------------------------------------------------------------
+# modular arithmetic for a fixed pseudo-Mersenne modulus
+# ---------------------------------------------------------------------------
+
+class Mod:
+    """Arithmetic mod a constant ``m = 2^256 - delta`` (secp256k1 P or N).
+
+    All methods take/return ``[..., 16]`` uint32 limb arrays with values in
+    ``[0, m)`` and are safe under jit/vmap.  Exponents for :meth:`pow_const`
+    are Python-int constants, rolled into a ``fori_loop`` over their bits.
+    """
+
+    def __init__(self, m: int, n_folds: int):
+        self.m = m
+        delta = (1 << 256) - m
+        self.delta_limbs_np = int_to_limbs(delta, (delta.bit_length() + 15) // 16)
+        self.m_limbs_np = int_to_limbs(m)
+        self.n_folds = n_folds
+
+    @property
+    def m_limbs(self) -> jnp.ndarray:
+        return jnp.asarray(self.m_limbs_np)
+
+    def _cond_sub_m(self, a: jnp.ndarray) -> jnp.ndarray:
+        """One conditional subtract of m from a 16-limb value in [0, 2m)."""
+        diff, borrow = big_sub(a, jnp.broadcast_to(self.m_limbs, a.shape))
+        return select(borrow, a, diff)
+
+    def red(self, wide: jnp.ndarray) -> jnp.ndarray:
+        """Reduce a wide (>16 limb) value mod m via delta-folding.
+
+        ``n_folds`` folds shrink a 512-bit value to ``< 2^256 + small``; one
+        extra fold then guarantees the limbs above 256 bits are exactly zero
+        (if the top limb was 1, the new value is ``old - m < m``), so the
+        truncation below is lossless and two conditional subtracts finish.
+        """
+        delta = jnp.asarray(self.delta_limbs_np)
+        for _ in range(self.n_folds + 1):
+            if wide.shape[-1] <= NLIMBS:
+                break
+            lo = wide[..., :NLIMBS]
+            hi = wide[..., NLIMBS:]
+            prod = big_mul(hi, jnp.broadcast_to(delta, (*hi.shape[:-1], delta.shape[-1])))
+            wide = big_add(lo, prod)
+        a = wide[..., :NLIMBS]
+        a = self._cond_sub_m(a)
+        a = self._cond_sub_m(a)
+        return a
+
+    def add(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        s = big_add(a, b, NLIMBS + 1)
+        return self.red(s)
+
+    def sub(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        # a - b mod m with a,b in [0, m): add m then subtract, always >= 0.
+        am = big_add(a, jnp.broadcast_to(self.m_limbs, a.shape), NLIMBS + 1)
+        bp = jnp.pad(b, [(0, 0)] * (b.ndim - 1) + [(0, 1)])
+        diff, _ = big_sub(am, bp)
+        return self.red(diff)
+
+    def neg(self, a: jnp.ndarray) -> jnp.ndarray:
+        z = jnp.zeros_like(a)
+        return select(is_zero(a), z, self.sub(z, a))
+
+    def mul(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        return self.red(big_mul(a, b))
+
+    def sqr(self, a: jnp.ndarray) -> jnp.ndarray:
+        return self.mul(a, a)
+
+    def mul_small(self, a: jnp.ndarray, k: int) -> jnp.ndarray:
+        """Multiply by a small Python-int constant (k < 2^16)."""
+        kl = jnp.full((*a.shape[:-1], 1), k, jnp.uint32)
+        return self.red(big_mul(a, kl))
+
+    def pow_const(self, a: jnp.ndarray, e: int) -> jnp.ndarray:
+        """``a ** e mod m`` for a constant exponent, via a rolled bit loop."""
+        nbits = e.bit_length()
+        bits = jnp.asarray([(e >> i) & 1 for i in range(nbits)], dtype=jnp.uint32)
+        one = jnp.broadcast_to(jnp.asarray(int_to_limbs(1)), a.shape)
+
+        def body(i, state):
+            result, base = state
+            bit = bits[i]
+            result = select(jnp.broadcast_to(bit, result.shape[:-1]),
+                            self.mul(result, base), result)
+            base = self.sqr(base)
+            return result, base
+
+        result, _ = jax.lax.fori_loop(0, nbits, body, (one, a))
+        return result
+
+    def inv(self, a: jnp.ndarray) -> jnp.ndarray:
+        """Fermat inverse ``a^(m-2)``; returns 0 for input 0."""
+        return self.pow_const(a, self.m - 2)
+
+    def const(self, x: int, like: jnp.ndarray) -> jnp.ndarray:
+        """Broadcast a Python-int constant to the batch shape of ``like``."""
+        return jnp.broadcast_to(jnp.asarray(int_to_limbs(x % self.m)), like.shape)
+
+
+class FieldP(Mod):
+    """The base field F_P; adds sqrt (P ≡ 3 mod 4)."""
+
+    def __init__(self):
+        super().__init__(P, n_folds=3)
+
+    def sqrt(self, a: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Square root via ``a^((P+1)/4)``.  Returns (root, exists_flag)."""
+        r = self.pow_const(a, (P + 1) // 4)
+        ok = eq(self.sqr(r), a)
+        return r, ok
+
+
+class OrderN(Mod):
+    """The scalar field mod the group order N."""
+
+    def __init__(self):
+        super().__init__(N, n_folds=3)
+
+
+FP = FieldP()
+FN = OrderN()

@@ -1,0 +1,112 @@
+"""Golden tests: TPU limb arithmetic vs Python big ints.
+
+Covers random vectors plus adversarial extremes (0, 1, m-1, values just
+below 2^256) for both secp256k1 moduli — the cases where the delta-folding
+reduction bound analysis must hold exactly.
+"""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eges_tpu.ops import bigint
+from eges_tpu.ops.bigint import (FN, FP, N, NLIMBS, P, big_mul,
+                                 bytes_be_to_limbs, int_to_limbs,
+                                 limbs_to_bytes_be, limbs_to_int)
+
+rng = random.Random(1234)
+
+
+def _rand_batch(m, n=8, extremes=()):
+    vals = list(extremes) + [rng.randrange(m) for _ in range(n - len(extremes))]
+    arr = np.stack([int_to_limbs(v) for v in vals])
+    return vals, jnp.asarray(arr)
+
+
+EXTREMES_P = [0, 1, P - 1, P - 2, 2**256 % P, (2**255) % P]
+EXTREMES_N = [0, 1, N - 1, N - 2, 2**256 % N]
+
+
+def test_limb_roundtrip():
+    for v in [0, 1, P - 1, N - 1, 2**256 - 1, 12345678901234567890]:
+        assert limbs_to_int(int_to_limbs(v)) == v
+
+
+def test_bytes_limbs_roundtrip():
+    vals = [rng.randrange(2**256) for _ in range(4)] + [0, 2**256 - 1]
+    b = np.stack([np.frombuffer(v.to_bytes(32, "big"), dtype=np.uint8) for v in vals])
+    limbs = bytes_be_to_limbs(jnp.asarray(b))
+    for i, v in enumerate(vals):
+        assert limbs_to_int(limbs[i]) == v
+    back = limbs_to_bytes_be(limbs)
+    assert np.array_equal(np.asarray(back), b)
+
+
+def test_big_mul_random():
+    vals_a, a = _rand_batch(2**256, 8)
+    vals_b, b = _rand_batch(2**256, 8)
+    prod = big_mul(a, b)
+    for i in range(8):
+        assert limbs_to_int(prod[i]) == vals_a[i] * vals_b[i]
+
+
+def test_big_mul_extremes():
+    top = 2**256 - 1
+    a = jnp.asarray(np.stack([int_to_limbs(top)] * 2))
+    prod = big_mul(a, a)
+    assert limbs_to_int(prod[0]) == top * top
+
+
+@pytest.mark.parametrize("mod,extremes", [(FP, EXTREMES_P), (FN, EXTREMES_N)])
+def test_mod_mul_add_sub(mod, extremes):
+    vals_a, a = _rand_batch(mod.m, 12, extremes)
+    vals_b, b = _rand_batch(mod.m, 12, list(reversed(extremes)))
+    got_mul = mod.mul(a, b)
+    got_add = mod.add(a, b)
+    got_sub = mod.sub(a, b)
+    got_neg = mod.neg(a)
+    for i in range(12):
+        assert limbs_to_int(got_mul[i]) == vals_a[i] * vals_b[i] % mod.m, i
+        assert limbs_to_int(got_add[i]) == (vals_a[i] + vals_b[i]) % mod.m, i
+        assert limbs_to_int(got_sub[i]) == (vals_a[i] - vals_b[i]) % mod.m, i
+        assert limbs_to_int(got_neg[i]) == (-vals_a[i]) % mod.m, i
+
+
+@pytest.mark.parametrize("mod,extremes", [(FP, EXTREMES_P), (FN, EXTREMES_N)])
+def test_mod_inv(mod, extremes):
+    vals, a = _rand_batch(mod.m, 8, [1, mod.m - 1])
+    inv = mod.inv(a)
+    for i, v in enumerate(vals):
+        assert limbs_to_int(inv[i]) == pow(v, -1, mod.m), i
+
+
+def test_sqrt():
+    vals, a = _rand_batch(P, 8, [1, 4, P - 1])
+    sq = FP.sqr(a)
+    root, ok = FP.sqrt(sq)
+    assert np.all(np.asarray(ok) == 1)
+    for i, v in enumerate(vals):
+        r = limbs_to_int(root[i])
+        assert r == v % P or r == (P - v) % P, i
+    # a known non-residue: 3 is a QR mod P? check explicitly via Euler
+    nonres = next(x for x in range(2, 50) if pow(x, (P - 1) // 2, P) == P - 1)
+    _, ok2 = FP.sqrt(jnp.asarray(int_to_limbs(nonres))[None, :])
+    assert int(ok2[0]) == 0
+
+
+def test_pow_const():
+    vals, a = _rand_batch(P, 4, [2])
+    e = 0xDEADBEEFCAFE1234567890
+    got = FP.pow_const(a, e)
+    for i, v in enumerate(vals):
+        assert limbs_to_int(got[i]) == pow(v, e, P), i
+
+
+def test_predicates():
+    a = jnp.asarray(np.stack([int_to_limbs(0), int_to_limbs(5), int_to_limbs(7)]))
+    b = jnp.asarray(np.stack([int_to_limbs(0), int_to_limbs(7), int_to_limbs(5)]))
+    assert np.asarray(bigint.is_zero(a)).tolist() == [1, 0, 0]
+    assert np.asarray(bigint.eq(a, b)).tolist() == [1, 0, 0]
+    assert np.asarray(bigint.big_lt(a, b)).tolist() == [0, 1, 0]
