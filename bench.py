@@ -1,0 +1,72 @@
+"""Benchmark: batched secp256k1 ecrecover throughput on one chip.
+
+The BASELINE.json primary metric — secp256k1 verifies/sec/chip — measured
+on whatever accelerator JAX finds (the driver runs this on a real TPU).
+The CPU reference point is the single-threaded cgo ecrecover path the
+fork serializes every transaction through (~12-20k/s/core class,
+BASELINE.md), so ``vs_baseline`` is throughput / 16k.
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+CPU_BASELINE_VERIFIES_PER_S = 16_000.0  # mid of 12-20k/s/core (BASELINE.md)
+
+
+def main() -> None:
+    import numpy as np
+    import jax
+
+    from eges_tpu.crypto import secp256k1 as host
+    from eges_tpu.crypto.verifier import ecrecover_batch
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    # deterministic workload: real signatures so the verifier does full work
+    rng_msgs = [secrets.token_bytes(32) for _ in range(64)]
+    privs = [secrets.token_bytes(32) for _ in range(64)]
+    sigs = np.zeros((batch, 65), np.uint8)
+    hashes = np.zeros((batch, 32), np.uint8)
+    expect = []
+    for i in range(batch):
+        m, p = rng_msgs[i % 64], privs[i % 64]
+        s = host.ecdsa_sign(m, p)
+        sigs[i] = np.frombuffer(s, np.uint8)
+        hashes[i] = np.frombuffer(m, np.uint8)
+        if i < 4:
+            expect.append(host.pubkey_to_address(host.privkey_to_pubkey(p)))
+
+    fn = jax.jit(ecrecover_batch)
+    js, jh = jax.numpy.asarray(sigs), jax.numpy.asarray(hashes)
+    addrs, _, ok = fn(js, jh)  # compile + warmup
+    addrs, ok = np.asarray(addrs), np.asarray(ok)
+    assert ok.all(), "verifier rejected valid signatures"
+    for i in range(4):
+        assert bytes(addrs[i]) == expect[i], "address mismatch vs host model"
+
+    n_iters = 5
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        out = fn(js, jh)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    per_sec = batch * n_iters / dt
+
+    print(json.dumps({
+        "metric": "secp256k1_ecrecover_verifies_per_sec_per_chip",
+        "value": round(per_sec, 1),
+        "unit": "verifies/s",
+        "vs_baseline": round(per_sec / CPU_BASELINE_VERIFIES_PER_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

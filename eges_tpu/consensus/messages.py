@@ -1,0 +1,254 @@
+"""Consensus wire messages — both network planes.
+
+The reference splits Geec traffic over two planes (SURVEY §2.3):
+
+* **gossip plane** (RLPx/TCP in the reference): ``ValidateReqMsg`` /
+  ``QueryMsg`` / ``RegisterReqMsg`` / ``ConfirmBlockMsg``, devp2p codes
+  0x11/0x12/0x14/0x15 (ref: eth/protocol.go:67-73), relayed to all peers
+  with retry/version dedup gating.
+* **direct plane** (raw UDP + RLP): election messages and validate/query
+  replies sent point-to-point to ``ip:port`` carried inside the request
+  (ref: consensus/geec/election/server.go:70-120,
+  core/geec_state.go:584-591), wrapped in ``GeecUDPMsg`` envelopes with
+  codes 0x01/0x02/0x03 (ref: core/geecCore/Types.go:59-63).
+
+Every message is a frozen dataclass with RLP to/from, so the same bytes
+flow over the in-process simulator, real sockets, and tests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from eges_tpu.core import rlp
+from eges_tpu.core.types import Block, ConfirmBlockMsg, QueryBlockMsg, Registration
+
+# Direct-plane (UDP envelope) codes (ref: core/geecCore/Types.go:59-63)
+UDP_EXAMINE_REPLY = 0x01
+UDP_ELECT = 0x02
+UDP_QUERY_REPLY = 0x03
+UDP_BLOCKS = 0x04  # backfill reply (this build; see BlockFetchReq)
+
+# Election sub-codes (ref: consensus/geec/election/election_go.go:15-18)
+MSG_ELECT = 0x01
+MSG_VOTE = 0x02
+
+# Gossip-plane codes (ref: eth/protocol.go:67-73)
+GOSSIP_VALIDATE_REQ = 0x11
+GOSSIP_QUERY = 0x12
+GOSSIP_REGISTER_REQ = 0x14
+GOSSIP_CONFIRM_BLOCK = 0x15
+GOSSIP_GET_BLOCKS = 0x16  # backfill request (this build's minimal stand-in
+#                           for the reference's downloader body sync,
+#                           eth/downloader/queue.go:65-67 Geec-extended)
+
+
+@dataclass(frozen=True)
+class ElectMessage:
+    """Election announce / vote (ref: election/election_go.go electMessage).
+
+    ``code`` MSG_ELECT announces candidacy with ``rand``; MSG_VOTE carries a
+    vote for ``author`` (on transfer, ``author`` stays the ORIGINAL voter —
+    the vote-transfer semantics of election_go.go:276-310)."""
+
+    code: int
+    block_num: int
+    author: bytes
+    rand: int = 0
+    version: int = 0
+    retry: int = 0
+    ip: str = ""
+    port: int = 0
+
+    def to_rlp(self) -> list:
+        return [self.code, self.block_num, self.author, self.rand,
+                self.version, self.retry, self.ip.encode(), self.port]
+
+    @classmethod
+    def from_rlp(cls, item: list) -> "ElectMessage":
+        code, blk, author, rand, version, retry, ip, port = item
+        return cls(code=rlp.decode_uint(code), block_num=rlp.decode_uint(blk),
+                   author=bytes(author), rand=rlp.decode_uint(rand),
+                   version=rlp.decode_uint(version),
+                   retry=rlp.decode_uint(retry), ip=ip.decode(),
+                   port=rlp.decode_uint(port))
+
+
+@dataclass(frozen=True)
+class ValidateRequest:
+    """Proposer -> everyone: please ACK this block
+    (ref: core/geecCore/Types.go:20-30).  Carries the full block plus the
+    proposer's direct-plane return address and the empty-block numbers the
+    proposer wants backfilled (``empty_list``)."""
+
+    block_num: int
+    author: bytes
+    block: Block
+    ip: str
+    port: int
+    retry: int = 0
+    version: int = 0
+    empty_list: tuple[int, ...] = ()
+
+    def to_rlp(self) -> list:
+        return [self.block_num, self.author, self.block.to_rlp(),
+                self.ip.encode(), self.port, self.retry, self.version,
+                list(self.empty_list)]
+
+    @classmethod
+    def from_rlp(cls, item: list) -> "ValidateRequest":
+        blk_num, author, block, ip, port, retry, version, empties = item
+        return cls(block_num=rlp.decode_uint(blk_num), author=bytes(author),
+                   block=Block.from_rlp(block), ip=ip.decode(),
+                   port=rlp.decode_uint(port), retry=rlp.decode_uint(retry),
+                   version=rlp.decode_uint(version),
+                   empty_list=tuple(rlp.decode_uint(e) for e in empties))
+
+
+@dataclass(frozen=True)
+class ValidateReply:
+    """Acceptor -> proposer ACK, direct plane
+    (ref: core/geecCore/Types.go:32-38).  ``fill_blocks`` backfills the
+    empty blocks the request asked for (geec_state.go:555-564)."""
+
+    block_num: int
+    author: bytes
+    accepted: bool = True
+    retry: int = 0
+    fill_blocks: tuple[Block, ...] = ()
+
+    def to_rlp(self) -> list:
+        return [self.block_num, self.author, int(self.accepted), self.retry,
+                [b.to_rlp() for b in self.fill_blocks]]
+
+    @classmethod
+    def from_rlp(cls, item: list) -> "ValidateReply":
+        blk, author, acc, retry, fills = item
+        return cls(block_num=rlp.decode_uint(blk), author=bytes(author),
+                   accepted=bool(rlp.decode_uint(acc)),
+                   retry=rlp.decode_uint(retry),
+                   fill_blocks=tuple(Block.from_rlp(b) for b in fills))
+
+
+@dataclass(frozen=True)
+class QueryReply:
+    """Acceptor -> querier, direct plane (ref: core/geecCore/Types.go:42-49).
+    ``empty=True`` means "I have no pending block at that height"."""
+
+    block_num: int
+    author: bytes
+    version: int
+    retry: int = 0
+    empty: bool = True
+    block_hash: bytes = bytes(32)
+
+    def to_rlp(self) -> list:
+        return [self.block_num, self.author, self.version, self.retry,
+                int(self.empty), self.block_hash]
+
+    @classmethod
+    def from_rlp(cls, item: list) -> "QueryReply":
+        blk, author, version, retry, empty, h = item
+        return cls(block_num=rlp.decode_uint(blk), author=bytes(author),
+                   version=rlp.decode_uint(version),
+                   retry=rlp.decode_uint(retry),
+                   empty=bool(rlp.decode_uint(empty)), block_hash=bytes(h))
+
+
+@dataclass(frozen=True)
+class BlockFetchReq:
+    """Backfill: "send me canonical blocks [start, start+count)".
+
+    A node that learns (via a ConfirmBlockMsg) that the quorum is ahead of
+    its head asks peers to stream the gap back on the direct plane.  This
+    replaces the reference's downloader sync for the Geec capability path
+    (SURVEY §5 checkpoint/resume: "full-sync + downloader backfill
+    re-joins after downtime")."""
+
+    start: int
+    count: int
+    ip: str
+    port: int
+
+    def to_rlp(self) -> list:
+        return [self.start, self.count, self.ip.encode(), self.port]
+
+    @classmethod
+    def from_rlp(cls, item: list) -> "BlockFetchReq":
+        start, count, ip, port = item
+        return cls(start=rlp.decode_uint(start), count=rlp.decode_uint(count),
+                   ip=ip.decode(), port=rlp.decode_uint(port))
+
+
+@dataclass(frozen=True)
+class BlocksReply:
+    """Backfill payload: contiguous canonical blocks with their stored
+    confirm messages attached."""
+
+    blocks: tuple[Block, ...]
+
+    def to_rlp(self) -> list:
+        return [[b.to_rlp() for b in self.blocks]]
+
+    @classmethod
+    def from_rlp(cls, item: list) -> "BlocksReply":
+        (blocks,) = item
+        return cls(blocks=tuple(Block.from_rlp(b) for b in blocks))
+
+
+@dataclass(frozen=True)
+class UdpEnvelope:
+    """Direct-plane envelope (ref: core/geecCore/Types.go:68-72)."""
+
+    code: int
+    author: bytes
+    payload: bytes
+
+    def encode(self) -> bytes:
+        return rlp.encode([self.code, self.author, self.payload])
+
+    @classmethod
+    def decode(cls, data: bytes) -> "UdpEnvelope":
+        code, author, payload = rlp.decode(data)
+        return cls(code=rlp.decode_uint(code), author=bytes(author),
+                   payload=bytes(payload))
+
+
+_DIRECT_BODY = {
+    UDP_EXAMINE_REPLY: ValidateReply,
+    UDP_ELECT: ElectMessage,
+    UDP_QUERY_REPLY: QueryReply,
+    UDP_BLOCKS: BlocksReply,
+}
+
+
+def pack_direct(code: int, author: bytes, msg) -> bytes:
+    return UdpEnvelope(code=code, author=author,
+                       payload=rlp.encode(msg.to_rlp())).encode()
+
+
+def unpack_direct(data: bytes):
+    """-> (code, author, message object)"""
+    env = UdpEnvelope.decode(data)
+    body = _DIRECT_BODY[env.code].from_rlp(rlp.decode(env.payload))
+    return env.code, env.author, body
+
+
+_GOSSIP_BODY = {
+    GOSSIP_VALIDATE_REQ: ValidateRequest,
+    GOSSIP_QUERY: QueryBlockMsg,
+    GOSSIP_REGISTER_REQ: Registration,
+    GOSSIP_CONFIRM_BLOCK: ConfirmBlockMsg,
+    GOSSIP_GET_BLOCKS: BlockFetchReq,
+}
+
+
+def pack_gossip(code: int, msg) -> bytes:
+    return rlp.encode([code, msg.to_rlp()])
+
+
+def unpack_gossip(data: bytes):
+    """-> (code, message object)"""
+    code, body = rlp.decode(data)
+    code = rlp.decode_uint(code)
+    return code, _GOSSIP_BODY[code].from_rlp(body)

@@ -1,0 +1,894 @@
+"""The Geec consensus node: one event-loop state machine per node.
+
+This is the TPU-native re-architecture of the reference's goroutine soup
+— ``GeecState`` + its four loops (``blockLoop``/``handleVerifyReplies``/
+``handleQueryReply``/election ``HandleMessage``, core/geec_state.go:315-318),
+the engine's blocking ``Seal`` (consensus/geec/geec.go:282-370) and the
+ProtocolManager's worker goroutines (eth/handler.go:897-1056) — collapsed
+into ONE single-threaded, non-blocking state machine per node with
+injectable clock and transport (SURVEY §7 step 3: "replace the
+comment-enforced lock soup with event loops and explicit messages").
+
+Everything the reference does with a blocking wait becomes a timer or a
+deferred message:
+
+* ``Wb.Wait(blk)`` (condvar)            -> defer queue drained on advance
+* ``Seal`` blocking on election/ACKs    -> proposer phase machine + timers
+* ``time.Sleep(backoff)``               -> backoff timer
+* ``blockLoop`` select timeout ladder   -> block-timeout timer, 3x
+  committee re-election then forced empty block (geec_state.go:1140-1180)
+
+The consensus-critical semantics (versioned retries, vote transfer,
+confidence, TTL economy, membership windows) follow the reference
+line-for-line in *behavior*; citations sit on each method.
+
+Signature verification is where TPUs enter: acceptors verify a proposed
+block's signed txns as one device batch before ACKing (the reference's
+acceptor replies unconditionally, ``valResult := true``,
+core/geec_state.go:545 — verification actually happening is this build's
+north-star upgrade), and the insert path batch-recovers senders
+(core/state_processor.go:93's per-tx loop, batched).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+from eges_tpu.consensus import messages as M
+from eges_tpu.consensus.config import (
+    ChainGeecConfig, NodeConfig, calc_confidence, ttl_params,
+    CONFIDENCE_THRESHOLD,
+)
+from eges_tpu.consensus.membership import Member, Membership, derive_seed
+from eges_tpu.consensus.working_block import (
+    WorkingBlock, ELEC_CANDIDATE, ELEC_ELECTED, ELEC_VOTED,
+    WB_CURRENT, WB_FUTURE, WB_PASSED,
+)
+from eges_tpu.core.chain import BlockChain
+from eges_tpu.core.types import (
+    Block, ConfirmBlockMsg, Header, QueryBlockMsg, Registration, Transaction,
+    fake_txn, EMPTY_ADDR, new_block,
+)
+
+
+def addr_to_int(addr: bytes) -> int:
+    """Election tie-break key (ref: election/server.go:122-125)."""
+    return (int.from_bytes(addr[0:8], "big") + int.from_bytes(addr[8:16], "big")
+            + int.from_bytes(addr[16:20], "big")) % (1 << 64)
+
+
+# Proposer phases
+IDLE, ELECTING, VALIDATING, BACKOFF = range(4)
+
+
+class GeecNode:
+    """One consensus participant.
+
+    Wire-in points: ``transport`` must call :meth:`on_gossip` /
+    :meth:`on_direct` for inbound traffic; the chain calls
+    :meth:`_on_new_block` via its listener hook.  ``clock`` provides
+    ``now()`` and ``call_later(delay_s, fn) -> cancelable handle``.
+    """
+
+    def __init__(self, chain: BlockChain, clock, transport,
+                 node_cfg: NodeConfig, chain_cfg: ChainGeecConfig, *,
+                 mine: bool = True, verifier=None, log=None):
+        self.chain = chain
+        self.clock = clock
+        self.transport = transport
+        self.cfg = node_cfg
+        self.ccfg = chain_cfg
+        self.mine = mine
+        self.verifier = verifier
+        self.coinbase = node_cfg.coinbase
+        self._log = log or (lambda *a, **k: None)
+
+        tp = ttl_params(node_cfg.total_nodes)
+        self.membership = Membership(node_cfg.n_candidates,
+                                     node_cfg.n_acceptors, **tp)
+        # genesis bootstrap membership (ref: geec_state.go:275-289)
+        for bn in chain_cfg.bootstrap:
+            self.membership.add(Member(addr=bn.account, ip=bn.ip, port=bn.port,
+                                       referee=bn.account, joined_block=0,
+                                       ttl=tp["initial_ttl"]))
+
+        self.wb = WorkingBlock(self.coinbase)
+        self.trust_rands: dict[int, int] = {0: 0}
+        self.pending_blocks: dict[int, Block] = {}
+        self.max_confirmed_block = 0
+        self.unconfirmed: list[Block] = []
+        self.empty_block_list: list[int] = []
+        self.pending_regs: dict[bytes, Registration] = {}
+        self.registered = self.coinbase in self.membership
+        self.pending_geec_txns: list[Transaction] = []
+        self.geec_txn_sink = None  # app-layer callback for confirmed geec txns
+
+        # deferred messages for future working blocks (Wait() analogue)
+        self._deferred: list[tuple[int, object]] = []  # (blk_num, thunk)
+
+        # proposer phase state
+        self._phase = IDLE
+        self._proposal: Block | None = None
+        self._proposal_version = 0
+        self._validate_req: M.ValidateRequest | None = None
+        self._seal_t0 = 0.0
+        self._elect_t = 0.0
+
+        # timers
+        self._timers: dict[str, object] = {}
+        self._timeout_times = 0
+
+        chain.add_listener(self._on_new_block)
+        # restart path: rebuild membership/trust-rand/working-block state
+        # from the durable chain (blocks already canonical are final here)
+        for n in range(1, chain.height() + 1):
+            self._ingest_block(chain.get_block_by_number(n), replay=True)
+        self.max_confirmed_block = chain.height()
+        if self.coinbase in self.membership:
+            self.registered = True
+
+    # ------------------------------------------------------------------
+    # timers
+    # ------------------------------------------------------------------
+
+    def _set_timer(self, name: str, delay_s: float, fn) -> None:
+        self._cancel_timer(name)
+        self._timers[name] = self.clock.call_later(delay_s, fn)
+
+    def _cancel_timer(self, name: str) -> None:
+        h = self._timers.pop(name, None)
+        if h is not None:
+            h.cancel()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._arm_block_timeout()
+        if self.mine:
+            if not self.registered:
+                self._start_registration(renew=0)
+            self._try_propose()
+
+    def stop(self) -> None:
+        for name in list(self._timers):
+            self._cancel_timer(name)
+
+    # ------------------------------------------------------------------
+    # inbound dispatch
+    # ------------------------------------------------------------------
+
+    def on_gossip(self, data: bytes) -> None:
+        try:
+            code, msg = M.unpack_gossip(data)
+        except Exception:
+            return  # malformed datagram from a peer must not kill the loop
+        if code == M.GOSSIP_VALIDATE_REQ:
+            self._handle_validate_request(msg)
+        elif code == M.GOSSIP_QUERY:
+            self._handle_query(msg)
+        elif code == M.GOSSIP_REGISTER_REQ:
+            self._append_reg_req(msg)
+        elif code == M.GOSSIP_CONFIRM_BLOCK:
+            self._handle_confirm(msg)
+        elif code == M.GOSSIP_GET_BLOCKS:
+            self._serve_block_fetch(msg)
+
+    def on_direct(self, data: bytes) -> None:
+        try:
+            code, author, msg = M.unpack_direct(data)
+        except Exception:
+            return
+        if code == M.UDP_ELECT:
+            self._handle_elect_message(msg)
+        elif code == M.UDP_EXAMINE_REPLY:
+            self._handle_validate_reply(msg)
+        elif code == M.UDP_QUERY_REPLY:
+            self._handle_query_reply(msg)
+        elif code == M.UDP_BLOCKS:
+            self._handle_blocks_reply(msg)
+
+    def on_geec_txn(self, payload: bytes) -> None:
+        """UDP txn ingest (ref: consensus/geec/geec_api.go:28-41)."""
+        from eges_tpu.core.types import geec_txn
+        self.pending_geec_txns.append(geec_txn(payload))
+
+    # defer a thunk until the working block reaches ``blk`` (Wait analogue)
+    def _defer(self, blk: int, thunk) -> None:
+        self._deferred.append((blk, thunk))
+
+    def _drain_deferred(self) -> None:
+        ready = [(b, t) for (b, t) in self._deferred if b <= self.wb.blk_num]
+        self._deferred = [(b, t) for (b, t) in self._deferred
+                          if b > self.wb.blk_num]
+        for b, t in ready:
+            if b == self.wb.blk_num:
+                t()
+
+    # ------------------------------------------------------------------
+    # trust rand / committee helpers
+    # ------------------------------------------------------------------
+
+    def seed_for(self, blk_num: int) -> int | None:
+        """Committee seed for height ``blk_num`` = TrustRand(blk_num-1).
+        The reference stubs GetTrustRand to return the block number
+        (core/geec_state.go:156-171); here the real header-recorded rand
+        is used — the stub's determinism comes from the simulator's
+        seeded PRNGs instead."""
+        return self.trust_rands.get(blk_num - 1)
+
+    def is_committee(self, blk_num: int, version: int = 0) -> bool:
+        seed = self.seed_for(blk_num)
+        if seed is None:
+            return False
+        return self.membership.is_committee(self.coinbase, seed, version)
+
+    def is_acceptor(self, blk_num: int) -> bool:
+        seed = self.seed_for(blk_num)
+        if seed is None:
+            return False
+        return self.membership.is_acceptor(self.coinbase, seed)
+
+    # ------------------------------------------------------------------
+    # proposer pipeline (the event-driven Seal, ref: geec.go:282-370)
+    # ------------------------------------------------------------------
+
+    def _try_propose(self, version: int = 0) -> None:
+        if not self.mine or self._phase != IDLE:
+            return
+        h = self.wb.blk_num
+        if not self.is_committee(h, version):
+            return  # ErrNoCommittee path (geec.go:262): stay follower
+        self._seal_t0 = self.clock.now()
+        self._start_election(h, version)
+
+    def _start_election(self, blk_num: int, version: int) -> None:
+        """(ref: ElectForProposer geec_state.go:606-651 + Elect
+        election_go.go:37-175)"""
+        wb = self.wb
+        if blk_num != wb.blk_num:
+            return
+        seed = self.seed_for(blk_num)
+        committee = self.membership.committee(seed, version)
+        if version > wb.max_version:
+            wb.bump_version(version)
+        elif wb.elect_state == ELEC_VOTED:
+            return  # already voted on this version (election_go.go:56-59)
+        wb.n_candidates = len(committee)
+        wb.election_threshold = self.membership.election_threshold(len(committee))
+        self._phase = ELECTING
+        self._proposal_version = version
+        self._elect_t = self.clock.now()
+        self._election_retry(blk_num, version, committee, retry=0)
+
+    def _election_retry(self, blk_num: int, version: int, committee,
+                        retry: int) -> None:
+        wb = self.wb
+        if (blk_num != wb.blk_num or wb.max_version > version
+                or wb.elect_state == ELEC_VOTED):
+            self._abort_proposal()
+            return
+        if len(wb.supporters) >= wb.election_threshold:
+            self._on_elected()
+            return
+        em = M.ElectMessage(code=M.MSG_ELECT, block_num=blk_num,
+                            author=self.coinbase, rand=wb.my_rand,
+                            version=version, retry=retry,
+                            ip=self.cfg.consensus_ip,
+                            port=self.cfg.consensus_port)
+        payload = M.pack_direct(M.UDP_ELECT, self.coinbase, em)
+        for m in committee:
+            if m.addr == self.coinbase:
+                continue  # never to self (election_go.go:83)
+            self.transport.send_direct(m.ip, m.port, payload)
+        # 1 s retry loop (election_go.go:150)
+        self._set_timer("election", 1.0,
+                        lambda: self._election_retry(blk_num, version,
+                                                     committee, retry + 1))
+
+    def _on_elected(self) -> None:
+        """Threshold of votes reached -> build + broadcast the proposal."""
+        wb = self.wb
+        if self._phase != ELECTING:
+            return
+        wb.elect_state = ELEC_ELECTED
+        wb.is_proposer = True
+        wb.validate_threshold = self.membership.validate_threshold()
+        self._cancel_timer("election")
+        if self.cfg.breakdown:
+            self._log("breakdown", phase="election",
+                      dt=self.clock.now() - self._elect_t,
+                      blk=wb.blk_num)
+        if self._proposal_version > 0:
+            # recovered leader: query what happened first
+            self._start_query(wb.blk_num, self._proposal_version)
+            return
+        self._build_and_validate(wb.blk_num, self._proposal_version)
+
+    def _build_proposal(self, blk_num: int) -> Block:
+        """Assemble header+body (ref: Prepare geec.go:228-264 + Seal's txn
+        attachment geec.go:319-339 + Finalize geec.go:268-279)."""
+        parent = self.chain.head()
+        regs = tuple(self.pending_regs[a] for a in
+                     sorted(self.pending_regs)[: self.ccfg.max_reg_per_blk])
+        header = Header(
+            parent_hash=parent.hash, number=blk_num,
+            coinbase=self.coinbase, difficulty=100,
+            time=max(int(self.clock.now()), parent.header.time + 1),
+            root=parent.header.root, regs=regs,
+            trust_rand=self.wb._rng.getrandbits(64),  # seed for NEXT block
+        )
+        n = min(len(self.pending_geec_txns), self.cfg.txn_per_block)
+        geec_txns = tuple(self.pending_geec_txns[:n])
+        self.pending_geec_txns = self.pending_geec_txns[n:]
+        fakes = tuple(fake_txn(self.cfg.txn_size, seq=i)
+                      for i in range(self.cfg.txn_per_block - n))
+        return new_block(header, geec_txns=geec_txns, fake_txns=fakes)
+
+    def _build_and_validate(self, blk_num: int, version: int) -> None:
+        if blk_num != self.wb.blk_num:
+            self._abort_proposal()
+            return
+        self._proposal = self._build_proposal(blk_num)
+        req = M.ValidateRequest(
+            block_num=blk_num, author=self.coinbase, block=self._proposal,
+            ip=self.cfg.consensus_ip, port=self.cfg.consensus_port,
+            retry=0, version=version,
+            empty_list=tuple(self.empty_block_list),
+        )
+        self._ask_for_ack(req)
+
+    def _ask_for_ack(self, req: M.ValidateRequest) -> None:
+        """(ref: AskForAck geec.go:373-419 — gossip the full block, retry
+        on validate_timeout with bumped retry counter)"""
+        self._phase = VALIDATING
+        self._validate_req = req
+        self.wb.validate_replies.clear()
+        self.wb.validate_succeeded = False
+        self._ack_t = self.clock.now()
+        self._validate_retry(req.block_num, req.version, 0)
+
+    def _validate_retry(self, blk_num: int, version: int, retry: int) -> None:
+        if blk_num != self.wb.blk_num or self._phase != VALIDATING:
+            return
+        req = dataclasses.replace(self._validate_req, retry=retry)
+        self.transport.gossip(M.pack_gossip(M.GOSSIP_VALIDATE_REQ, req))
+        self._set_timer("validate", self.ccfg.validate_timeout_ms / 1e3,
+                        lambda: self._validate_retry(blk_num, version,
+                                                     retry + 1))
+
+    def _handle_validate_reply(self, reply: M.ValidateReply) -> None:
+        """Tally ACKs (ref: handleVerifyReplies geec_state.go:1184-1227)."""
+        wb = self.wb
+        if reply.block_num != wb.blk_num or reply.author in wb.validate_replies:
+            return
+        for blk in reply.fill_blocks:  # backfilled empty blocks
+            self.chain.offer(blk)
+        if not reply.accepted:
+            return  # an explicit NACK never counts toward the quorum
+        wb.validate_replies[reply.author] = reply.retry
+        if (len(wb.validate_replies) >= wb.validate_threshold
+                and not wb.validate_succeeded and self._phase == VALIDATING):
+            wb.validate_succeeded = True
+            self._cancel_timer("validate")
+            if self.cfg.breakdown:
+                self._log("breakdown", phase="ack",
+                          dt=self.clock.now() - self._ack_t, blk=wb.blk_num)
+            self._phase = BACKOFF
+            supporters = tuple(wb.validate_replies.keys())
+            self._set_timer("backoff", self.ccfg.backoff_time_ms / 1e3,
+                            lambda: self._finish_seal(supporters))
+
+    def _finish_seal(self, supporters: tuple[bytes, ...]) -> None:
+        """Confirm + self-insert + broadcast (ref: Seal tail geec.go:356-368
+        + worker.wait/minedBroadcastLoop eth/handler.go:1183-1209)."""
+        block = self._proposal
+        if block is None or block.number != self.wb.blk_num:
+            self._abort_proposal()
+            return
+        parent = self.chain.head()
+        parent_conf = parent.confirm.confidence if parent.confirm else 0
+        confirm = ConfirmBlockMsg(
+            block_number=block.number, hash=block.hash,
+            confidence=calc_confidence(parent_conf), supporters=supporters,
+            empty_block=False)
+        sealed = block.with_confirm(confirm)
+        self._phase = IDLE
+        self._proposal = None
+        self.chain.offer(sealed)  # our own insert funnel
+        self.transport.gossip(M.pack_gossip(M.GOSSIP_CONFIRM_BLOCK, confirm))
+
+    def _abort_proposal(self) -> None:
+        self._phase = IDLE
+        self._proposal = None
+        self._cancel_timer("election")
+        self._cancel_timer("validate")
+        self._cancel_timer("backoff")
+        self._cancel_timer("query")
+
+    # ------------------------------------------------------------------
+    # election message handling (ref: handleElectMessage
+    # election_go.go:178-310)
+    # ------------------------------------------------------------------
+
+    def _handle_elect_message(self, em: M.ElectMessage) -> None:
+        wb = self.wb
+        verdict = wb.classify(em.block_num)
+        if verdict == WB_PASSED:
+            return
+        if verdict == WB_FUTURE:
+            self._defer(em.block_num, lambda: self._handle_elect_message(em))
+            return
+        if wb.max_version > em.version:
+            return  # old version (election_go.go:205)
+        if wb.max_version < em.version:
+            wb.bump_version(em.version)
+            if self._phase in (ELECTING, VALIDATING):
+                self._abort_proposal()
+
+        if em.code == M.MSG_ELECT:
+            if wb.elect_state == ELEC_CANDIDATE:
+                if (wb.my_rand > em.rand
+                        or (wb.my_rand == em.rand
+                            and addr_to_int(self.coinbase) > addr_to_int(em.author))):
+                    return  # I have the larger rand — ignore
+                wb.elect_state = ELEC_VOTED
+                wb.delegator = em.author
+                wb.delegator_ip = em.ip
+                wb.delegator_port = em.port
+                if self._phase == ELECTING:
+                    self._abort_proposal()
+                self._vote(em.block_num, em.ip, em.port, em.version)
+            elif wb.elect_state == ELEC_VOTED:
+                # re-vote on delegator retry or after two extra rounds
+                if (em.author == wb.delegator
+                        or em.retry > wb.max_election_retry + 1):
+                    self._vote(em.block_num, wb.delegator_ip,
+                               wb.delegator_port, em.version)
+                    wb.max_election_retry = em.retry
+        elif em.code == M.MSG_VOTE:
+            if wb.elect_state == ELEC_CANDIDATE or self._phase == ELECTING:
+                wb.supporters.add(em.author)
+                if (len(wb.supporters) >= wb.election_threshold
+                        and self._phase == ELECTING):
+                    self._on_elected()
+            elif wb.elect_state == ELEC_VOTED:
+                # vote transfer: forward the original author's vote
+                wb.supporters.add(em.author)
+                fwd = M.ElectMessage(code=M.MSG_VOTE, block_num=em.block_num,
+                                     author=em.author, version=em.version,
+                                     ip=self.cfg.consensus_ip,
+                                     port=self.cfg.consensus_port)
+                self.transport.send_direct(
+                    wb.delegator_ip, wb.delegator_port,
+                    M.pack_direct(M.UDP_ELECT, self.coinbase, fwd))
+
+    def _vote(self, blk_num: int, ip: str, port: int, version: int) -> None:
+        """(ref: vote election_go.go:312-340)"""
+        reply = M.ElectMessage(code=M.MSG_VOTE, block_num=blk_num,
+                               author=self.coinbase, version=version,
+                               ip=self.cfg.consensus_ip,
+                               port=self.cfg.consensus_port)
+        self.transport.send_direct(ip, port,
+                                   M.pack_direct(M.UDP_ELECT, self.coinbase,
+                                                 reply))
+
+    # ------------------------------------------------------------------
+    # acceptor side: validate requests (ref: HandleValidateRequest
+    # eth/handler.go:1000-1056 + Validate geec_state.go:528-591)
+    # ------------------------------------------------------------------
+
+    def _handle_validate_request(self, req: M.ValidateRequest) -> None:
+        wb = self.wb
+        verdict = wb.classify(req.block_num)
+        if verdict == WB_PASSED:
+            return
+        if verdict == WB_FUTURE:
+            self._defer(req.block_num,
+                        lambda: self._handle_validate_request(req))
+            return
+        if req.version < wb.max_version:
+            return
+        if req.version > wb.max_version:
+            wb.bump_version(req.version)
+        if req.retry <= wb.max_validate_retry:
+            return  # already relayed/answered this retry round
+        # gossip-relay with dedup (handler.go:1025-1037)
+        self.transport.gossip(M.pack_gossip(M.GOSSIP_VALIDATE_REQ, req))
+        if req.block.number > self.max_confirmed_block:
+            self.pending_blocks[req.block.number] = req.block
+        wb.max_validate_retry = req.retry
+
+        if not self.is_acceptor(req.block_num):
+            return
+        accepted = self._validate_block(req.block)
+        if not accepted:
+            self._log("reject", blk=req.block_num)
+            return
+        fills = []
+        for n in req.empty_list:  # backfill requested empties
+            b = self.chain.get_block_by_number(n)
+            if b is not None:
+                fills.append(b)
+        reply = M.ValidateReply(block_num=req.block_num, author=self.coinbase,
+                                accepted=True, retry=req.retry,
+                                fill_blocks=tuple(fills))
+        self.transport.send_direct(
+            req.ip, req.port,
+            M.pack_direct(M.UDP_EXAMINE_REPLY, self.coinbase, reply))
+
+    def _validate_block(self, block: Block) -> bool:
+        """Acceptor-side block check.  The reference ACKs unconditionally
+        (``valResult := true``, geec_state.go:545); here the signed txns
+        are batch-verified on device — the capability BASELINE.json
+        targets.  Same implementation as the insert path
+        (chain._verify_body) by construction."""
+        from eges_tpu.crypto.verifier import batch_verify_txns
+        if self.verifier is None:
+            return True
+        return batch_verify_txns(block.transactions, self.verifier)
+
+    # ------------------------------------------------------------------
+    # confirm handling (ref: eth/handler.go:785-871)
+    # ------------------------------------------------------------------
+
+    def _handle_confirm(self, confirm: ConfirmBlockMsg) -> None:
+        if confirm.block_number <= self.max_confirmed_block:
+            return
+        if confirm.empty_block:
+            for n in sorted(self.pending_blocks):
+                if n < confirm.block_number:
+                    blk = self.pending_blocks.pop(n).with_confirm(confirm)
+                    self.chain.offer(blk)
+                elif n == confirm.block_number:
+                    del self.pending_blocks[n]
+            if self.chain.height() == confirm.block_number - 1:
+                empty = self.chain.make_empty_block().with_confirm(confirm)
+                self.chain.offer(empty)
+        else:
+            for n in sorted(self.pending_blocks):
+                if n > confirm.block_number:
+                    break
+                blk = self.pending_blocks.pop(n)
+                if n == confirm.block_number and blk.hash != confirm.hash:
+                    # a confirm only vouches for its own hash; a stale or
+                    # forged pending block at that height must not be
+                    # stamped confirmed (cf. the hash check on the query
+                    # path, geec_state.go:1370) — drop it and let
+                    # backfill fetch the real one
+                    continue
+                self.chain.offer(blk.with_confirm(confirm))
+        self.max_confirmed_block = confirm.block_number
+        # unconditional re-broadcast; loop broken by max_confirmed gate
+        self.transport.gossip(M.pack_gossip(M.GOSSIP_CONFIRM_BLOCK, confirm))
+        behind = self.chain.height() < confirm.block_number
+        local = self.chain.get_block_by_number(confirm.block_number)
+        forked = (not confirm.empty_block and local is not None
+                  and local.hash != confirm.hash)
+        if behind or forked:
+            self._request_backfill(confirm.block_number)
+
+    # ------------------------------------------------------------------
+    # backfill (downloader-sync stand-in; SURVEY §5 checkpoint/resume)
+    # ------------------------------------------------------------------
+
+    def _request_backfill(self, target: int, start: int | None = None) -> None:
+        """Ask peers for the gap between our head and the quorum head.
+
+        The request overlaps a few blocks *behind* our head so the reply
+        exposes the fork point when our tail is locally-forced empty
+        blocks (replace_suffix needs the anchor).  Rate-limited to one
+        outstanding request per validate-timeout.
+        """
+        if "backfill" in self._timers:
+            return
+        if start is None:
+            start = max(1, self.chain.height() - 7)
+        count = max(min(target - start + 1, 64), 1)
+        req = M.BlockFetchReq(start=start, count=count,
+                              ip=self.cfg.consensus_ip,
+                              port=self.cfg.consensus_port)
+        self._backfill_target = target
+        self.transport.gossip(M.pack_gossip(M.GOSSIP_GET_BLOCKS, req))
+        self._set_timer("backfill", self.ccfg.validate_timeout_ms / 1e3,
+                        lambda: self._cancel_timer("backfill"))
+
+    def _serve_block_fetch(self, req: M.BlockFetchReq) -> None:
+        blocks = []
+        for n in range(req.start, req.start + min(req.count, 64)):
+            b = self.chain.get_block_by_number(n)
+            if b is None:
+                break
+            blocks.append(b)
+        if not blocks:
+            return
+        reply = M.BlocksReply(blocks=tuple(blocks))
+        self.transport.send_direct(
+            req.ip, req.port,
+            M.pack_direct(M.UDP_BLOCKS, self.coinbase, reply))
+
+    def _handle_blocks_reply(self, reply: M.BlocksReply) -> None:
+        """Backfilled canonical blocks: heal a local-empty-block fork via
+        reorg, then extend normally.  If the fork is deeper than the
+        reply's overlap, re-request further back (doubling window)."""
+        blocks = sorted(reply.blocks, key=lambda b: b.number)
+        if not blocks:
+            return
+        head = self.chain.height()
+        conflict = [b for b in blocks if b.number <= head
+                    and (local := self.chain.get_block_by_number(b.number))
+                    is not None and local.hash != b.hash]
+        if conflict:
+            done = self.chain.replace_suffix(
+                [b for b in blocks if b.number >= conflict[0].number])
+            if not done and conflict[0].number == blocks[0].number:
+                # fork point precedes the reply window — look deeper
+                self._cancel_timer("backfill")
+                target = getattr(self, "_backfill_target", head + 1)
+                depth = 2 * max(head - blocks[0].number + 1, 8)
+                self._request_backfill(target,
+                                       start=max(1, head - depth + 1))
+                return
+        for b in blocks:
+            self.chain.offer(b)
+
+    # ------------------------------------------------------------------
+    # chain listener (ref: handleNewBlock geec_state.go:964-1018 +
+    # blockLoop geec_state.go:1132-1180)
+    # ------------------------------------------------------------------
+
+    def _on_new_block(self, blk: Block) -> None:
+        self._timeout_times = 0
+        self._arm_block_timeout()
+        self._ingest_block(blk)
+
+    def _ingest_block(self, blk: Block, replay: bool = False) -> None:
+        """Consensus-state effects of a canonical block; also used to
+        rebuild state from a durable chain on restart (the reference
+        rebuilds GeecState "from genesis bootstrap list + replayed
+        confirmed blocks", SURVEY §5 checkpoint/resume)."""
+        self.trust_rands[blk.number] = blk.header.trust_rand
+        if blk.header.coinbase == EMPTY_ADDR:
+            if blk.number not in self.empty_block_list:
+                self.empty_block_list.append(blk.number)
+        self.unconfirmed.append(blk)
+        confidence = blk.confirm.confidence if blk.confirm else 0
+        if confidence > CONFIDENCE_THRESHOLD:
+            self._handle_confirmed_tail(blk)
+        # drop pendings at or below the new height
+        for n in list(self.pending_blocks):
+            if n <= blk.number:
+                del self.pending_blocks[n]
+        if blk.number >= self.wb.blk_num:
+            if not replay:
+                self._abort_proposal()
+            self.wb.advance(blk.number + 1)
+            if not replay:
+                self._drain_deferred()
+                self._try_propose()
+
+    def _handle_confirmed_tail(self, confirmed_blk: Block) -> None:
+        """Apply effects of all now-confirmed blocks (ref:
+        handleConfirmedBlock geec_state.go:1021-1082)."""
+        for blk in self.unconfirmed:
+            for reg in blk.header.regs:
+                known = self.pending_regs.get(reg.account)
+                if known is not None and known.renew <= reg.renew:
+                    del self.pending_regs[reg.account]
+                try:
+                    port = int(reg.port)
+                except ValueError:
+                    continue  # geec_state.go:1049: unparsable port ignored
+                self.membership.add(Member(
+                    addr=reg.account, referee=reg.referee, ip=reg.ip,
+                    port=port, joined_block=blk.number,
+                    ttl=self.membership.initial_ttl,
+                    renewed_times=reg.renew))
+                if reg.account == self.coinbase:
+                    self.registered = True
+                    self._cancel_timer("register")
+            for txn in blk.geec_txns:
+                if self.geec_txn_sink is not None:
+                    self.geec_txn_sink(txn)
+            if self.cfg.failure_test:
+                self._check_membership(blk)
+        self.unconfirmed = []
+        self.empty_block_list = []
+
+    def _check_membership(self, blk: Block) -> None:
+        """TTL economy per confirmed block (ref: CheckMembership
+        geec_state.go:1088-1129)."""
+        if blk.confirm is not None:
+            self.membership.reward(list(blk.confirm.supporters)
+                                   + [blk.header.coinbase])
+        if blk.number % self.membership.ttl_interval == 0:
+            self.membership.decay()
+            if (self.membership.needs_renewal(self.coinbase)
+                    and self.mine):
+                me = self.membership.get(self.coinbase)
+                self._start_registration(renew=me.renewed_times + 1)
+
+    # ------------------------------------------------------------------
+    # registration (ref: Register geec_state.go:706-757)
+    # ------------------------------------------------------------------
+
+    def _start_registration(self, renew: int) -> None:
+        me = self.membership.get(self.coinbase)
+        if me is not None and me.renewed_times >= renew > 0:
+            return
+        reg = Registration(account=self.coinbase, referee=self.coinbase,
+                           ip=self.cfg.consensus_ip,
+                           port=str(self.cfg.consensus_port),
+                           renew=renew)
+        self._registration_tick(reg, attempt=0)
+
+    def _registration_tick(self, reg: Registration, attempt: int) -> None:
+        if self.registered and reg.renew == 0:
+            return
+        self._append_reg_req(reg)  # local pending list too
+        self.transport.gossip(M.pack_gossip(M.GOSSIP_REGISTER_REQ, reg))
+        self._set_timer("register", self.ccfg.reg_timeout_s,
+                        lambda: self._registration_tick(reg, attempt + 1))
+
+    def _append_reg_req(self, reg: Registration) -> None:
+        """(ref: AppendRegReq geec_state.go:669-683)"""
+        known = self.pending_regs.get(reg.account)
+        if (known is not None and known.ip == reg.ip and known.port == reg.port
+                and known.renew >= reg.renew):
+            return
+        self.pending_regs[reg.account] = reg
+
+    # ------------------------------------------------------------------
+    # failure handling: timeout ladder (ref: blockLoop
+    # geec_state.go:1140-1180)
+    # ------------------------------------------------------------------
+
+    def _arm_block_timeout(self) -> None:
+        self._set_timer("block_timeout", self.cfg.block_timeout_s,
+                        self._on_block_timeout)
+
+    def _on_block_timeout(self) -> None:
+        if self.wb.blk_num == 1:
+            self._arm_block_timeout()  # no timeout during bootstrap
+            return
+        if self._timeout_times < 3:
+            self._timeout_times += 1
+            self._arm_block_timeout()
+            self._handle_committee_timeout(self._timeout_times)
+        else:
+            self._timeout_times = 0
+            self._arm_block_timeout()
+            self._force_empty_block()
+
+    def _force_empty_block(self) -> None:
+        """(ref: HandleBlockTimeout geec_state.go:927-953)"""
+        empty = self.chain.make_empty_block()
+        confirm = ConfirmBlockMsg(block_number=empty.number, hash=empty.hash,
+                                  confidence=0, empty_block=True)
+        self.empty_block_list.append(empty.number)
+        self.chain.offer(empty.with_confirm(confirm))
+
+    def _handle_committee_timeout(self, version: int) -> None:
+        """Re-elect at a higher version then query what happened
+        (ref: HandleCommitteeTimeout geec_state.go:1286-1405)."""
+        blk_num = self.wb.blk_num
+        if not self.is_committee(blk_num, version):
+            return
+        self._abort_proposal()
+        self._try_propose(version)
+
+    # -- query protocol (recovered leader side) -------------------------
+
+    def _start_query(self, blk_num: int, version: int) -> None:
+        wb = self.wb
+        wb.query_threshold = self.membership.validate_threshold()
+        wb.query_replies.clear()
+        wb.query_empty_count = 0
+        wb.query_nonempty_count = 0
+        wb.query_recv_majority = False
+        self._phase = VALIDATING  # reuse phase slot for retry gating
+        self._query_retry(blk_num, version, 0)
+
+    def _query_retry(self, blk_num: int, version: int, retry: int) -> None:
+        if blk_num != self.wb.blk_num or self.wb.query_recv_majority:
+            return
+        q = QueryBlockMsg(block_number=blk_num, version=version,
+                          ip=self.cfg.consensus_ip, retry=retry,
+                          port=self.cfg.consensus_port)
+        self.transport.gossip(M.pack_gossip(M.GOSSIP_QUERY, q))
+        self._set_timer("query", self.ccfg.validate_timeout_ms / 1e3,
+                        lambda: self._query_retry(blk_num, version, retry + 1))
+
+    def _handle_query_reply(self, reply: M.QueryReply) -> None:
+        """(ref: handleQueryReply geec_state.go:1231-1283)"""
+        wb = self.wb
+        if (reply.block_num != wb.blk_num or reply.version != wb.max_version
+                or reply.author in wb.query_replies):
+            return
+        wb.query_replies[reply.author] = reply.retry
+        if reply.empty:
+            wb.query_empty_count += 1
+        else:
+            wb.query_nonempty_count += 1
+            self._query_block_hash = reply.block_hash
+        if (len(wb.query_replies) >= wb.query_threshold
+                and not wb.query_recv_majority):
+            wb.query_recv_majority = True
+            self._cancel_timer("query")
+            self._resolve_query(reply.block_num, reply.version)
+
+    def _resolve_query(self, blk_num: int, version: int) -> None:
+        """(ref: QUERY_* decision geec_state.go:1339-1398)"""
+        wb = self.wb
+        head = self.chain.head()
+        head_conf = head.confirm.confidence if head.confirm else 0
+        if wb.query_empty_count >= wb.query_threshold:
+            # nobody saw a block: confirm an empty one
+            self._phase = IDLE
+            empty = self.chain.make_empty_block()
+            confirm = ConfirmBlockMsg(block_number=blk_num, hash=empty.hash,
+                                      confidence=calc_confidence(head_conf),
+                                      supporters=tuple(wb.query_replies),
+                                      empty_block=True)
+            self.chain.offer(empty.with_confirm(confirm))
+            self.transport.gossip(M.pack_gossip(M.GOSSIP_CONFIRM_BLOCK, confirm))
+        elif wb.query_nonempty_count >= wb.query_threshold:
+            # majority saw the block: confirm it
+            self._phase = IDLE
+            confirm = ConfirmBlockMsg(block_number=blk_num,
+                                      hash=self._query_block_hash,
+                                      confidence=calc_confidence(head_conf),
+                                      supporters=tuple(wb.query_replies),
+                                      empty_block=False)
+            pending = self.pending_blocks.get(blk_num)
+            if pending is not None and pending.hash == confirm.hash:
+                self.chain.offer(pending.with_confirm(confirm))
+            self.transport.gossip(M.pack_gossip(M.GOSSIP_CONFIRM_BLOCK, confirm))
+        else:
+            # mixed: re-run the ACK round for the pending block
+            pending = self.pending_blocks.get(blk_num)
+            if pending is None:
+                self._phase = IDLE
+                return
+            req = M.ValidateRequest(
+                block_num=blk_num, author=self.coinbase, block=pending,
+                ip=self.cfg.consensus_ip, port=self.cfg.consensus_port,
+                retry=0, version=version,
+                empty_list=tuple(self.empty_block_list))
+            self._proposal = pending
+            self._proposal_version = version
+            self._ask_for_ack(req)
+
+    # -- query serving (ref: HandleQueryMsg eth/handler.go:897-997) ------
+
+    def _handle_query(self, query: QueryBlockMsg) -> None:
+        wb = self.wb
+        verdict = wb.classify(query.block_number)
+        if verdict == WB_PASSED:
+            return
+        if verdict == WB_FUTURE:
+            self._defer(query.block_number, lambda: self._handle_query(query))
+            return
+        if query.version < wb.max_version:
+            return
+        if query.version > wb.max_version:
+            wb.bump_version(query.version)
+            if self._phase in (ELECTING, VALIDATING):
+                self._abort_proposal()
+        if query.retry <= wb.max_query_retry:
+            return
+        wb.max_query_retry = query.retry
+        self.transport.gossip(M.pack_gossip(M.GOSSIP_QUERY, query))
+        if not self.is_acceptor(query.block_number):
+            return
+        pending = self.pending_blocks.get(query.block_number)
+        reply = M.QueryReply(
+            block_num=query.block_number, author=self.coinbase,
+            version=query.version, retry=query.retry,
+            empty=pending is None,
+            block_hash=pending.hash if pending is not None else bytes(32))
+        self.transport.send_direct(
+            query.ip, query.port,
+            M.pack_direct(M.UDP_QUERY_REPLY, self.coinbase, reply))

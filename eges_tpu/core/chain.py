@@ -1,0 +1,333 @@
+"""Canonical chain management.
+
+Covers the reference's L4 for the Geec capability set: ordered insertion
+with header verification, body validation, batched sender recovery, a
+durable block store, and the new-block notification hook that drives the
+consensus state machine (ref: core/blockchain.go:1096 InsertChain,
+:526-527 insert -> GeecState.NotifyNewBlock).
+
+Deliberate TPU-first redesign (SURVEY §7.5): the reference funnels all
+blocks through the fetcher queue then verifies/recovers senders one tx at
+a time via cgo (core/state_processor.go:93).  Here insertion is a single
+ordered funnel too (``offer`` buffers out-of-order arrivals), but sender
+recovery for an entire block is ONE device batch via
+:class:`~eges_tpu.crypto.verifier.BatchVerifier`, and verification of
+header links is host-side (they are near-no-ops in Geec,
+consensus/geec/geec.go:186-210).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from dataclasses import dataclass
+
+from eges_tpu.core import rlp
+from eges_tpu.core.types import (
+    Block, Header, new_block, EMPTY_ADDR, ZERO_HASH,
+)
+
+
+class ChainError(Exception):
+    pass
+
+
+class MemoryStore:
+    """In-memory block store (the reference's ethdb.MemDatabase role,
+    ethdb/memory_database.go — used by all unit tests)."""
+
+    def __init__(self):
+        self._by_hash: dict[bytes, bytes] = {}
+        self._hash_by_number: dict[int, bytes] = {}
+        self._head: bytes | None = None
+
+    def put_block(self, block: Block) -> None:
+        raw = block.encode()
+        h = block.hash
+        self._by_hash[h] = raw
+        self._hash_by_number[block.number] = h
+
+    def get_block(self, h: bytes) -> Block | None:
+        raw = self._by_hash.get(h)
+        return Block.decode(raw) if raw is not None else None
+
+    def get_hash_by_number(self, n: int) -> bytes | None:
+        return self._hash_by_number.get(n)
+
+    def set_head(self, h: bytes) -> None:
+        self._head = h
+
+    def get_head(self) -> bytes | None:
+        return self._head
+
+    def close(self) -> None:
+        pass
+
+
+class FileStore(MemoryStore):
+    """Append-only log + index — the durable store (the reference's
+    LevelDB role, ethdb/database.go, for the write/read-back/restart
+    paths Geec actually uses: blocks by hash/number + head tracking,
+    core/database_util.go).
+
+    Layout: ``blocks.log`` is a sequence of [u32 len][rlp block] records;
+    ``HEAD`` holds the head hash.  Restart replays the log to rebuild the
+    in-memory index (crash-safe: a torn tail record is truncated).
+    """
+
+    def __init__(self, path: str):
+        super().__init__()
+        os.makedirs(path, exist_ok=True)
+        self._dir = path
+        self._log_path = os.path.join(path, "blocks.log")
+        self._head_path = os.path.join(path, "HEAD")
+        self._replay()
+        self._log = open(self._log_path, "ab")
+
+    def _replay(self) -> None:
+        if not os.path.exists(self._log_path):
+            return
+        with open(self._log_path, "rb") as f:
+            data = f.read()
+        pos = 0
+        good_end = 0
+        while pos + 4 <= len(data):
+            (n,) = struct.unpack("<I", data[pos : pos + 4])
+            if pos + 4 + n > len(data):
+                break  # torn tail
+            raw = data[pos + 4 : pos + 4 + n]
+            try:
+                block = Block.decode(raw)
+            except Exception:
+                break
+            self._by_hash[block.hash] = raw
+            self._hash_by_number[block.number] = block.hash
+            pos += 4 + n
+            good_end = pos
+        if good_end != len(data):
+            with open(self._log_path, "r+b") as f:
+                f.truncate(good_end)
+        if os.path.exists(self._head_path):
+            with open(self._head_path, "rb") as f:
+                h = f.read()
+            if h in self._by_hash:
+                self._head = h
+
+    def put_block(self, block: Block) -> None:
+        if block.hash in self._by_hash:
+            return
+        raw = block.encode()
+        self._log.write(struct.pack("<I", len(raw)) + raw)
+        self._log.flush()
+        os.fsync(self._log.fileno())
+        self._by_hash[block.hash] = raw
+        self._hash_by_number[block.number] = block.hash
+
+    def set_head(self, h: bytes) -> None:
+        super().set_head(h)
+        tmp = self._head_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(h)
+        os.replace(tmp, self._head_path)
+
+    def close(self) -> None:
+        self._log.close()
+
+
+def make_genesis(extra: bytes = b"geec-genesis", time: int = 0) -> Block:
+    """Genesis block; the ``"thw"`` consensus config lives in the genesis
+    JSON beside it (ref: core/genesis.go SetupGenesisBlock +
+    params/config.go:124)."""
+    return new_block(Header(number=0, time=time, extra=extra,
+                            parent_hash=ZERO_HASH, trust_rand=0))
+
+
+class BlockChain:
+    """Ordered canonical chain with an insert funnel.
+
+    All block sources (proposer's own sealed block, confirmed pending
+    blocks, synthesized empty blocks, sync backfill) converge here, the
+    way every Geec path converges on fetcher.Enqueue -> insertChain in
+    the reference (SURVEY §3.3, eth/fetcher/fetcher.go:647-684).  Blocks
+    arriving out of order are buffered and inserted once their parent
+    lands, preserving the reference's "blocks come in order" invariant
+    (core/geec_state.go:962).
+    """
+
+    def __init__(self, store=None, genesis: Block | None = None,
+                 verifier=None, listeners=()):
+        self.store = store if store is not None else MemoryStore()
+        self.verifier = verifier
+        self._listeners = list(listeners)
+        self._lock = threading.RLock()
+        self._future: dict[int, Block] = {}
+        self.bad_blocks = 0
+        self.last_error: str | None = None
+
+        head_hash = self.store.get_head()
+        if head_hash is None:
+            self.genesis = genesis if genesis is not None else make_genesis()
+            self.store.put_block(self.genesis)
+            self.store.set_head(self.genesis.hash)
+            self._head = self.genesis
+        else:
+            self._head = self.store.get_block(head_hash)
+            g = self.store.get_block(self.store.get_hash_by_number(0))
+            self.genesis = g if g is not None else genesis
+
+    # -- reads ------------------------------------------------------------
+
+    def head(self) -> Block:
+        return self._head
+
+    def height(self) -> int:
+        return self._head.number
+
+    def get_block_by_number(self, n: int) -> Block | None:
+        h = self.store.get_hash_by_number(n)
+        return self.store.get_block(h) if h is not None else None
+
+    def get_block(self, h: bytes) -> Block | None:
+        return self.store.get_block(h)
+
+    def has_block(self, h: bytes) -> bool:
+        return self.store.get_block(h) is not None
+
+    # -- listeners --------------------------------------------------------
+
+    def add_listener(self, fn) -> None:
+        """``fn(block)`` fires after each canonical insert — the
+        NotifyNewBlock hook (ref: core/blockchain.go:526-527)."""
+        self._listeners.append(fn)
+
+    # -- verification -----------------------------------------------------
+
+    def _verify_header(self, header: Header) -> None:
+        """Geec header verification is intentionally minimal: ancestry
+        only (ref: consensus/geec/geec.go:186-210 verifyHeader)."""
+        if header.number != self._head.number + 1:
+            raise ChainError(
+                f"non-sequential insert: {header.number} onto {self._head.number}")
+        if header.parent_hash != self._head.hash:
+            raise ChainError("unknown ancestor")
+
+    def _verify_body(self, block: Block) -> None:
+        """Uncle/tx-root checks (ref: core/block_validator.go:51-76;
+        Geec/fake txns are outside TxHash by design) + batched sender
+        recovery of the rooted txns — the TPU hot path (SURVEY §3.5)."""
+        if block.uncles:
+            raise ChainError("uncles not allowed")  # geec.go:215-219
+        from eges_tpu.core.trie import derive_sha, EMPTY_ROOT
+        want = (derive_sha([t.encode() for t in block.transactions])
+                if block.transactions else EMPTY_ROOT)
+        if block.header.tx_hash != want:
+            raise ChainError("transaction root mismatch")
+        from eges_tpu.crypto.verifier import batch_verify_txns
+        if not batch_verify_txns(block.transactions, self.verifier):
+            raise ChainError("invalid transaction signature")
+
+    # -- insert funnel ----------------------------------------------------
+
+    def offer(self, block: Block) -> list[Block]:
+        """Submit a block from any source; inserts it (and any buffered
+        successors) when in order.  Returns the blocks inserted.
+
+        Never raises on a bad block: like the fetcher funnel it came from
+        (eth/fetcher/fetcher.go:647-684 drops blocks that fail import), a
+        block that fails verification is dropped and counted — an invalid
+        or conflicting gossip block must not take down the caller's event
+        loop.
+        """
+        with self._lock:
+            inserted = []
+            if block.number <= self._head.number:
+                return inserted  # duplicate/old — fetcher-style dedup
+            self._future[block.number] = block
+            while (nxt := self._future.get(self._head.number + 1)) is not None:
+                del self._future[self._head.number + 1]
+                try:
+                    self._insert(nxt)
+                except ChainError as e:
+                    self.bad_blocks += 1
+                    self.last_error = str(e)
+                    break
+                inserted.append(nxt)
+            # cap the out-of-order buffer (a peer can't balloon memory)
+            if len(self._future) > 256:
+                for n in sorted(self._future)[:-256]:
+                    del self._future[n]
+            return inserted
+
+    def replace_suffix(self, blocks: list[Block]) -> bool:
+        """Reorg: replace our chain suffix with a confirmed alternative.
+
+        Geec forks arise one way only: a partitioned node forced local
+        empty blocks (confidence 0, HandleBlockTimeout semantics) while
+        the quorum confirmed real ones.  The quorum chain wins — but ONLY
+        ever displacing locally-forced empty blocks; confirmed non-empty
+        history is immutable.  (The reference leans on geth's
+        total-difficulty reorg in core/blockchain.go:927+; Geec confidence
+        replaces difficulty here.)
+
+        ``blocks``: contiguous ascending, parented into our chain.
+        Returns True if the reorg was applied.
+        """
+        with self._lock:
+            if not blocks:
+                return False
+            first = blocks[0]
+            if first.number > self._head.number:
+                return False  # nothing to displace; use offer()
+            anchor = self.get_block_by_number(first.number - 1)
+            if anchor is None or first.header.parent_hash != anchor.hash:
+                return False
+            # every displaced block must be a local empty (EmptyAddr
+            # coinbase) with no quorum confidence
+            for n in range(first.number, self._head.number + 1):
+                displaced = self.get_block_by_number(n)
+                conf = displaced.confirm.confidence if displaced.confirm else 0
+                if displaced.header.coinbase != EMPTY_ADDR or conf > 0:
+                    return False
+            # replacements must be confirmed and well-linked
+            prev = anchor
+            for b in blocks:
+                if (b.number != prev.number + 1
+                        or b.header.parent_hash != prev.hash
+                        or b.confirm is None):
+                    return False
+                prev = b
+            # rewind + replay
+            self._head = anchor
+            for b in blocks:
+                try:
+                    self._insert(b)
+                except ChainError as e:
+                    self.bad_blocks += 1
+                    self.last_error = str(e)
+                    return False
+            self._future.clear()
+            return True
+
+    def _insert(self, block: Block) -> None:
+        self._verify_header(block.header)
+        self._verify_body(block)
+        self.store.put_block(block)
+        self.store.set_head(block.hash)
+        self._head = block
+        for fn in self._listeners:
+            fn(block)
+
+    def make_empty_block(self) -> Block:
+        """Empty block atop the current head, keeping numbers dense
+        (ref: core/geec_state.go:885-920 GenerateEmptyBlock —
+        coinbase=EmptyAddr marks it; state root carried forward)."""
+        parent = self._head
+        return new_block(Header(
+            parent_hash=parent.hash,
+            number=parent.number + 1,
+            time=parent.header.time + 1,
+            coinbase=EMPTY_ADDR,
+            root=parent.header.root,
+            difficulty=1,
+        ))

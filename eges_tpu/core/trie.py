@@ -1,0 +1,107 @@
+"""Merkle-Patricia trie: root hashing and key/value proofs-of-inclusion.
+
+Fills the role of the reference's ``trie/`` package for the paths the
+consensus capability set needs: ``DeriveSha`` over transactions/receipts
+(ref: core/types/derive_sha.go) and a generic secure-keyed KV trie for
+state roots (ref: trie/trie.go, trie/secure_trie.go).  This is a batch
+builder — it materialises the node structure for a key set and folds it
+into the keccak root — rather than a journaled incremental trie; the
+chain layer rebuilds roots per block, which at Geec's 1000-txn operating
+point is microseconds of host work and keeps the structure immutable
+(functional style, no in-place node mutation).
+"""
+
+from __future__ import annotations
+
+from eges_tpu.core import rlp
+from eges_tpu.crypto.keccak import keccak256
+
+EMPTY_ROOT = bytes.fromhex(
+    "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421"
+)  # keccak256(rlp(b''))
+
+
+def _nibbles(key: bytes) -> list[int]:
+    out = []
+    for b in key:
+        out.append(b >> 4)
+        out.append(b & 0xF)
+    return out
+
+
+def _hp_encode(nibbles: list[int], terminal: bool) -> bytes:
+    """Hex-prefix encoding (ref: trie/encoding.go hexToCompact)."""
+    flag = 2 if terminal else 0
+    if len(nibbles) % 2:
+        head = [flag + 1] + nibbles
+    else:
+        head = [flag, 0] + nibbles
+    return bytes(
+        (head[i] << 4) | head[i + 1] for i in range(0, len(head), 2)
+    )
+
+
+def _node_ref(encoded: bytes):
+    """Nodes < 32 bytes embed in the parent; otherwise refer by hash."""
+    if len(encoded) < 32:
+        return rlp.decode(encoded)
+    return keccak256(encoded)
+
+
+def _build(items: list[tuple[list[int], bytes]], depth: int):
+    """Build the node for items sharing a prefix of length ``depth``.
+
+    Returns the RLP *structure* of the node (to be encoded / hashed by
+    the caller).  ``items`` must be sorted and have distinct keys.
+    """
+    if not items:
+        return b""
+    if len(items) == 1:
+        nib, val = items[0]
+        return [_hp_encode(nib[depth:], True), val]
+
+    # longest common prefix below depth
+    first = items[0][0]
+    lcp = len(first)
+    for nib, _ in items[1:]:
+        i = depth
+        limit = min(len(first), len(nib))
+        while i < limit and nib[i] == first[i]:
+            i += 1
+        lcp = min(lcp, i)
+    if lcp > depth:
+        child = _build(items, lcp)
+        return [_hp_encode(first[depth:lcp], False), _node_ref(rlp.encode(child))]
+
+    # branch node
+    children = [b""] * 16
+    value = b""
+    buckets: dict[int, list] = {}
+    for nib, val in items:
+        if len(nib) == depth:
+            value = val
+        else:
+            buckets.setdefault(nib[depth], []).append((nib, val))
+    for idx, bucket in buckets.items():
+        child = _build(bucket, depth + 1)
+        children[idx] = _node_ref(rlp.encode(child))
+    return children + [value]
+
+
+def trie_root(pairs: dict[bytes, bytes]) -> bytes:
+    """Root hash of the MPT holding ``pairs`` (raw keys)."""
+    if not pairs:
+        return EMPTY_ROOT
+    items = sorted((_nibbles(k), v) for k, v in pairs.items())
+    node = _build(items, 0)
+    return keccak256(rlp.encode(node))
+
+
+def secure_trie_root(pairs: dict[bytes, bytes]) -> bytes:
+    """Root with keccak-hashed keys (ref: trie/secure_trie.go)."""
+    return trie_root({keccak256(k): v for k, v in pairs.items()})
+
+
+def derive_sha(encoded_items: list[bytes]) -> bytes:
+    """Tx/receipt root: trie keyed by rlp(index) (ref: core/types/derive_sha.go:30)."""
+    return trie_root({rlp.encode(i): item for i, item in enumerate(encoded_items)})

@@ -1,0 +1,221 @@
+"""The batched TPU signature verifier — the framework's flagship "model".
+
+This is the TPU-native replacement for the reference's per-transaction
+cgo hot path (SURVEY §3.5): ``types.Sender -> recoverPlain ->
+crypto.Ecrecover -> secp256k1_ecdsa_recover + Keccak256(pub)[12:]``
+(ref: core/types/transaction_signing.go:222-241,
+crypto/secp256k1/secp256.go:105, crypto/signature_cgo.go:31-34).  Where
+the reference serializes one Go<->C call per signature per node, here a
+whole block's worth of signatures (txn senders + validator ACK votes +
+committee election votes) forms one ``[N, ...]`` batch that runs as a
+single fused XLA computation — ecrecover, curve checks and the
+Keccak-256 address derivation never leave the device.
+
+Layers:
+
+* :func:`ecrecover_batch` — pure jittable graph, bytes in / bytes out.
+* :func:`make_sharded_ecrecover` — the multi-chip path: `shard_map` over a
+  ``Mesh`` axis, rows scattered across devices (the "data parallelism" of
+  this domain, SURVEY §2.3), with an optional `psum` tally so the
+  ACK-counting reduction also stays on-device.
+* :class:`BatchVerifier` — host facade: pads to bucketed static shapes
+  (powers of two, so jit caches a handful of graphs), runs, unpads.
+  This is what the tx pool / block validator / consensus engine call.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from eges_tpu.ops import bigint, ec, keccak_tpu
+
+
+def _unpack(sigs: jnp.ndarray, hashes: jnp.ndarray):
+    """``sigs [..., 65]`` u8 (r||s||v), ``hashes [..., 32]`` u8 -> limb fields."""
+    r = bigint.bytes_be_to_limbs(sigs[..., 0:32])
+    s = bigint.bytes_be_to_limbs(sigs[..., 32:64])
+    v = sigs[..., 64].astype(jnp.uint32)
+    z = bigint.bytes_be_to_limbs(hashes)
+    return z, r, s, v
+
+
+def ecrecover_batch(sigs: jnp.ndarray, hashes: jnp.ndarray):
+    """Batched sender recovery.
+
+    Args: ``sigs [N, 65]`` uint8 Ethereum wire signatures, ``hashes
+    [N, 32]`` uint8 message hashes.  Returns ``(addrs [N, 20] uint8,
+    pubs [N, 64] uint8, ok [N] uint32)``; invalid rows are zeroed with
+    ``ok == 0`` (the reference raises per-call instead,
+    secp256.go:105-124 — a mask is the batch-native contract).
+    """
+    z, r, s, v = _unpack(sigs, hashes)
+    qx, qy, ok = ec.ecrecover_point(z, r, s, v)
+    qx_b = bigint.limbs_to_bytes_be(qx)
+    qy_b = bigint.limbs_to_bytes_be(qy)
+    addrs = keccak_tpu.pubkey_to_address(qx_b, qy_b)
+    mask = ok[..., None].astype(jnp.uint8)
+    pubs = jnp.concatenate([qx_b, qy_b], axis=-1) * mask
+    return addrs * mask, pubs, ok
+
+
+def verify_batch(sigs: jnp.ndarray, hashes: jnp.ndarray, pubs: jnp.ndarray):
+    """Batched classic ECDSA verify against known 64-byte pubkeys
+    (ref: secp256.go:126 VerifySignature).  Returns ``ok [N]`` uint32."""
+    z, r, s, _ = _unpack(
+        jnp.concatenate([sigs, jnp.zeros((*sigs.shape[:-1], 1), jnp.uint8)], axis=-1)
+        if sigs.shape[-1] == 64 else sigs,
+        hashes,
+    )
+    qx = bigint.bytes_be_to_limbs(pubs[..., 0:32])
+    qy = bigint.bytes_be_to_limbs(pubs[..., 32:64])
+    return ec.ecdsa_verify_point(z, r, s, qx, qy)
+
+
+def make_sharded_ecrecover(mesh: jax.sharding.Mesh, axis: str = "dp"):
+    """Build the multi-chip ecrecover: rows sharded over ``mesh[axis]``.
+
+    Uses `shard_map` so each device runs the identical fused kernel on its
+    row shard; XLA inserts no collectives for the map itself (pure data
+    parallel over ICI-connected chips).  The returned function also emits
+    the on-device vote tally (``psum`` of the validity mask over the mesh
+    axis) — the all-reduce analogue of the proposer's ACK count
+    (ref: core/geec_state.go:1184-1227 handleVerifyReplies), so counting
+    valid signatures costs one scalar collective instead of a host gather.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as PS
+
+    def shard_fn(sigs, hashes):
+        addrs, pubs, ok = ecrecover_batch(sigs, hashes)
+        tally = jax.lax.psum(jnp.sum(ok), axis)
+        return addrs, pubs, ok, tally
+
+    return jax.jit(
+        shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(PS(axis), PS(axis)),
+            out_specs=(PS(axis), PS(axis), PS(axis), PS()),
+        )
+    )
+
+
+def _bucket(n: int, minimum: int = 16) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class BatchVerifier:
+    """Host facade over the jitted verifier graphs.
+
+    Pads each request up to a power-of-two bucket so only O(log N)
+    distinct graphs ever compile, optionally shards rows over a device
+    mesh, and returns plain numpy to the (host-side) consensus layers.
+    """
+
+    def __init__(self, mesh: jax.sharding.Mesh | None = None, axis: str = "dp",
+                 min_bucket: int = 16):
+        self._mesh = mesh
+        self._axis = axis
+        self._min_bucket = min_bucket
+        if mesh is not None:
+            self._sharded = make_sharded_ecrecover(mesh, axis)
+            self._ndev = mesh.shape[axis]
+        else:
+            self._sharded = None
+            self._ndev = 1
+        self._recover = jax.jit(ecrecover_batch)
+        self._verify = jax.jit(verify_batch)
+
+    def _pad(self, n: int) -> int:
+        b = _bucket(max(n, 1), self._min_bucket)
+        # round up to a device multiple so shards stay even (works for any
+        # device count, not just powers of two)
+        return -(-b // self._ndev) * self._ndev
+
+    def ecrecover(self, sigs: np.ndarray, hashes: np.ndarray):
+        """``sigs [N,65]`` u8, ``hashes [N,32]`` u8 ->
+        ``(addrs [N,20] u8, pubs [N,64] u8, ok [N] bool)``."""
+        n = sigs.shape[0]
+        if n == 0:
+            return (np.zeros((0, 20), np.uint8), np.zeros((0, 64), np.uint8),
+                    np.zeros((0,), bool))
+        b = self._pad(n)
+        ps = np.zeros((b, 65), np.uint8)
+        ph = np.zeros((b, 32), np.uint8)
+        ps[:n] = sigs
+        ph[:n] = hashes
+        if self._sharded is not None:
+            addrs, pubs, ok, _ = self._sharded(jnp.asarray(ps), jnp.asarray(ph))
+        else:
+            addrs, pubs, ok = self._recover(jnp.asarray(ps), jnp.asarray(ph))
+        return (np.asarray(addrs)[:n], np.asarray(pubs)[:n],
+                np.asarray(ok)[:n].astype(bool))
+
+    def recover_addresses(self, sigs: np.ndarray, hashes: np.ndarray):
+        addrs, _, ok = self.ecrecover(sigs, hashes)
+        return addrs, ok
+
+    def verify(self, sigs: np.ndarray, hashes: np.ndarray, pubs: np.ndarray):
+        """Classic verify; returns ``ok [N]`` bool."""
+        n = sigs.shape[0]
+        if n == 0:
+            return np.zeros((0,), bool)
+        b = self._pad(n)
+        ps = np.zeros((b, 65), np.uint8)
+        ph = np.zeros((b, 32), np.uint8)
+        pq = np.zeros((b, 64), np.uint8)
+        ps[:n] = sigs[:, :65] if sigs.shape[1] >= 65 else np.pad(sigs, ((0, 0), (0, 65 - sigs.shape[1])))
+        ph[:n] = hashes
+        pq[:n] = pubs
+        ok = self._verify(jnp.asarray(ps), jnp.asarray(ph), jnp.asarray(pq))
+        return np.asarray(ok)[:n].astype(bool)
+
+
+def batch_verify_txns(txns, verifier) -> bool:
+    """Verify the signed (non-Geec) transactions of a block as one device
+    batch; the single shared implementation behind both the acceptor ACK
+    check and the insert-path body validation (SURVEY §3.5's two verify
+    sites, core/tx_pool.go:571 and core/state_processor.go:93).
+
+    Returns False if any signed txn is malformed or fails recovery.
+    ``verifier=None`` falls back to per-txn host recovery (the
+    signature_nocgo.go role).
+    """
+    signed = [t for t in txns if not t.is_geec and (t.r or t.s or t.v)]
+    if not signed:
+        return True
+    parts = [t.signature_parts() for t in signed]
+    if any(p is None for p in parts):
+        return False
+    if verifier is None:
+        try:
+            for t in signed:
+                t.sender()
+        except ValueError:
+            return False
+        return True
+    sigs = np.zeros((len(parts), 65), np.uint8)
+    hashes = np.zeros((len(parts), 32), np.uint8)
+    for i, (sig, h) in enumerate(parts):
+        sigs[i] = np.frombuffer(sig, np.uint8)
+        hashes[i] = np.frombuffer(h, np.uint8)
+    _, ok = verifier.recover_addresses(sigs, hashes)
+    return bool(ok.all())
+
+
+@functools.lru_cache(maxsize=1)
+def default_verifier() -> BatchVerifier:
+    """Process-wide verifier on the default device set: a 1-axis mesh over
+    all local devices if there are several, else single-device."""
+    devs = jax.devices()
+    if len(devs) > 1:
+        mesh = jax.sharding.Mesh(np.array(devs), ("dp",))
+        return BatchVerifier(mesh=mesh)
+    return BatchVerifier()

@@ -1,0 +1,307 @@
+"""secp256k1 group law on TPU: batched Jacobian point arithmetic.
+
+TPU-native replacement for the compute core of the reference's C
+libsecp256k1 (ref: crypto/secp256k1/secp256.go:20-37 wraps it via cgo; the
+group law lives in its src/group_impl.h role).  Instead of one point at a
+time in 64-bit limbs, every function here is batched: a point is a triple
+of ``[..., 16]`` uint32 limb arrays (Jacobian X, Y, Z over
+:class:`eges_tpu.ops.bigint.FieldP`), rows ride the VPU lanes, and the
+whole ECDSA-recover pipeline becomes one fused XLA computation per batch.
+
+Design notes (TPU-first, not a translation):
+
+* **Branchless exceptional cases.**  libsecp256k1 branches on
+  infinity/equal/opposite inputs; XLA cannot.  Each add computes the
+  generic path, the doubling path and the trivial selections, then picks
+  per row with masks.  Cost is ~2x field muls per add, won back many times
+  over by batching.
+* **Infinity encoding** is ``Z == 0`` (Y forced to 1 so formulas stay
+  non-degenerate).
+* **Scalar mul** is interleaved Strauss double-and-add over the two
+  scalars of ECDSA recovery (``u1*G + u2*R``), one `lax.fori_loop` with a
+  static 256-iteration bound so the compiled graph stays one loop body.
+* No data-dependent shapes anywhere: invalid rows flow through with a
+  validity mask instead of raising, matching the batch-verifier contract
+  (the reference raises per call, secp256.go:105-124).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from eges_tpu.ops import bigint
+from eges_tpu.ops.bigint import FP, FN, NLIMBS, int_to_limbs, select, eq, is_zero
+
+# Generator (affine), as trace-time limb constants.
+GX_INT = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+GY_INT = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+SEVEN = 7
+
+
+def _const(x: int, like: jnp.ndarray) -> jnp.ndarray:
+    return jnp.broadcast_to(jnp.asarray(int_to_limbs(x)), like.shape)
+
+
+# A Jacobian point batch is the tuple (X, Y, Z), each [..., 16] uint32.
+
+
+def infinity(like: jnp.ndarray):
+    """Batch of points at infinity, batch shape taken from ``like``."""
+    z = jnp.zeros_like(like)
+    return _const(0, like), _const(1, like), z
+
+
+def is_infinity(pt) -> jnp.ndarray:
+    return is_zero(pt[2])
+
+
+def jac_double(pt):
+    """Point doubling, a=0 curve (dbl-2009-l).  Handles infinity and
+    2-torsion (y=0 cannot occur on secp256k1, but Y=0 rows yield Z3=0)."""
+    X1, Y1, Z1 = pt
+    A = FP.sqr(X1)
+    B = FP.sqr(Y1)
+    C = FP.sqr(B)
+    t = FP.sqr(FP.add(X1, B))
+    D = FP.mul_small(FP.sub(FP.sub(t, A), C), 2)
+    E = FP.mul_small(A, 3)
+    F = FP.sqr(E)
+    X3 = FP.sub(F, FP.mul_small(D, 2))
+    Y3 = FP.sub(FP.mul(E, FP.sub(D, X3)), FP.mul_small(C, 8))
+    Z3 = FP.mul_small(FP.mul(Y1, Z1), 2)
+    return X3, Y3, Z3
+
+
+def jac_add_mixed(pt, x2: jnp.ndarray, y2: jnp.ndarray):
+    """Mixed addition ``pt + (x2, y2)`` with (x2, y2) affine (Z2 = 1).
+
+    Branchless over the exceptional cases:
+      * pt at infinity          -> (x2, y2, 1)
+      * same point (H=0, r=0)   -> doubling path
+      * opposite (H=0, r!=0)    -> infinity
+    (madd-2007-bl for the generic path.)
+    """
+    X1, Y1, Z1 = pt
+    Z1Z1 = FP.sqr(Z1)
+    U2 = FP.mul(x2, Z1Z1)
+    S2 = FP.mul(FP.mul(y2, Z1), Z1Z1)
+    H = FP.sub(U2, X1)
+    r = FP.sub(S2, Y1)
+
+    # generic path
+    HH = FP.sqr(H)
+    I = FP.mul_small(HH, 4)
+    J = FP.mul(H, I)
+    rr = FP.mul_small(r, 2)
+    V = FP.mul(X1, I)
+    X3 = FP.sub(FP.sub(FP.sqr(rr), J), FP.mul_small(V, 2))
+    Y3 = FP.sub(FP.mul(rr, FP.sub(V, X3)), FP.mul_small(FP.mul(Y1, J), 2))
+    Z3 = FP.mul(FP.mul_small(Z1, 2), H)
+
+    # doubling path (pt == (x2,y2) as group elements)
+    DX, DY, DZ = jac_double(pt)
+
+    h0 = is_zero(H)
+    r0 = is_zero(r)
+    p1_inf = is_zero(Z1)
+    dbl = h0 * r0
+    opp = h0 * (1 - r0)
+
+    one = _const(1, Z1)
+    X = select(dbl, DX, X3)
+    Y = select(dbl, DY, Y3)
+    Z = select(dbl, DZ, Z3)
+    Z = select(opp, jnp.zeros_like(Z), Z)
+    Y = select(opp, one, Y)
+    X = select(p1_inf, x2, X)
+    Y = select(p1_inf, y2, Y)
+    Z = select(p1_inf, one, Z)
+    return X, Y, Z
+
+
+def jac_add(p, q):
+    """Full Jacobian addition ``p + q``, branchless exceptional cases
+    (add-2007-bl for the generic path)."""
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    Z1Z1 = FP.sqr(Z1)
+    Z2Z2 = FP.sqr(Z2)
+    U1 = FP.mul(X1, Z2Z2)
+    U2 = FP.mul(X2, Z1Z1)
+    S1 = FP.mul(FP.mul(Y1, Z2), Z2Z2)
+    S2 = FP.mul(FP.mul(Y2, Z1), Z1Z1)
+    H = FP.sub(U2, U1)
+    r = FP.sub(S2, S1)
+
+    HH = FP.sqr(H)
+    I = FP.mul_small(HH, 4)
+    J = FP.mul(H, I)
+    rr = FP.mul_small(r, 2)
+    V = FP.mul(U1, I)
+    X3 = FP.sub(FP.sub(FP.sqr(rr), J), FP.mul_small(V, 2))
+    Y3 = FP.sub(FP.mul(rr, FP.sub(V, X3)), FP.mul_small(FP.mul(S1, J), 2))
+    Z3 = FP.mul(FP.mul(FP.mul_small(FP.mul(Z1, Z2), 2), H), _const(1, H))
+
+    DX, DY, DZ = jac_double(p)
+
+    h0 = is_zero(H)
+    r0 = is_zero(r)
+    p_inf = is_zero(Z1)
+    q_inf = is_zero(Z2)
+    both = p_inf * q_inf
+    dbl = h0 * r0 * (1 - p_inf) * (1 - q_inf)
+    opp = h0 * (1 - r0) * (1 - p_inf) * (1 - q_inf)
+
+    one = _const(1, Z1)
+    X = select(dbl, DX, X3)
+    Y = select(dbl, DY, Y3)
+    Z = select(dbl, DZ, Z3)
+    Z = select(opp, jnp.zeros_like(Z), Z)
+    Y = select(opp, one, Y)
+    # p infinite -> q; q infinite -> p; both -> infinity
+    X = select(p_inf, X2, X)
+    Y = select(p_inf, Y2, Y)
+    Z = select(p_inf, Z2, Z)
+    X = select(q_inf * (1 - p_inf), X1, X)
+    Y = select(q_inf * (1 - p_inf), Y1, Y)
+    Z = select(q_inf * (1 - p_inf), Z1, Z)
+    Z = select(both, jnp.zeros_like(Z), Z)
+    return X, Y, Z
+
+
+def to_affine(pt):
+    """Jacobian -> affine ``(x, y, ok)``; infinity rows get x=y=0, ok=0."""
+    X, Y, Z = pt
+    inf = is_zero(Z)
+    zi = FP.inv(Z)
+    zi2 = FP.sqr(zi)
+    x = FP.mul(X, zi2)
+    y = FP.mul(Y, FP.mul(zi, zi2))
+    zero = jnp.zeros_like(x)
+    return select(inf, zero, x), select(inf, zero, y), (1 - inf)
+
+
+def on_curve(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Per-row flag: ``y^2 == x^3 + 7`` in F_P."""
+    lhs = FP.sqr(y)
+    rhs = FP.add(FP.mul(FP.sqr(x), x), _const(SEVEN, x))
+    return eq(lhs, rhs)
+
+
+def _scalar_bits(k: jnp.ndarray) -> jnp.ndarray:
+    """``[..., 16]`` limbs -> ``[..., 256]`` bits, little-endian bit order."""
+    shifts = jnp.arange(bigint.LIMB_BITS, dtype=jnp.uint32)
+    bits = (k[..., :, None] >> shifts[None, :]) & 1  # [..., 16, 16]
+    return bits.reshape(*k.shape[:-1], 256)
+
+
+def strauss_gR(u1: jnp.ndarray, u2: jnp.ndarray, rx: jnp.ndarray, ry: jnp.ndarray):
+    """Shamir/Strauss interleaved ``u1*G + u2*R`` (R affine, per-row).
+
+    The double-scalar multiplication at the core of ECDSA recovery
+    (ref: libsecp256k1 ecmult's role, consumed by secp256.go:105
+    RecoverPubkey).  One fori_loop, MSB-first: double, then two masked
+    mixed adds.  Scalars are limb arrays mod N.
+    """
+    b1 = _scalar_bits(u1)
+    b2 = _scalar_bits(u2)
+    gx = _const(GX_INT, rx)
+    gy = _const(GY_INT, rx)
+    acc = infinity(rx)
+
+    def body(i, acc):
+        idx = 255 - i
+        acc = jac_double(acc)
+        bit1 = jax.lax.dynamic_index_in_dim(b1, idx, axis=-1, keepdims=False)
+        bit2 = jax.lax.dynamic_index_in_dim(b2, idx, axis=-1, keepdims=False)
+        added_g = jac_add_mixed(acc, gx, gy)
+        acc = tuple(select(bit1, n, o) for n, o in zip(added_g, acc))
+        added_r = jac_add_mixed(acc, rx, ry)
+        acc = tuple(select(bit2, n, o) for n, o in zip(added_r, acc))
+        return acc
+
+    return jax.lax.fori_loop(0, 256, body, acc)
+
+
+def scalar_mul(k: jnp.ndarray, px: jnp.ndarray, py: jnp.ndarray):
+    """Plain ``k * P`` for an affine per-row point (used by tests and the
+    batched classic-verify path)."""
+    bits = _scalar_bits(k)
+    acc = infinity(px)
+
+    def body(i, acc):
+        idx = 255 - i
+        acc = jac_double(acc)
+        bit = jax.lax.dynamic_index_in_dim(bits, idx, axis=-1, keepdims=False)
+        added = jac_add_mixed(acc, px, py)
+        return tuple(select(bit, n, o) for n, o in zip(added, acc))
+
+    return jax.lax.fori_loop(0, 256, body, acc)
+
+
+def ecrecover_point(z: jnp.ndarray, r: jnp.ndarray, s: jnp.ndarray,
+                    v: jnp.ndarray):
+    """Batched core of public-key recovery (ref: secp256.go:105).
+
+    Inputs: ``z`` message-hash, ``r``/``s`` signature scalars (all
+    ``[..., 16]`` limbs), ``v`` recovery id ``[...]`` uint32 in [0, 4).
+    Returns affine ``(qx, qy, ok)`` with ``ok`` a 0/1 validity mask —
+    invalid rows (r/s out of range, r not an x-coordinate, point at
+    infinity) are masked, never raised.
+    """
+    one = _const(1, r)
+    n_lim = jnp.broadcast_to(FN.m_limbs, r.shape)
+    p_lim = jnp.broadcast_to(FP.m_limbs, r.shape)
+
+    r_ok = (1 - is_zero(r)) * bigint.big_lt(r, n_lim)
+    s_ok = (1 - is_zero(s)) * bigint.big_lt(s, n_lim)
+    v_ok = (v < 4).astype(jnp.uint32)
+
+    # x = r + (v >= 2 ? N : 0), must be < P
+    hi = (v >= 2).astype(jnp.uint32)
+    x_wide = bigint.big_add(r, select(hi, n_lim, jnp.zeros_like(r)), NLIMBS + 1)
+    x_ok = is_zero(x_wide[..., NLIMBS:]) * bigint.big_lt(x_wide[..., :NLIMBS], p_lim)
+    x = x_wide[..., :NLIMBS]
+
+    # y from x^3 + 7, parity fixed to v&1
+    y_sq = FP.add(FP.mul(FP.sqr(x), x), _const(SEVEN, x))
+    y, y_ok = FP.sqrt(y_sq)
+    want_odd = (v & 1).astype(jnp.uint32)
+    y_odd = (y[..., 0] & 1).astype(jnp.uint32)
+    y = select(want_odd ^ y_odd, FP.neg(y), y)
+
+    # u1 = -z/r mod N, u2 = s/r mod N
+    r_inv = FN.inv(r)
+    z_mod = FN.red(jnp.pad(z, [(0, 0)] * (z.ndim - 1) + [(0, 1)]))
+    u1 = FN.neg(FN.mul(z_mod, r_inv))
+    u2 = FN.mul(s, r_inv)
+
+    q = strauss_gR(u1, u2, x, y)
+    qx, qy, not_inf = to_affine(q)
+    ok = r_ok * s_ok * v_ok * x_ok * y_ok * not_inf
+    zero = jnp.zeros_like(qx)
+    return select(ok, qx, zero), select(ok, qy, zero), ok
+
+
+def ecdsa_verify_point(z: jnp.ndarray, r: jnp.ndarray, s: jnp.ndarray,
+                       qx: jnp.ndarray, qy: jnp.ndarray) -> jnp.ndarray:
+    """Batched classic ECDSA verify against known pubkeys
+    (ref: secp256.go:126 VerifySignature; rejects high-s malleable sigs
+    the same way libsecp256k1's normalized verify does)."""
+    n_lim = jnp.broadcast_to(FN.m_limbs, r.shape)
+    half_n = _const((FN.m - 1) // 2 + 1, r)  # s < ceil(N/2)+? use s <= N//2
+    r_ok = (1 - is_zero(r)) * bigint.big_lt(r, n_lim)
+    s_ok = (1 - is_zero(s)) * bigint.big_lt(s, half_n)
+    q_ok = on_curve(qx, qy)
+
+    s_inv = FN.inv(s)
+    z_mod = FN.red(jnp.pad(z, [(0, 0)] * (z.ndim - 1) + [(0, 1)]))
+    u1 = FN.mul(z_mod, s_inv)
+    u2 = FN.mul(r, s_inv)
+    pt = strauss_gR(u1, u2, qx, qy)
+    px, _, not_inf = to_affine(pt)
+    # compare px mod N with r
+    px_mod = FN.red(jnp.pad(px, [(0, 0)] * (px.ndim - 1) + [(0, 1)]))
+    return r_ok * s_ok * q_ok * not_inf * eq(px_mod, r)

@@ -1,0 +1,152 @@
+"""Batched Keccak-256 on TPU.
+
+The reference computes Keccak-256 with amd64 assembly on the host
+(ref: crypto/sha3/keccakf_amd64.s, fronted by crypto/crypto.go:43
+Keccak256).  On TPU there is no 64-bit integer datapath, so each 64-bit
+lane of the 5x5 Keccak state is a **pair of uint32 words** ``(lo, hi)``;
+all of theta/rho/pi/chi/iota decompose into 32-bit XOR/AND/NOT/shifts,
+which the VPU executes lane-parallel over the batch dimension.
+
+Rotation amounts and round constants are trace-time Python constants, so
+the 24 rounds unroll into straight-line vector code — no data-dependent
+control flow, fixed shapes, arbitrary leading batch dims.
+
+Primary in-graph consumer: pubkey -> address (``keccak256(x || y)[12:]``)
+at the tail of batched ecrecover (ref: crypto/signature_cgo.go:31 +
+crypto/crypto.go:194), which keeps the whole sender-recovery hot path
+(SURVEY §3.5) on-device.  Fixed input length per call site; multi-block
+absorption is unrolled at trace time for lengths >= the 136-byte rate.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+RATE = 136  # bytes, Keccak-256 (capacity 512)
+
+_RC = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+
+# rho rotation offsets, indexed [x][y] (column-major state layout A[x,y])
+_ROT = [
+    [0, 36, 3, 41, 18],
+    [1, 44, 10, 45, 2],
+    [62, 6, 43, 15, 61],
+    [28, 55, 25, 21, 56],
+    [27, 20, 39, 8, 14],
+]
+
+_M32 = jnp.uint32(0xFFFFFFFF)
+
+
+def _rotl64(lo, hi, r: int):
+    """Rotate a (lo, hi) uint32 pair left by a constant r in [0, 64)."""
+    r %= 64
+    if r == 0:
+        return lo, hi
+    if r == 32:
+        return hi, lo
+    if r > 32:
+        lo, hi = hi, lo
+        r -= 32
+    nl = ((lo << r) | (hi >> (32 - r))) & _M32
+    nh = ((hi << r) | (lo >> (32 - r))) & _M32
+    return nl, nh
+
+
+def _keccak_f(lanes_lo, lanes_hi):
+    """Keccak-f[1600] permutation on lists of 25 lane pairs.
+
+    ``lanes_lo/hi[x + 5*y]`` are batched uint32 arrays.
+    """
+    A_lo = list(lanes_lo)
+    A_hi = list(lanes_hi)
+    for rnd in range(24):
+        # theta
+        C_lo = [A_lo[x] ^ A_lo[x + 5] ^ A_lo[x + 10] ^ A_lo[x + 15] ^ A_lo[x + 20]
+                for x in range(5)]
+        C_hi = [A_hi[x] ^ A_hi[x + 5] ^ A_hi[x + 10] ^ A_hi[x + 15] ^ A_hi[x + 20]
+                for x in range(5)]
+        for x in range(5):
+            rl, rh = _rotl64(C_lo[(x + 1) % 5], C_hi[(x + 1) % 5], 1)
+            d_lo = C_lo[(x + 4) % 5] ^ rl
+            d_hi = C_hi[(x + 4) % 5] ^ rh
+            for y in range(5):
+                A_lo[x + 5 * y] = A_lo[x + 5 * y] ^ d_lo
+                A_hi[x + 5 * y] = A_hi[x + 5 * y] ^ d_hi
+        # rho + pi
+        B_lo = [None] * 25
+        B_hi = [None] * 25
+        for x in range(5):
+            for y in range(5):
+                nl, nh = _rotl64(A_lo[x + 5 * y], A_hi[x + 5 * y], _ROT[x][y])
+                B_lo[y + 5 * ((2 * x + 3 * y) % 5)] = nl
+                B_hi[y + 5 * ((2 * x + 3 * y) % 5)] = nh
+        # chi
+        for y in range(5):
+            row_lo = [B_lo[x + 5 * y] for x in range(5)]
+            row_hi = [B_hi[x + 5 * y] for x in range(5)]
+            for x in range(5):
+                A_lo[x + 5 * y] = row_lo[x] ^ (~row_lo[(x + 1) % 5] & row_lo[(x + 2) % 5])
+                A_hi[x + 5 * y] = row_hi[x] ^ (~row_hi[(x + 1) % 5] & row_hi[(x + 2) % 5])
+        # iota
+        rc = _RC[rnd]
+        A_lo[0] = A_lo[0] ^ jnp.uint32(rc & 0xFFFFFFFF)
+        A_hi[0] = A_hi[0] ^ jnp.uint32(rc >> 32)
+    return A_lo, A_hi
+
+
+def keccak256_fixed(data: jnp.ndarray) -> jnp.ndarray:
+    """Batched Keccak-256 of fixed-length messages.
+
+    ``data``: ``[..., L]`` uint8 with a static trailing length L.  Returns
+    ``[..., 32]`` uint8 digests.  Matches the legacy (pre-NIST) Keccak
+    padding the reference uses (crypto/sha3: domain byte 0x01).
+    """
+    L = data.shape[-1]
+    batch = data.shape[:-1]
+    nblocks = L // RATE + 1  # last block holds padding, always present
+
+    padded_len = nblocks * RATE
+    pad = jnp.zeros((*batch, padded_len - L), jnp.uint8)
+    buf = jnp.concatenate([data, pad], axis=-1)
+    buf = buf.at[..., L].set(jnp.uint8(0x01))
+    buf = buf.at[..., padded_len - 1].set(buf[..., padded_len - 1] | jnp.uint8(0x80))
+
+    zeros = jnp.zeros(batch, jnp.uint32)
+    A_lo = [zeros] * 25
+    A_hi = [zeros] * 25
+    b32 = buf.astype(jnp.uint32)
+    for blk in range(nblocks):
+        off = blk * RATE
+        for lane in range(RATE // 8):
+            base = off + 8 * lane
+            lo = (b32[..., base] | (b32[..., base + 1] << 8)
+                  | (b32[..., base + 2] << 16) | (b32[..., base + 3] << 24))
+            hi = (b32[..., base + 4] | (b32[..., base + 5] << 8)
+                  | (b32[..., base + 6] << 16) | (b32[..., base + 7] << 24))
+            A_lo[lane] = A_lo[lane] ^ lo
+            A_hi[lane] = A_hi[lane] ^ hi
+        A_lo, A_hi = _keccak_f(A_lo, A_hi)
+
+    out = []
+    for lane in range(4):  # 32 bytes = 4 lanes
+        for word in (A_lo[lane], A_hi[lane]):
+            for shift in (0, 8, 16, 24):
+                out.append(((word >> shift) & 0xFF).astype(jnp.uint8))
+    return jnp.stack(out, axis=-1)
+
+
+def pubkey_to_address(qx_bytes: jnp.ndarray, qy_bytes: jnp.ndarray) -> jnp.ndarray:
+    """Batched ``keccak256(x || y)[12:]`` — Ethereum address derivation
+    (ref: crypto/crypto.go:194 PubkeyToAddress)."""
+    pub = jnp.concatenate([qx_bytes, qy_bytes], axis=-1)
+    return keccak256_fixed(pub)[..., 12:]

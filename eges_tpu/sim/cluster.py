@@ -1,0 +1,87 @@
+"""Simulated Geec cluster builder.
+
+The in-process analogue of the reference's ``test.py`` local 3-node
+harness (ref: test.py:1-138 — bootnode + N geth processes on distinct
+ports) with deterministic keys, virtual time, and direct access to every
+node's state.  Used by the consensus test-suite and by liveness/soak
+checks (the ``test-sep-2.sh`` criterion: chain keeps advancing).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from eges_tpu.consensus.config import BootstrapNode, ChainGeecConfig, NodeConfig
+from eges_tpu.consensus.node import GeecNode
+from eges_tpu.core.chain import BlockChain, make_genesis
+from eges_tpu.crypto import secp256k1 as secp
+from eges_tpu.sim.simnet import SimClock, SimNet
+
+
+@dataclass
+class SimNode:
+    name: str
+    priv: bytes
+    addr: bytes
+    chain: BlockChain
+    node: GeecNode
+
+
+class SimCluster:
+    def __init__(self, n_nodes: int = 3, *, n_bootstrap: int | None = None,
+                 seed: int = 0, n_candidates: int = 3, n_acceptors: int = 4,
+                 txn_per_block: int = 10, txn_size: int = 100,
+                 block_timeout_s: float = 20.0, validate_timeout_ms: float = 500,
+                 backoff_time_ms: float = 0.0, reg_timeout_s: float = 10.0,
+                 drop_rate: float = 0.0, failure_test: bool = False,
+                 verifier=None, mine=None):
+        self.clock = SimClock()
+        self.net = SimNet(self.clock, seed=seed, drop_rate=drop_rate)
+        self.nodes: list[SimNode] = []
+
+        if n_bootstrap is None:
+            n_bootstrap = n_nodes
+        privs = [bytes([i + 1]) * 32 for i in range(n_nodes)]
+        addrs = [secp.pubkey_to_address(secp.privkey_to_pubkey(p))
+                 for p in privs]
+        boot = tuple(
+            BootstrapNode(account=addrs[i], ip="10.0.0.%d" % (i + 1),
+                          port=8100 + i)
+            for i in range(n_bootstrap))
+        ccfg = ChainGeecConfig(bootstrap=boot,
+                               validate_timeout_ms=validate_timeout_ms,
+                               backoff_time_ms=backoff_time_ms,
+                               reg_timeout_s=reg_timeout_s)
+        genesis = make_genesis()
+
+        for i in range(n_nodes):
+            name = f"node{i}"
+            ncfg = NodeConfig(
+                coinbase=addrs[i], consensus_ip="10.0.0.%d" % (i + 1),
+                consensus_port=8100 + i, n_candidates=n_candidates,
+                n_acceptors=n_acceptors, txn_per_block=txn_per_block,
+                txn_size=txn_size, block_timeout_s=block_timeout_s,
+                total_nodes=n_nodes, failure_test=failure_test)
+            chain = BlockChain(genesis=genesis, verifier=verifier)
+            node = GeecNode(chain, self.clock, None, ncfg, ccfg,
+                            mine=(mine[i] if mine is not None else True),
+                            verifier=verifier)
+            transport = self.net.join(name, ncfg.consensus_ip,
+                                      ncfg.consensus_port,
+                                      node.on_gossip, node.on_direct)
+            node.transport = transport
+            self.nodes.append(SimNode(name=name, priv=privs[i],
+                                      addr=addrs[i], chain=chain, node=node))
+
+    def start(self) -> None:
+        for sn in self.nodes:
+            sn.node.start()
+
+    def run(self, seconds: float, stop_condition=None) -> None:
+        self.clock.run_until(self.clock.now() + seconds, stop_condition)
+
+    def heights(self) -> list[int]:
+        return [sn.chain.height() for sn in self.nodes]
+
+    def min_height(self) -> int:
+        return min(self.heights())

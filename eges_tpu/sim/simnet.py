@@ -1,0 +1,140 @@
+"""Deterministic in-process multi-node simulator.
+
+The analogue of the reference's ``p2p/simulations`` framework (SURVEY §4:
+"in-memory net or exec'd nodes ... NOT used for Geec" — the fork only
+ever tested Geec with real clusters + log grepping).  This build makes
+the deterministic simulator the *primary* consensus test vehicle: virtual
+time, seeded latency/loss, full-mesh gossip and addressed direct
+datagrams, every run reproducible from its seed.
+
+* :class:`SimClock` — a heap of (due, seq, fn) callbacks; ``run_until``
+  executes them in timestamp order, advancing virtual time instantly.
+* :class:`SimNet` — in-memory transports: ``gossip`` fans out to every
+  other node's gossip inbox (the RLPx/TCP plane), ``send_direct``
+  delivers to the (ip, port) owner (the raw-UDP plane).  Configurable
+  per-message latency jitter and drop rate model the planes' real
+  characteristics (UDP loss is what the reference's retry ladders exist
+  for).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+
+
+class _Timer:
+    __slots__ = ("fn", "cancelled")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
+class SimClock:
+    def __init__(self, start: float = 0.0):
+        self._now = start
+        self._heap: list = []
+        self._seq = itertools.count()
+
+    def now(self) -> float:
+        return self._now
+
+    def call_later(self, delay_s: float, fn) -> _Timer:
+        t = _Timer(fn)
+        heapq.heappush(self._heap, (self._now + max(delay_s, 0.0),
+                                    next(self._seq), t))
+        return t
+
+    def run_until(self, deadline: float, stop_condition=None) -> None:
+        """Execute due callbacks in order until virtual ``deadline``."""
+        while self._heap and self._heap[0][0] <= deadline:
+            due, _, timer = heapq.heappop(self._heap)
+            self._now = due
+            if not timer.cancelled:
+                timer.fn()
+            if stop_condition is not None and stop_condition():
+                return
+        self._now = max(self._now, deadline)
+
+    def pending(self) -> int:
+        return sum(1 for _, _, t in self._heap if not t.cancelled)
+
+
+class SimTransport:
+    """Per-node transport handle bound to a :class:`SimNet`."""
+
+    def __init__(self, net: "SimNet", node_id: str):
+        self._net = net
+        self.node_id = node_id
+
+    def gossip(self, data: bytes) -> None:
+        self._net.deliver_gossip(self.node_id, data)
+
+    def send_direct(self, ip: str, port: int, data: bytes) -> None:
+        self._net.deliver_direct(self.node_id, (ip, port), data)
+
+
+class SimNet:
+    def __init__(self, clock: SimClock, *, seed: int = 0,
+                 latency_s: float = 0.002, jitter_s: float = 0.002,
+                 drop_rate: float = 0.0):
+        self.clock = clock
+        self.rng = random.Random(seed)
+        self.latency_s = latency_s
+        self.jitter_s = jitter_s
+        self.drop_rate = drop_rate
+        self._gossip_sinks: dict[str, object] = {}   # node_id -> fn(bytes)
+        self._direct_sinks: dict[tuple, object] = {}  # (ip, port) -> fn(bytes)
+        self._partitioned: set[str] = set()
+        self.stats = {"gossip": 0, "direct": 0, "dropped": 0}
+
+    def join(self, node_id: str, ip: str, port: int, on_gossip, on_direct):
+        transport = SimTransport(self, node_id)
+        self._gossip_sinks[node_id] = on_gossip
+        self._direct_sinks[(ip, port)] = (node_id, on_direct)
+        return transport
+
+    def partition(self, node_id: str) -> None:
+        """Cut a node off both planes (crash/partition injection)."""
+        self._partitioned.add(node_id)
+
+    def heal(self, node_id: str) -> None:
+        self._partitioned.discard(node_id)
+
+    def _delay(self) -> float:
+        return self.latency_s + self.rng.random() * self.jitter_s
+
+    def _dropped(self) -> bool:
+        return self.drop_rate > 0 and self.rng.random() < self.drop_rate
+
+    def deliver_gossip(self, sender_id: str, data: bytes) -> None:
+        if sender_id in self._partitioned:
+            return
+        for node_id, sink in self._gossip_sinks.items():
+            if node_id == sender_id or node_id in self._partitioned:
+                continue
+            if self._dropped():
+                self.stats["dropped"] += 1
+                continue
+            self.stats["gossip"] += 1
+            self.clock.call_later(self._delay(),
+                                  (lambda s, d: lambda: s(d))(sink, data))
+
+    def deliver_direct(self, sender_id: str, addr: tuple, data: bytes) -> None:
+        if sender_id in self._partitioned:
+            return
+        entry = self._direct_sinks.get(addr)
+        if entry is None:
+            return  # dead letter, like a UDP datagram to a closed port
+        node_id, sink = entry
+        if node_id in self._partitioned or self._dropped():
+            self.stats["dropped"] += 1
+            return
+        self.stats["direct"] += 1
+        self.clock.call_later(self._delay(),
+                              (lambda s, d: lambda: s(d))(sink, data))

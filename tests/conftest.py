@@ -7,10 +7,19 @@ compilation cache so the big secp256k1 graphs compile once per machine.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must override, not setdefault: the ambient environment points JAX at the
+# real TPU tunnel (and its sitecustomize hook calls
+# jax.config.update("jax_platforms", "axon,cpu") at interpreter startup,
+# overriding the env var), but the test suite needs the deterministic
+# 8-virtual-device CPU mesh (bench.py is what exercises the real chip).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (after env setup, before any backend use)
+
+jax.config.update("jax_platforms", "cpu")
 
 
 def pytest_configure(config):

@@ -1,0 +1,135 @@
+"""Tests for RLP, trie roots, and the chain data model."""
+
+import secrets
+
+import pytest
+
+from eges_tpu.core import rlp
+from eges_tpu.core.trie import derive_sha, trie_root, EMPTY_ROOT
+from eges_tpu.core.types import (
+    Block, ConfirmBlockMsg, Header, QueryBlockMsg, Registration, Transaction,
+    fake_txn, geec_txn, new_block, EMPTY_ADDR, REG_ADDR,
+)
+from eges_tpu.crypto import secp256k1 as host
+
+
+# --- RLP ---------------------------------------------------------------
+
+def test_rlp_known_vectors():
+    # canonical vectors from the RLP spec
+    assert rlp.encode(b"dog") == b"\x83dog"
+    assert rlp.encode([b"cat", b"dog"]) == b"\xc8\x83cat\x83dog"
+    assert rlp.encode(b"") == b"\x80"
+    assert rlp.encode(0) == b"\x80"
+    assert rlp.encode(15) == b"\x0f"
+    assert rlp.encode(1024) == b"\x82\x04\x00"
+    assert rlp.encode([]) == b"\xc0"
+    assert rlp.encode([[], [[]], [[], [[]]]]) == bytes.fromhex("c7c0c1c0c3c0c1c0")
+    lorem = b"Lorem ipsum dolor sit amet, consectetur adipisicing elit"
+    assert rlp.encode(lorem) == b"\xb8\x38" + lorem
+
+
+def test_rlp_roundtrip_nested():
+    item = [b"abc", [b"", b"\x01", [b"deep"]], b"\x7f", b"\x80" * 60]
+    assert rlp.decode(rlp.encode(item)) == item
+
+
+def test_rlp_strictness():
+    with pytest.raises(rlp.RLPError):
+        rlp.decode(b"\x81\x05")  # non-canonical single byte
+    with pytest.raises(rlp.RLPError):
+        rlp.decode(b"\x83do")  # truncated
+    with pytest.raises(rlp.RLPError):
+        rlp.decode(b"\x83dogX")  # trailing bytes
+
+
+# --- trie --------------------------------------------------------------
+
+def test_trie_empty_and_single():
+    assert trie_root({}) == EMPTY_ROOT
+    # known single-pair root (geth TestTrie "dog"->"puppy" style check:
+    # deterministic, verified by structure round-trip below)
+    r1 = trie_root({b"dog": b"puppy"})
+    r2 = trie_root({b"dog": b"puppy"})
+    assert r1 == r2 and r1 != EMPTY_ROOT
+
+
+def test_trie_known_geth_root():
+    # vector from go-ethereum trie tests (TestInsert):
+    pairs = {b"doe": b"reindeer", b"dog": b"puppy", b"dogglesworth": b"cat"}
+    exp = bytes.fromhex(
+        "8aad789dff2f538bca5d8ea56e8abe10f4c7ba3a5dea95fea4cd6e7c3a1168d3")
+    assert trie_root(pairs) == exp
+
+
+def test_derive_sha_order_sensitivity():
+    items = [secrets.token_bytes(40) for _ in range(5)]
+    assert derive_sha(items) != derive_sha(list(reversed(items)))
+    assert derive_sha(items) == derive_sha(list(items))
+
+
+# --- transactions ------------------------------------------------------
+
+def test_txn_sign_and_recover_eip155_and_homestead():
+    priv = secrets.token_bytes(32)
+    addr = host.pubkey_to_address(host.privkey_to_pubkey(priv))
+    tx = Transaction(nonce=1, gas_price=2, gas_limit=21000,
+                     to=secrets.token_bytes(20), value=10, payload=b"hi")
+    for cid in (None, 1, 1337):
+        signed = tx.signed(priv, chain_id=cid)
+        assert signed.chain_id == cid
+        assert signed.sender() == addr
+        # roundtrip through RLP preserves sender
+        back = Transaction.decode(signed.encode())
+        assert back.sender() == addr
+        assert back.hash == signed.hash
+
+
+def test_geec_and_fake_txns():
+    g = geec_txn(b"payload")
+    assert g.is_geec and g.to == REG_ADDR and g.sender() == EMPTY_ADDR
+    f = fake_txn(100, seq=7)
+    assert len(f.payload) == 100 and f.to == EMPTY_ADDR
+    back = Transaction.decode(f.encode())
+    assert back == f
+
+
+# --- header / block ----------------------------------------------------
+
+def test_header_block_roundtrip_with_geec_fields():
+    regs = (Registration(account=secrets.token_bytes(20), ip="10.0.0.1",
+                         port="6190", renew=2),)
+    h = Header(number=5, parent_hash=secrets.token_bytes(32), regs=regs,
+               trust_rand=0xDEADBEEF, time=1234, extra=b"geec")
+    priv = secrets.token_bytes(32)
+    txs = [Transaction(nonce=i, gas_limit=21000, to=bytes(20)).signed(priv)
+           for i in range(3)]
+    confirm = ConfirmBlockMsg(block_number=5, hash=secrets.token_bytes(32),
+                              confidence=1000,
+                              supporters=(secrets.token_bytes(20),))
+    blk = new_block(h, txs=txs, geec_txns=[geec_txn(b"g")],
+                    fake_txns=[fake_txn(64)], confirm=confirm)
+    back = Block.decode(blk.encode())
+    assert back.header == blk.header
+    assert back.hash == blk.hash
+    assert back.transactions == blk.transactions
+    assert back.geec_txns == blk.geec_txns
+    assert back.fake_txns == blk.fake_txns
+    assert back.confirm == confirm
+
+    # tx root covers only `transactions` (ref: core/block_validator.go:72)
+    blk2 = new_block(h, txs=txs, geec_txns=[geec_txn(b"other")])
+    assert blk2.header.tx_hash == blk.header.tx_hash
+
+    # header hash changes with trust_rand
+    import dataclasses
+    h2 = dataclasses.replace(h, trust_rand=1)
+    assert h2.hash != h.hash
+
+
+def test_query_and_registration_roundtrip():
+    q = QueryBlockMsg(block_number=9, version=2, ip="127.0.0.1", retry=1, port=8100)
+    assert QueryBlockMsg.from_rlp(rlp.decode(rlp.encode(q.to_rlp()))) == q
+    r = Registration(account=secrets.token_bytes(20), referee=secrets.token_bytes(20),
+                     ip="1.2.3.4", port="99", signature=b"\x01\x02", renew=3)
+    assert Registration.from_rlp(rlp.decode(rlp.encode(r.to_rlp()))) == r

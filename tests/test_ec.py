@@ -1,0 +1,183 @@
+"""Golden tests: TPU Jacobian EC ops vs the host secp256k1 model.
+
+Mirrors the role of the reference's libsecp256k1 self-tests
+(crypto/secp256k1/libsecp256k1/src/tests.c) for the batched group law.
+"""
+
+import secrets
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eges_tpu.crypto import secp256k1 as host
+from eges_tpu.ops import ec
+from eges_tpu.ops.bigint import int_to_limbs, limbs_to_int
+
+SEED = 1234
+
+
+def _rand_scalars(n, rng):
+    return [rng.randrange(1, host.N) for _ in range(n)]
+
+
+def _points_to_limbs(pts):
+    xs = np.stack([int_to_limbs(p[0]) for p in pts])
+    ys = np.stack([int_to_limbs(p[1]) for p in pts])
+    return jnp.asarray(xs), jnp.asarray(ys)
+
+
+def _affine_out(pt):
+    x, y, ok = ec.to_affine(pt)
+    return np.asarray(x), np.asarray(y), np.asarray(ok)
+
+
+@pytest.fixture(scope="module")
+def rng():
+    import random
+
+    return random.Random(SEED)
+
+
+def test_jac_add_and_double_match_host(rng):
+    ks = _rand_scalars(4, rng)
+    pts = [host.point_mul(k, host.G) for k in ks]
+    px, py = _points_to_limbs(pts)
+    one = jnp.broadcast_to(jnp.asarray(int_to_limbs(1)), px.shape)
+    jac = (px, py, one)
+
+    # double
+    x, y, ok = _affine_out(ec.jac_double(jac))
+    for i, p in enumerate(pts):
+        expect = host.point_add(p, p)
+        assert ok[i] == 1
+        assert limbs_to_int(x[i]) == expect[0]
+        assert limbs_to_int(y[i]) == expect[1]
+
+    # add distinct: P + 2P
+    dbl = ec.jac_double(jac)
+    x, y, ok = _affine_out(ec.jac_add(jac, dbl))
+    for i, p in enumerate(pts):
+        expect = host.point_add(p, host.point_add(p, p))
+        assert ok[i] == 1
+        assert limbs_to_int(x[i]) == expect[0]
+        assert limbs_to_int(y[i]) == expect[1]
+
+
+def test_add_exceptional_cases(rng):
+    k = _rand_scalars(1, rng)[0]
+    p = host.point_mul(k, host.G)
+    px, py = _points_to_limbs([p])
+    one = jnp.broadcast_to(jnp.asarray(int_to_limbs(1)), px.shape)
+    jac = (px, py, one)
+    inf = ec.infinity(px)
+
+    # inf + P = P (mixed)
+    x, y, ok = _affine_out(ec.jac_add_mixed(inf, px, py))
+    assert ok[0] == 1 and limbs_to_int(x[0]) == p[0]
+
+    # P + P via mixed add dispatches to doubling
+    x, y, ok = _affine_out(ec.jac_add_mixed(jac, px, py))
+    expect = host.point_add(p, p)
+    assert ok[0] == 1 and limbs_to_int(x[0]) == expect[0]
+
+    # P + (-P) = inf
+    neg_y = jnp.asarray(np.stack([int_to_limbs(host.P - p[1])]))
+    _, _, ok = _affine_out(ec.jac_add_mixed(jac, px, neg_y))
+    assert ok[0] == 0
+
+    # full add: inf + inf = inf
+    _, _, ok = _affine_out(ec.jac_add(inf, inf))
+    assert ok[0] == 0
+
+
+def test_scalar_mul_matches_host(rng):
+    ks = _rand_scalars(3, rng)
+    base_k = _rand_scalars(1, rng)[0]
+    base = host.point_mul(base_k, host.G)
+    px, py = _points_to_limbs([base] * len(ks))
+    kl = jnp.asarray(np.stack([int_to_limbs(k) for k in ks]))
+
+    fn = jax.jit(ec.scalar_mul)
+    x, y, ok = _affine_out(fn(kl, px, py))
+    for i, k in enumerate(ks):
+        expect = host.point_mul(k, base)
+        assert ok[i] == 1
+        assert limbs_to_int(x[i]) == expect[0]
+        assert limbs_to_int(y[i]) == expect[1]
+
+
+def test_strauss_matches_host(rng):
+    n = 3
+    u1s = _rand_scalars(n, rng)
+    u2s = _rand_scalars(n, rng)
+    rks = _rand_scalars(n, rng)
+    rpts = [host.point_mul(k, host.G) for k in rks]
+    rx, ry = _points_to_limbs(rpts)
+    u1l = jnp.asarray(np.stack([int_to_limbs(u) for u in u1s]))
+    u2l = jnp.asarray(np.stack([int_to_limbs(u) for u in u2s]))
+
+    fn = jax.jit(ec.strauss_gR)
+    x, y, ok = _affine_out(fn(u1l, u2l, rx, ry))
+    for i in range(n):
+        expect = host.point_add(
+            host.point_mul(u1s[i], host.G), host.point_mul(u2s[i], rpts[i])
+        )
+        assert ok[i] == 1
+        assert limbs_to_int(x[i]) == expect[0]
+        assert limbs_to_int(y[i]) == expect[1]
+
+
+def test_ecrecover_point_matches_host(rng):
+    n = 4
+    privs = [secrets.token_bytes(32) for _ in range(n)]
+    msgs = [secrets.token_bytes(32) for _ in range(n)]
+    sigs = [host.ecdsa_sign(m, p) for m, p in zip(msgs, privs)]
+
+    z = jnp.asarray(np.stack([int_to_limbs(int.from_bytes(m, "big")) for m in msgs]))
+    r = jnp.asarray(np.stack([int_to_limbs(int.from_bytes(s[0:32], "big")) for s in sigs]))
+    s_ = jnp.asarray(np.stack([int_to_limbs(int.from_bytes(s[32:64], "big")) for s in sigs]))
+    v = jnp.asarray(np.array([s[64] for s in sigs], dtype=np.uint32))
+
+    fn = jax.jit(ec.ecrecover_point)
+    qx, qy, ok = fn(z, r, s_, v)
+    qx, qy, ok = np.asarray(qx), np.asarray(qy), np.asarray(ok)
+    for i in range(n):
+        pub = host.ecdsa_recover(msgs[i], sigs[i])
+        assert ok[i] == 1
+        assert limbs_to_int(qx[i]) == int.from_bytes(pub[:32], "big")
+        assert limbs_to_int(qy[i]) == int.from_bytes(pub[32:], "big")
+
+    # corrupt one signature: flipped s must either recover a DIFFERENT key
+    # or be masked invalid — never the original key
+    bad_s = np.asarray(s_).copy()
+    bad_s[0, 0] ^= 1
+    qx2, _, ok2 = fn(z, r, jnp.asarray(bad_s), v)
+    pub0 = host.ecdsa_recover(msgs[0], sigs[0])
+    assert not (
+        ok2[0] == 1 and limbs_to_int(np.asarray(qx2)[0]) == int.from_bytes(pub0[:32], "big")
+    )
+
+
+def test_ecdsa_verify_point(rng):
+    n = 3
+    privs = [secrets.token_bytes(32) for _ in range(n)]
+    msgs = [secrets.token_bytes(32) for _ in range(n)]
+    sigs = [host.ecdsa_sign(m, p) for m, p in zip(msgs, privs)]
+    pubs = [host.privkey_to_pubkey(p) for p in privs]
+
+    z = jnp.asarray(np.stack([int_to_limbs(int.from_bytes(m, "big")) for m in msgs]))
+    r = jnp.asarray(np.stack([int_to_limbs(int.from_bytes(s[0:32], "big")) for s in sigs]))
+    s_ = jnp.asarray(np.stack([int_to_limbs(int.from_bytes(s[32:64], "big")) for s in sigs]))
+    qx = jnp.asarray(np.stack([int_to_limbs(int.from_bytes(p[:32], "big")) for p in pubs]))
+    qy = jnp.asarray(np.stack([int_to_limbs(int.from_bytes(p[32:], "big")) for p in pubs]))
+
+    fn = jax.jit(ec.ecdsa_verify_point)
+    ok = np.asarray(fn(z, r, s_, qx, qy))
+    assert ok.tolist() == [1] * n
+
+    # wrong message fails
+    z_bad = jnp.asarray(np.roll(np.asarray(z), 1, axis=0))
+    ok = np.asarray(fn(z_bad, r, s_, qx, qy))
+    assert ok.tolist() == [0] * n
