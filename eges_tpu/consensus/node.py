@@ -103,6 +103,7 @@ class GeecNode:
         self.registered = self.coinbase in self.membership
         self.pending_geec_txns: list[Transaction] = []
         self.geec_txn_sink = None  # app-layer callback for confirmed geec txns
+        self.txpool = None  # optional TxPool; proposals drain it
 
         # deferred messages for future working blocks (Wait() analogue)
         self._deferred: list[tuple[int, object]] = []  # (blk_num, thunk)
@@ -325,7 +326,10 @@ class GeecNode:
         self.pending_geec_txns = self.pending_geec_txns[n:]
         fakes = tuple(fake_txn(self.cfg.txn_size, seq=i)
                       for i in range(self.cfg.txn_per_block - n))
-        return new_block(header, geec_txns=geec_txns, fake_txns=fakes)
+        txs = (tuple(self.txpool.pending_txns(self.cfg.txn_per_block))
+               if self.txpool is not None else ())
+        return new_block(header, txs=txs, geec_txns=geec_txns,
+                         fake_txns=fakes)
 
     def _build_and_validate(self, blk_num: int, version: int) -> None:
         if blk_num != self.wb.blk_num:
@@ -525,7 +529,7 @@ class GeecNode:
         are batch-verified on device — the capability BASELINE.json
         targets.  Same implementation as the insert path
         (chain._verify_body) by construction."""
-        from eges_tpu.crypto.verifier import batch_verify_txns
+        from eges_tpu.crypto.verify_host import batch_verify_txns
         if self.verifier is None:
             return True
         return batch_verify_txns(block.transactions, self.verifier)
@@ -650,6 +654,8 @@ class GeecNode:
         rebuilds GeecState "from genesis bootstrap list + replayed
         confirmed blocks", SURVEY §5 checkpoint/resume)."""
         self.trust_rands[blk.number] = blk.header.trust_rand
+        if self.txpool is not None and blk.transactions:
+            self.txpool.remove_included(blk.transactions)
         if blk.header.coinbase == EMPTY_ADDR:
             if blk.number not in self.empty_block_list:
                 self.empty_block_list.append(blk.number)

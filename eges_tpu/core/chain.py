@@ -223,7 +223,7 @@ class BlockChain:
                 if block.transactions else EMPTY_ROOT)
         if block.header.tx_hash != want:
             raise ChainError("transaction root mismatch")
-        from eges_tpu.crypto.verifier import batch_verify_txns
+        from eges_tpu.crypto.verify_host import batch_verify_txns
         if not batch_verify_txns(block.transactions, self.verifier):
             raise ChainError("invalid transaction signature")
 

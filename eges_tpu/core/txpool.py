@@ -1,0 +1,134 @@
+"""Transaction pool with a device-batched verification window.
+
+Role parity with the reference's ``core/tx_pool.go`` for the Geec
+capability set: remote txns are validated (signature -> sender) before
+entering the pending set the proposer drains (ref: validateTx's
+``types.Sender`` call, core/tx_pool.go:571-573 — the "second TPU
+batch-verify target", SURVEY §2.2).
+
+TPU-first redesign (SURVEY §7 step 5): instead of one ecrecover per
+``add``, incoming txns accumulate in a verify queue that is flushed as
+ONE device batch when either ``max_batch`` rows are waiting or the
+``window_ms`` timer fires — the classic latency/occupancy batching
+window.  Senders come back from the same batch (recover_addresses), so
+admission costs one device call per window regardless of txn rate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from eges_tpu.core.types import Transaction
+
+
+class TxPool:
+    def __init__(self, clock, verifier=None, *, window_ms: float = 5.0,
+                 max_batch: int = 1024, max_pending: int = 100_000,
+                 on_admitted=None):
+        self.clock = clock
+        self.verifier = verifier
+        self.window_ms = window_ms
+        self.max_batch = max_batch
+        self.max_pending = max_pending
+        self.on_admitted = on_admitted
+        # sender -> {nonce -> txn}; admission order preserved separately
+        self.pending: dict[bytes, dict[int, Transaction]] = {}
+        self._order: list[Transaction] = []
+        self._known: set[bytes] = set()
+        self._queue: list[Transaction] = []
+        self._timer = None
+        self.stats = {"admitted": 0, "rejected": 0, "duplicate": 0,
+                      "batches": 0}
+
+    # -- ingest -----------------------------------------------------------
+
+    def add_remotes(self, txns) -> None:
+        """Queue remote txns for batched admission
+        (ref: TxPool.AddRemotes core/tx_pool.go:551)."""
+        for t in txns:
+            h = t.hash
+            if h in self._known:
+                self.stats["duplicate"] += 1
+                continue
+            self._known.add(h)
+            self._queue.append(t)
+        if len(self._queue) >= self.max_batch:
+            self._flush()
+        elif self._queue and self._timer is None:
+            self._timer = self.clock.call_later(self.window_ms / 1e3,
+                                                self._on_window)
+
+    def _on_window(self) -> None:
+        self._timer = None
+        self._flush()
+
+    def _flush(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        batch, self._queue = self._queue[: self.max_batch], \
+            self._queue[self.max_batch:]
+        if not batch:
+            return
+        self.stats["batches"] += 1
+        parts = [t.signature_parts() for t in batch]
+        senders: list[bytes | None] = [None] * len(batch)
+        rows = [(i, p) for i, p in enumerate(parts) if p is not None]
+        if rows and self.verifier is not None:
+            sigs = np.zeros((len(rows), 65), np.uint8)
+            hashes = np.zeros((len(rows), 32), np.uint8)
+            for k, (_, (sig, h)) in enumerate(rows):
+                sigs[k] = np.frombuffer(sig, np.uint8)
+                hashes[k] = np.frombuffer(h, np.uint8)
+            addrs, ok = self.verifier.recover_addresses(sigs, hashes)
+            for k, (i, _) in enumerate(rows):
+                if ok[k]:
+                    senders[i] = bytes(addrs[k])
+        elif rows:
+            for i, _ in rows:
+                try:
+                    senders[i] = batch[i].sender()
+                except ValueError:
+                    pass
+        for t, sender in zip(batch, senders):
+            if sender is None:
+                self.stats["rejected"] += 1
+                continue
+            self._admit(t, sender)
+        if self._queue:
+            self._flush()
+
+    def _admit(self, t: Transaction, sender: bytes) -> None:
+        if len(self._order) >= self.max_pending:
+            self.stats["rejected"] += 1
+            return
+        by_nonce = self.pending.setdefault(sender, {})
+        if t.nonce in by_nonce:  # replacement: keep first (no gas bidding here)
+            self.stats["duplicate"] += 1
+            return
+        by_nonce[t.nonce] = t
+        self._order.append(t)
+        self.stats["admitted"] += 1
+        if self.on_admitted is not None:
+            self.on_admitted(t, sender)
+
+    # -- drain ------------------------------------------------------------
+
+    def pending_txns(self, limit: int | None = None) -> list[Transaction]:
+        """Admission-ordered pending txns for block building
+        (ref: TxPool.Pending, miner/worker.go:463)."""
+        return self._order[:limit] if limit else list(self._order)
+
+    def remove_included(self, txns) -> None:
+        """Drop txns included in a canonical block."""
+        hashes = {t.hash for t in txns}
+        self._order = [t for t in self._order if t.hash not in hashes]
+        for sender in list(self.pending):
+            self.pending[sender] = {
+                n: t for n, t in self.pending[sender].items()
+                if t.hash not in hashes}
+            if not self.pending[sender]:
+                del self.pending[sender]
+
+    def __len__(self) -> int:
+        return len(self._order)

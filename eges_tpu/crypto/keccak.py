@@ -66,7 +66,7 @@ def keccak_f1600(lanes: list[int]) -> list[int]:
     return a
 
 
-def keccak256(data: bytes) -> bytes:
+def keccak256_py(data: bytes) -> bytes:
     """Ethereum-style Keccak-256 digest of ``data``."""
     state = [0] * 25
     # Multi-rate padding: 0x01 ... 0x80 (both may share one byte).
@@ -86,3 +86,20 @@ def keccak256(data: bytes) -> bytes:
     for i in range(4):  # 32 bytes = 4 lanes
         out += state[i].to_bytes(8, "little")
     return bytes(out)
+
+
+def _dispatch_keccak256():
+    """Prefer the native C++ core when built (the reference's asm-core
+    role, crypto/sha3/keccakf_amd64.s); pure Python stays the golden
+    fallback."""
+    try:
+        from eges_tpu.crypto import native
+
+        if native.available():
+            return lambda data: native.keccak256(bytes(data))
+    except Exception:
+        pass
+    return keccak256_py
+
+
+keccak256 = _dispatch_keccak256()

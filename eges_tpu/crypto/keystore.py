@@ -1,0 +1,178 @@
+"""Encrypted key storage — the accounts/keystore role.
+
+Web3 secret-storage v3 compatible (scrypt + AES-128-CTR + keccak MAC),
+the same format the reference's keystore writes (ref:
+accounts/keystore/passphrase.go; scrypt JSON files under
+``<datadir>/keystore``, created by ``geth account new`` which the
+harness drives over ssh, start.py:60-80).  AES-CTR is implemented
+inline on top of stdlib AES-ECB... stdlib has no AES; CTR here is built
+on a pure-Python AES core kept minimal — keystore I/O is not a hot
+path (one decrypt at node start).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import secrets
+
+from eges_tpu.crypto.keccak import keccak256
+from eges_tpu.crypto import secp256k1 as secp
+
+# -- minimal AES-128 (encrypt-only; CTR needs only the forward cipher) ----
+
+_SBOX = None
+
+
+def _sbox():
+    global _SBOX
+    if _SBOX is None:
+        p = q = 1
+        sbox = [0] * 256
+        while True:
+            # multiply p by 3 in GF(2^8)
+            p = p ^ ((p << 1) & 0xFF) ^ (0x1B if p & 0x80 else 0)
+            # divide q by 3
+            q ^= (q << 1) & 0xFF
+            q ^= (q << 2) & 0xFF
+            q ^= (q << 4) & 0xFF
+            q ^= 0x09 if q & 0x80 else 0
+            x = q ^ ((q << 1) | (q >> 7)) & 0xFF
+            x ^= ((q << 2) | (q >> 6)) & 0xFF
+            x ^= ((q << 3) | (q >> 5)) & 0xFF
+            x ^= ((q << 4) | (q >> 4)) & 0xFF
+            sbox[p] = (x ^ 0x63) & 0xFF
+            if p == 1:
+                break
+        sbox[0] = 0x63
+        _SBOX = sbox
+    return _SBOX
+
+
+def _xtime(a: int) -> int:
+    return ((a << 1) ^ 0x1B) & 0xFF if a & 0x80 else a << 1
+
+
+def _aes128_encrypt_block(key: bytes, block: bytes) -> bytes:
+    sbox = _sbox()
+    # key expansion
+    rk = list(key)
+    rcon = 1
+    for i in range(4, 44):
+        t = rk[4 * (i - 1): 4 * i]
+        if i % 4 == 0:
+            t = [sbox[t[1]] ^ rcon, sbox[t[2]], sbox[t[3]], sbox[t[0]]]
+            rcon = _xtime(rcon)
+        rk += [rk[4 * (i - 4) + j] ^ t[j] for j in range(4)]
+    s = [block[i] ^ rk[i] for i in range(16)]
+    for rnd in range(1, 11):
+        s = [sbox[b] for b in s]
+        # shift rows
+        s = [s[0], s[5], s[10], s[15], s[4], s[9], s[14], s[3],
+             s[8], s[13], s[2], s[7], s[12], s[1], s[6], s[11]]
+        if rnd != 10:
+            ns = []
+            for c in range(4):
+                a = s[4 * c: 4 * c + 4]
+                ns += [
+                    _xtime(a[0]) ^ (_xtime(a[1]) ^ a[1]) ^ a[2] ^ a[3],
+                    a[0] ^ _xtime(a[1]) ^ (_xtime(a[2]) ^ a[2]) ^ a[3],
+                    a[0] ^ a[1] ^ _xtime(a[2]) ^ (_xtime(a[3]) ^ a[3]),
+                    (_xtime(a[0]) ^ a[0]) ^ a[1] ^ a[2] ^ _xtime(a[3]),
+                ]
+            s = [b & 0xFF for b in ns]
+        s = [s[i] ^ rk[16 * rnd + i] for i in range(16)]
+    return bytes(s)
+
+
+def _aes128_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    out = bytearray()
+    counter = int.from_bytes(iv, "big")
+    for off in range(0, len(data), 16):
+        ks = _aes128_encrypt_block(key, counter.to_bytes(16, "big"))
+        chunk = data[off: off + 16]
+        out += bytes(a ^ b for a, b in zip(chunk, ks))
+        counter = (counter + 1) % (1 << 128)
+    return bytes(out)
+
+
+# -- web3 v3 keystore ------------------------------------------------------
+
+def encrypt_key(priv: bytes, password: str, *, n: int = 1 << 12, p: int = 6) -> dict:
+    """Encrypt to a v3 keystore dict.  Default scrypt N is the reference's
+    LightScryptN (accounts/keystore: 4096) to keep tests fast."""
+    salt = secrets.token_bytes(32)
+    dk = hashlib.scrypt(password.encode(), salt=salt, n=n, r=8, p=p, dklen=32,
+                        maxmem=128 * 1024 * 1024)
+    iv = secrets.token_bytes(16)
+    ciphertext = _aes128_ctr(dk[:16], iv, priv)
+    mac = keccak256(dk[16:32] + ciphertext)
+    addr = secp.pubkey_to_address(secp.privkey_to_pubkey(priv))
+    return {
+        "version": 3,
+        "id": secrets.token_hex(16),
+        "address": addr.hex(),
+        "crypto": {
+            "cipher": "aes-128-ctr",
+            "ciphertext": ciphertext.hex(),
+            "cipherparams": {"iv": iv.hex()},
+            "kdf": "scrypt",
+            "kdfparams": {"dklen": 32, "n": n, "r": 8, "p": p,
+                          "salt": salt.hex()},
+            "mac": mac.hex(),
+        },
+    }
+
+
+def decrypt_key(obj: dict, password: str) -> bytes:
+    c = obj["crypto"]
+    if c["kdf"] != "scrypt" or c["cipher"] != "aes-128-ctr":
+        raise ValueError("unsupported keystore parameters")
+    kp = c["kdfparams"]
+    dk = hashlib.scrypt(password.encode(), salt=bytes.fromhex(kp["salt"]),
+                        n=kp["n"], r=kp["r"], p=kp["p"], dklen=kp["dklen"],
+                        maxmem=512 * 1024 * 1024)
+    ciphertext = bytes.fromhex(c["ciphertext"])
+    if keccak256(dk[16:32] + ciphertext) != bytes.fromhex(c["mac"]):
+        raise ValueError("could not decrypt key with given password")
+    return _aes128_ctr(dk[:16], bytes.fromhex(c["cipherparams"]["iv"]),
+                       ciphertext)
+
+
+class Keystore:
+    """Directory of v3 key files (``geth account new`` role)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def new_account(self, password: str) -> bytes:
+        priv = secrets.token_bytes(32)
+        return self.import_key(priv, password)
+
+    def import_key(self, priv: bytes, password: str) -> bytes:
+        obj = encrypt_key(priv, password)
+        addr = bytes.fromhex(obj["address"])
+        with open(os.path.join(self.path, f"UTC--{obj['address']}.json"),
+                  "w") as f:
+            json.dump(obj, f)
+        return addr
+
+    def accounts(self) -> list[bytes]:
+        out = []
+        for name in sorted(os.listdir(self.path)):
+            if name.endswith(".json"):
+                with open(os.path.join(self.path, name)) as f:
+                    out.append(bytes.fromhex(json.load(f)["address"]))
+        return out
+
+    def get_key(self, addr: bytes, password: str) -> bytes:
+        for name in sorted(os.listdir(self.path)):
+            if not name.endswith(".json"):
+                continue
+            with open(os.path.join(self.path, name)) as f:
+                obj = json.load(f)
+            if obj["address"] == addr.hex():
+                return decrypt_key(obj, password)
+        raise KeyError(f"no key for {addr.hex()}")

@@ -200,3 +200,49 @@ def recover_address(msg_hash: bytes, sig: bytes) -> bytes:
     path the TPU batches (ref: core/types/transaction_signing.go:222
     recoverPlain -> Ecrecover -> Keccak256(pub)[12:])."""
     return pubkey_to_address(ecdsa_recover(msg_hash, sig))
+
+
+# ---------------------------------------------------------------------------
+# native dispatch: prefer the C++ library when built (the reference's
+# cgo-vs-pure-Go split, crypto/signature_cgo.go:17); the pure-Python
+# implementations above remain the golden model and are kept under
+# ``*_py`` names for cross-checking.
+# ---------------------------------------------------------------------------
+
+ecdsa_sign_py = ecdsa_sign
+ecdsa_recover_py = ecdsa_recover
+ecdsa_verify_py = ecdsa_verify
+privkey_to_pubkey_py = privkey_to_pubkey
+
+try:
+    from eges_tpu.crypto import native as _native
+
+    if _native.available():
+        def ecdsa_sign(msg_hash: bytes, priv: bytes) -> bytes:  # noqa: F811
+            if len(msg_hash) != 32 or len(priv) != 32:
+                raise ValueError("hash and key must be 32 bytes")
+            return _native.ec_sign(bytes(msg_hash), bytes(priv))
+
+        def ecdsa_recover(msg_hash: bytes, sig: bytes) -> bytes:  # noqa: F811
+            if len(sig) != 65 or len(msg_hash) != 32:
+                raise ValueError("need 32-byte hash and 65-byte signature")
+            return _native.ec_recover(bytes(msg_hash), bytes(sig))
+
+        def ecdsa_verify(msg_hash: bytes, sig: bytes, pub: bytes) -> bool:  # noqa: F811
+            if len(msg_hash) != 32 or len(sig) < 64:
+                return False
+            try:
+                pub64 = pub[-64:] if len(pub) in (64, 65) else pub
+                if len(pub64) != 64:
+                    return False
+                return _native.ec_verify(bytes(msg_hash), bytes(sig[:64]),
+                                         bytes(pub64))
+            except Exception:
+                return False
+
+        def privkey_to_pubkey(priv: bytes) -> bytes:  # noqa: F811
+            if len(priv) != 32:
+                raise ValueError("private key must be 32 bytes")
+            return _native.ec_pubkey(bytes(priv))
+except Exception:  # pragma: no cover - native lib absent
+    pass

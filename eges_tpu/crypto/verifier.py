@@ -178,38 +178,6 @@ class BatchVerifier:
         return np.asarray(ok)[:n].astype(bool)
 
 
-def batch_verify_txns(txns, verifier) -> bool:
-    """Verify the signed (non-Geec) transactions of a block as one device
-    batch; the single shared implementation behind both the acceptor ACK
-    check and the insert-path body validation (SURVEY §3.5's two verify
-    sites, core/tx_pool.go:571 and core/state_processor.go:93).
-
-    Returns False if any signed txn is malformed or fails recovery.
-    ``verifier=None`` falls back to per-txn host recovery (the
-    signature_nocgo.go role).
-    """
-    signed = [t for t in txns if not t.is_geec and (t.r or t.s or t.v)]
-    if not signed:
-        return True
-    parts = [t.signature_parts() for t in signed]
-    if any(p is None for p in parts):
-        return False
-    if verifier is None:
-        try:
-            for t in signed:
-                t.sender()
-        except ValueError:
-            return False
-        return True
-    sigs = np.zeros((len(parts), 65), np.uint8)
-    hashes = np.zeros((len(parts), 32), np.uint8)
-    for i, (sig, h) in enumerate(parts):
-        sigs[i] = np.frombuffer(sig, np.uint8)
-        hashes[i] = np.frombuffer(h, np.uint8)
-    _, ok = verifier.recover_addresses(sigs, hashes)
-    return bool(ok.all())
-
-
 @functools.lru_cache(maxsize=1)
 def default_verifier() -> BatchVerifier:
     """Process-wide verifier on the default device set: a 1-axis mesh over

@@ -1,0 +1,185 @@
+"""Real-socket network planes (asyncio).
+
+The production counterpart of the simulator transports, mirroring the
+reference's two planes (SURVEY §2.3):
+
+* **Direct plane** — UDP datagrams, RLP payloads, exactly like the
+  reference's election/reply sockets (consensus/geec/election/server.go
+  binds ``--consensusPort``; replies dial ``ip:port`` from the request).
+* **Gossip plane** — persistent TCP connections to a static peer list
+  with length-prefixed frames.  The reference runs RLPx-encrypted devp2p
+  here (p2p/rlpx.go); a permissioned deployment's transport security is
+  orthogonal to consensus, so frames are plaintext for now and the
+  handshake/encryption layer can be added beneath this interface
+  (SURVEY §7 step 4: "discovery/RLPx crypto can come last").
+
+Everything runs on one asyncio loop; inbound messages call straight into
+the single-threaded :class:`~eges_tpu.consensus.node.GeecNode`, so the
+no-locks design of the state machines carries over unchanged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+
+class AsyncioClock:
+    """Clock interface over the running asyncio loop."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop | None = None):
+        self._loop = loop or asyncio.get_event_loop()
+
+    def now(self) -> float:
+        return self._loop.time()
+
+    def call_later(self, delay_s: float, fn):
+        return self._loop.call_later(delay_s, fn)  # TimerHandle has .cancel()
+
+
+class _UdpProtocol(asyncio.DatagramProtocol):
+    def __init__(self, on_datagram):
+        self._on_datagram = on_datagram
+
+    def datagram_received(self, data, addr):
+        try:
+            self._on_datagram(data)
+        except Exception:
+            pass  # one bad datagram must not kill the receive loop
+
+
+class DirectPlane:
+    """UDP send/receive for election messages and validate/query replies."""
+
+    def __init__(self, bind_ip: str, bind_port: int, on_direct):
+        self.bind_ip = bind_ip
+        self.bind_port = bind_port
+        self._on_direct = on_direct
+        self._transport: asyncio.DatagramTransport | None = None
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: _UdpProtocol(self._on_direct),
+            local_addr=(self.bind_ip, self.bind_port))
+
+    def send(self, ip: str, port: int, data: bytes) -> None:
+        if self._transport is not None:
+            self._transport.sendto(data, (ip, port))
+
+    def close(self) -> None:
+        if self._transport is not None:
+            self._transport.close()
+
+
+class GossipPlane:
+    """Static-peer-list TCP gossip with 4-byte length-prefixed frames.
+
+    Reconnects with backoff; sends are fire-and-forget like the
+    reference's per-peer ``p2p.Send`` loops (eth/handler.go:1071-1080).
+    """
+
+    MAX_FRAME = 64 * 1024 * 1024
+
+    def __init__(self, bind_ip: str, bind_port: int, peers: list[tuple[str, int]],
+                 on_gossip):
+        self.bind_ip = bind_ip
+        self.bind_port = bind_port
+        self.peers = [p for p in peers if p != (bind_ip, bind_port)]
+        self._on_gossip = on_gossip
+        self._server: asyncio.AbstractServer | None = None
+        self._writers: dict[tuple[str, int], asyncio.StreamWriter] = {}
+        self._tasks: list[asyncio.Task] = []
+        self._closed = False
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.bind_ip, self.bind_port)
+        for peer in self.peers:
+            self._tasks.append(asyncio.create_task(self._dial_loop(peer)))
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                (n,) = struct.unpack("<I", hdr)
+                if n > self.MAX_FRAME:
+                    break
+                frame = await reader.readexactly(n)
+                try:
+                    self._on_gossip(frame)
+                except Exception:
+                    pass
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    async def _dial_loop(self, peer: tuple[str, int]) -> None:
+        backoff = 0.2
+        while not self._closed:
+            try:
+                _, writer = await asyncio.open_connection(*peer)
+                self._writers[peer] = writer
+                backoff = 0.2
+                # hold the connection; writer errors surface on send
+                while not writer.is_closing() and not self._closed:
+                    await asyncio.sleep(0.5)
+            except (ConnectionError, OSError):
+                pass
+            self._writers.pop(peer, None)
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 5.0)
+
+    def broadcast(self, data: bytes) -> None:
+        frame = struct.pack("<I", len(data)) + data
+        for peer, writer in list(self._writers.items()):
+            try:
+                writer.write(frame)
+            except Exception:
+                self._writers.pop(peer, None)
+
+    def close(self) -> None:
+        self._closed = True
+        for t in self._tasks:
+            t.cancel()
+        for w in self._writers.values():
+            w.close()
+        if self._server is not None:
+            self._server.close()
+
+
+class SocketTransport:
+    """The Transport interface GeecNode expects, over the two planes."""
+
+    def __init__(self, gossip: GossipPlane, direct: DirectPlane):
+        self._gossip = gossip
+        self._direct = direct
+
+    def gossip(self, data: bytes) -> None:
+        self._gossip.broadcast(data)
+
+    def send_direct(self, ip: str, port: int, data: bytes) -> None:
+        self._direct.send(ip, port, data)
+
+
+class GeecTxnService:
+    """UDP transaction-ingest API: every datagram on ``--geecTxnPort``
+    becomes an unsigned Geec transaction (ref: consensus/geec/geec_api.go:11)."""
+
+    def __init__(self, bind_ip: str, port: int, on_txn_payload):
+        self.bind_ip = bind_ip
+        self.port = port
+        self._on_txn = on_txn_payload
+        self._transport = None
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: _UdpProtocol(self._on_txn),
+            local_addr=(self.bind_ip, self.port))
+
+    def close(self) -> None:
+        if self._transport is not None:
+            self._transport.close()

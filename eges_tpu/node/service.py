@@ -1,0 +1,161 @@
+"""Node service container: the ``geth``-process equivalent.
+
+Assembles a full Geec node from a genesis file + flags (the role of
+node.Node + eth.New, ref: node/node.go:138, eth/backend.go:105-185):
+durable chain over a datadir FileStore, the consensus state machine,
+both network planes, the UDP txn-ingest service, and the TPU batch
+verifier — then runs the asyncio loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from dataclasses import dataclass
+
+from eges_tpu.consensus.config import ChainGeecConfig, NodeConfig
+from eges_tpu.consensus.node import GeecNode
+from eges_tpu.core.chain import BlockChain, FileStore, make_genesis
+from eges_tpu.crypto import secp256k1 as secp
+from eges_tpu.net.transports import (
+    AsyncioClock, DirectPlane, GeecTxnService, GossipPlane, SocketTransport,
+)
+from eges_tpu.utils.log import get_logger
+
+
+@dataclass
+class ServiceConfig:
+    datadir: str
+    genesis_path: str
+    key_hex: str                       # 32-byte private key (hex)
+    gossip_ip: str = "127.0.0.1"
+    gossip_port: int = 6190
+    peers: tuple[tuple[str, int], ...] = ()  # static gossip peer list
+    node: NodeConfig = None            # Geec knobs (coinbase filled in)
+    mine: bool = True
+    verbosity: int = 3
+    use_tpu_verifier: bool = False     # device batch verify on acceptors
+    rpc_port: int = 0                  # 0 = RPC disabled
+
+
+def load_genesis_config(path: str) -> tuple[ChainGeecConfig, dict]:
+    """Parse the genesis JSON's ``config.thw`` section
+    (ref: params/config.go:124, core/genesis.go SetupGenesisBlock)."""
+    with open(path) as f:
+        doc = json.load(f)
+    thw = doc.get("config", {}).get("thw", {})
+    return ChainGeecConfig.from_json(thw), doc
+
+
+class NodeService:
+    def __init__(self, cfg: ServiceConfig):
+        self.cfg = cfg
+        priv = bytes.fromhex(cfg.key_hex)
+        self.coinbase = secp.pubkey_to_address(secp.privkey_to_pubkey(priv))
+        self.log = get_logger(f"geec.{self.coinbase.hex()[:8]}",
+                              cfg.verbosity)
+
+        chain_cfg, genesis_doc = load_genesis_config(cfg.genesis_path)
+        extra = genesis_doc.get("extraData", "") or "geec-genesis"
+        if isinstance(extra, str):
+            extra = extra.encode()
+        genesis = make_genesis(
+            extra=extra,
+            time=int(genesis_doc.get("timestamp", "0x0"), 16)
+            if isinstance(genesis_doc.get("timestamp"), str)
+            else int(genesis_doc.get("timestamp", 0)))
+
+        verifier = None
+        if cfg.use_tpu_verifier:
+            from eges_tpu.crypto.verifier import default_verifier
+            verifier = default_verifier()
+
+        os.makedirs(cfg.datadir, exist_ok=True)
+        store = FileStore(os.path.join(cfg.datadir, "chaindata"))
+        self.chain = BlockChain(store=store, genesis=genesis,
+                                verifier=verifier)
+
+        import dataclasses
+        ncfg = dataclasses.replace(cfg.node or NodeConfig(),
+                                   coinbase=self.coinbase)
+
+        self.clock = AsyncioClock(asyncio.get_event_loop())
+        self.node = GeecNode(self.chain, self.clock, None, ncfg, chain_cfg,
+                             mine=cfg.mine, verifier=verifier,
+                             log=self._node_log)
+
+        self.direct = DirectPlane(ncfg.consensus_ip, ncfg.consensus_port,
+                                  self.node.on_direct)
+        self.gossip = GossipPlane(cfg.gossip_ip, cfg.gossip_port,
+                                  list(cfg.peers), self.node.on_gossip)
+        self.node.transport = SocketTransport(self.gossip, self.direct)
+
+        self.txn_service = None
+        if ncfg.geec_txn_port:
+            self.txn_service = GeecTxnService(
+                ncfg.consensus_ip, ncfg.geec_txn_port, self.node.on_geec_txn)
+
+        from eges_tpu.core.txpool import TxPool
+        self.txpool = TxPool(self.clock, verifier=verifier)
+        self.node.txpool = self.txpool
+
+        self.rpc = None
+        if cfg.rpc_port:
+            from eges_tpu.rpc.server import RpcServer
+            self.rpc = RpcServer(self.chain, node=self.node,
+                                 txpool=self.txpool,
+                                 bind_ip=cfg.gossip_ip, port=cfg.rpc_port)
+
+        self._height_task = None
+
+    def _node_log(self, kind: str, **kw) -> None:
+        if kind == "breakdown":
+            self.log.breakdown(kw.pop("phase", "?"), kw.pop("dt", 0.0), **kw)
+        else:
+            self.log.geec(kind, **kw)
+
+    async def start(self) -> None:
+        await self.direct.start()
+        await self.gossip.start()
+        if self.txn_service is not None:
+            await self.txn_service.start()
+        if self.rpc is not None:
+            await self.rpc.start()
+        # give gossip dials a moment, like the reference's block-1 grace
+        # sleep (consensus/geec/geec.go:296)
+        await asyncio.sleep(1.0)
+        self.node.start()
+        self.log.geec("node started", coinbase=self.coinbase.hex(),
+                      height=self.chain.height(), mine=self.cfg.mine)
+        self._height_task = asyncio.ensure_future(self._height_loop())
+
+    async def _height_loop(self) -> None:
+        last = -1
+        while True:
+            h = self.chain.height()
+            if h != last:
+                blk = self.chain.head()
+                self.log.geec("head", height=h,
+                              hash=blk.hash.hex()[:12],
+                              geec_txns=len(blk.geec_txns),
+                              fake_txns=len(blk.fake_txns))
+                last = h
+            await asyncio.sleep(0.5)
+
+    async def run_forever(self) -> None:
+        await self.start()
+        while True:
+            await asyncio.sleep(3600)
+
+    def close(self) -> None:
+        if self._height_task is not None:
+            self._height_task.cancel()
+        if self.rpc is not None:
+            self.rpc.close()
+        self.node.stop()
+        self.gossip.close()
+        self.direct.close()
+        if self.txn_service is not None:
+            self.txn_service.close()
+        self.chain.store.close()

@@ -23,6 +23,8 @@ constant exponent bits so the compiled graph stays small.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -254,21 +256,241 @@ class Mod:
         """Fermat inverse ``a^(m-2)``; returns 0 for input 0."""
         return self.pow_const(a, self.m - 2)
 
+    def batch_inv(self, a: jnp.ndarray) -> jnp.ndarray:
+        """Montgomery batch inversion over the leading batch axis.
+
+        A Fermat inverse costs ~512 field muls *per row*; the batch trick
+        replaces that with ~2 muls per row plus ONE Fermat inverse of the
+        whole batch's product.  Implemented as a product *tree* (log2(B)
+        levels of batched muls) rather than the classic sequential prefix
+        scan, so the batch axis stays parallel on the VPU.
+
+        Zero rows pass through as 0 (same contract as :meth:`inv`).
+        ``a`` must be ``[B, 16]``; any B >= 1 (odd level sizes carry the
+        tail element through).
+        """
+        B = a.shape[0]
+        if B == 1:
+            return self.inv(a)
+        one = jnp.broadcast_to(jnp.asarray(int_to_limbs(1)), a.shape)
+        zero_mask = self.is_zero_mod(a)
+        x = select(zero_mask, one, a)  # make every row invertible
+
+        # upward pass: pairwise products, carrying odd tails through
+        levels = [x]
+        cur = x
+        while cur.shape[0] > 1:
+            n = cur.shape[0]
+            half = n // 2
+            prod = self.mul(cur[0 : 2 * half : 2], cur[1 : 2 * half : 2])
+            if n % 2:
+                prod = jnp.concatenate([prod, cur[-1:]], axis=0)
+            levels.append(prod)
+            cur = prod
+
+        # invert the single root product
+        root_inv = self.inv(cur)
+
+        # downward pass: child inverses from the parent inverse
+        inv = root_inv
+        for lvl in levels[-2::-1]:
+            n = lvl.shape[0]
+            half = n // 2
+            parent_inv = inv  # [ceil(n/2), 16]
+            left = lvl[0 : 2 * half : 2]
+            right = lvl[1 : 2 * half : 2]
+            pi = parent_inv[:half]
+            inv_left = self.mul(pi, right)
+            inv_right = self.mul(pi, left)
+            pairs = jnp.stack([inv_left, inv_right], axis=1).reshape(
+                2 * half, NLIMBS)
+            if n % 2:
+                pairs = jnp.concatenate([pairs, parent_inv[half:]], axis=0)
+            inv = pairs
+
+        return select(zero_mask, jnp.zeros_like(a), inv)
+
+    def inv_batched(self, a: jnp.ndarray) -> jnp.ndarray:
+        """Shape-polymorphic front door for :meth:`batch_inv`: flattens
+        leading dims; falls back to Fermat for unbatched inputs."""
+        if a.ndim < 2:
+            return self.inv(a)
+        flat = a.reshape(-1, NLIMBS)
+        return self.batch_inv(flat).reshape(a.shape)
+
     def const(self, x: int, like: jnp.ndarray) -> jnp.ndarray:
         """Broadcast a Python-int constant to the batch shape of ``like``."""
         return jnp.broadcast_to(jnp.asarray(int_to_limbs(x % self.m)), like.shape)
 
+    # canonical-representation hooks; FieldP overrides for its relaxed form
+    def canon(self, a: jnp.ndarray) -> jnp.ndarray:
+        return a
+
+    def is_zero_mod(self, a: jnp.ndarray) -> jnp.ndarray:
+        return is_zero(a)
+
+    def eq_mod(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        return eq(a, b)
+
+
+# ---------------------------------------------------------------------------
+# fast path for F_P: diagonal-gather column products + fold-in-column-space
+# reduction + relaxed representation
+# ---------------------------------------------------------------------------
+#
+# The generic Mod path above scatters 32 partial rows into a column vector
+# and walks three carry/borrow chains per multiply (~100 sequential steps,
+# ~800 HLO ops).  The F_P fast path below does the same work as:
+#   * ONE constant-index gather that lines the 16x16 partial-product matrix
+#     up along its anti-diagonals plus a single sum-reduce ("column sums"),
+#   * delta-folding performed directly on the (uncarried) columns —
+#     977*hi and hi<<2 vector adds, exploiting delta_P = 2^32 + 977 having
+#     a single tiny limb,
+#   * exactly two 16-step carry chains and one 5-step mini-chain.
+# Outputs are RELAXED: in [0, 2^256), possibly >= P.  All F_P ops accept
+# relaxed inputs; canonicalize (one conditional subtract) only at compare/
+# output sites via canon()/is_zero_mod()/eq_mod().  This matches how
+# libsecp26k1's field_5x52 representation defers normalization — re-derived
+# here for 16-bit lanes and XLA (no borrowed code; ref role:
+# crypto/secp256k1/libsecp256k1/src/field_5x52_impl.h).
+
+
+@functools.lru_cache(maxsize=None)
+def _diag_idx(na: int, nb: int):
+    """Constant gather indices/masks aligning M[i, j] along k = i + j."""
+    k = np.arange(na + nb - 1)[None, :]
+    i = np.arange(na)[:, None]
+    j = k - i
+    mask = ((j >= 0) & (j < nb)).astype(np.uint32)
+    idx = np.clip(j, 0, nb - 1).astype(np.int32)
+    return idx, mask  # numpy constants (jnp values must not be cached
+    #                   across traces — they would leak tracers)
+
+
+def big_mul_cols(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Uncarried column sums of ``a * b``: ``[..., na+nb]`` uint32.
+
+    Column k holds ``sum_{i+j=k} lo(a_i b_j) + sum_{i+j=k-1} hi(a_i b_j)``
+    < 2^21 for na = nb = 16.
+    """
+    na, nb = a.shape[-1], b.shape[-1]
+    prod = a[..., :, None] * b[..., None, :]  # [., na, nb]
+    lo = prod & MASK
+    hi = prod >> LIMB_BITS
+    idx_np, mask_np = _diag_idx(na, nb)
+    idx, mask = jnp.asarray(idx_np), jnp.asarray(mask_np)
+    K = na + nb - 1
+    bidx = jnp.broadcast_to(idx, (*prod.shape[:-2], na, K))
+    lo_d = (jnp.take_along_axis(lo, bidx, axis=-1) * mask).sum(axis=-2)
+    hi_d = (jnp.take_along_axis(hi, bidx, axis=-1) * mask).sum(axis=-2)
+    zero = jnp.zeros((*lo_d.shape[:-1], 1), jnp.uint32)
+    return (jnp.concatenate([lo_d, zero], axis=-1)
+            + jnp.concatenate([zero, hi_d], axis=-1))
+
 
 class FieldP(Mod):
-    """The base field F_P; adds sqrt (P ≡ 3 mod 4)."""
+    """The base field F_P: fast relaxed arithmetic + sqrt (P ≡ 3 mod 4)."""
 
     def __init__(self):
         super().__init__(P, n_folds=3)
+        # constant for branchless subtraction: a - b ≡
+        #   a + (0xFFFF - b) + (2^256 - 2*delta + 1)  (mod P), see sub()
+        self._subc_np = int_to_limbs((1 << 256) - 2 * ((1 << 256) - P) + 1)
+
+    # -- the shared reduction tail ---------------------------------------
+
+    def _reduce_cols(self, cols: jnp.ndarray) -> jnp.ndarray:
+        """Columns (each < 2^31, width <= 32) -> relaxed 16-limb value.
+
+        Bound contract: the two fold iterations below stay under 2^32
+        when input columns are < 2^21 (multiplication) or < 2^19
+        (add/sub/mul_small); see the inline bounds.
+        """
+        # fold columns >= 16 into the low 16 via delta = 2^32 + 977
+        while cols.shape[-1] > 16:
+            lo = cols[..., :16]
+            hi = cols[..., 16:]
+            h = hi.shape[-1]
+            ext = max(h + 2 - 16, 0)
+            if ext:
+                lo = jnp.concatenate(
+                    [lo, jnp.zeros((*lo.shape[:-1], ext), jnp.uint32)],
+                    axis=-1)
+            # col j   += 977 * hi_j   (j < h;    977*2^21 < 2^31)
+            # col j+2 += hi_j         (2^21)
+            lo = lo.at[..., :h].add(hi * jnp.uint32(977))
+            lo = lo.at[..., 2 : 2 + h].add(hi)
+            cols = lo
+        # first full carry: 16 columns < 2^32 -> limbs + c_top < 2^16+eps
+        out = []
+        c = jnp.zeros(cols.shape[:-1], jnp.uint32)
+        for k in range(16):
+            t = cols[..., k] + c
+            out.append(t & MASK)
+            c = t >> LIMB_BITS
+        # fold c_top * 2^256 ≡ c_top * delta
+        out[0] = out[0] + c * jnp.uint32(977)  # < 2^16 + 2^26
+        out[2] = out[2] + c
+        # second full carry
+        c = jnp.zeros_like(c)
+        for k in range(16):
+            t = out[k] + c
+            out[k] = t & MASK
+            c = t >> LIMB_BITS
+        # possible final wrap: value was < 2^256 + 2^49, so if c == 1 the
+        # remaining limbs above index 3 are zero and a 5-step chain closes
+        out[0] = out[0] + c * jnp.uint32(977)
+        out[2] = out[2] + c
+        cc = jnp.zeros_like(c)
+        for k in range(5):
+            t = out[k] + cc
+            out[k] = t & MASK
+            cc = t >> LIMB_BITS
+        return jnp.stack(out, axis=-1)
+
+    # -- relaxed ops ------------------------------------------------------
+
+    def mul(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        return self._reduce_cols(big_mul_cols(a, b))
+
+    def sqr(self, a: jnp.ndarray) -> jnp.ndarray:
+        return self.mul(a, a)
+
+    def add(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        return self._reduce_cols(a + b)  # cols < 2^17
+
+    def sub(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        """Branchless: a + (0xFFFF - b) + C where C = 2^256 - 2*delta + 1,
+        so the column value is a - b + 2P >= 0 — no borrow chain."""
+        comp = jnp.uint32(MASK) - b
+        subc = jnp.broadcast_to(jnp.asarray(self._subc_np), a.shape)
+        return self._reduce_cols(a + comp + subc)  # cols < 3*2^16
+
+    def neg(self, a: jnp.ndarray) -> jnp.ndarray:
+        return self.sub(jnp.zeros_like(a), a)
+
+    def mul_small(self, a: jnp.ndarray, k: int) -> jnp.ndarray:
+        assert k < 16
+        return self._reduce_cols(a * jnp.uint32(k))  # cols < 2^20
+
+    # -- canonicalization ------------------------------------------------
+
+    def canon(self, a: jnp.ndarray) -> jnp.ndarray:
+        """Relaxed [0, 2^256) -> canonical [0, P): one conditional
+        subtract (2^256 - P < P, so one is always enough)."""
+        return self._cond_sub_m(a)
+
+    def is_zero_mod(self, a: jnp.ndarray) -> jnp.ndarray:
+        """a ≡ 0 (mod P) for relaxed a: value is exactly 0 or P."""
+        return (is_zero(a) | eq(a, jnp.broadcast_to(self.m_limbs, a.shape)))
+
+    def eq_mod(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        return eq(self.canon(a), self.canon(b))
 
     def sqrt(self, a: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
         """Square root via ``a^((P+1)/4)``.  Returns (root, exists_flag)."""
         r = self.pow_const(a, (P + 1) // 4)
-        ok = eq(self.sqr(r), a)
+        ok = self.eq_mod(self.sqr(r), a)
         return r, ok
 
 

@@ -3,28 +3,32 @@
 The reference computes Keccak-256 with amd64 assembly on the host
 (ref: crypto/sha3/keccakf_amd64.s, fronted by crypto/crypto.go:43
 Keccak256).  On TPU there is no 64-bit integer datapath, so each 64-bit
-lane of the 5x5 Keccak state is a **pair of uint32 words** ``(lo, hi)``;
-all of theta/rho/pi/chi/iota decompose into 32-bit XOR/AND/NOT/shifts,
-which the VPU executes lane-parallel over the batch dimension.
+lane of the 5x5 Keccak state is a **pair of uint32 words** ``(lo, hi)``,
+and the whole 25-lane state is a pair of ``[..., 25]`` uint32 arrays.
 
-Rotation amounts and round constants are trace-time Python constants, so
-the 24 rounds unroll into straight-line vector code — no data-dependent
-control flow, fixed shapes, arbitrary leading batch dims.
+theta/rho/pi/chi are expressed as lane-axis rolls, constant-index
+gathers, and per-lane constant-amount rotations — so one round is ~60
+vector ops and the 24 rounds run in a single `lax.fori_loop` (the
+round constant indexed per iteration).  This keeps the compiled graph
+tiny (the fully unrolled scalar form trips XLA CPU's slow-compile
+alarm) while the VPU still sees wide elementwise work: batch x 25 lanes.
 
 Primary in-graph consumer: pubkey -> address (``keccak256(x || y)[12:]``)
 at the tail of batched ecrecover (ref: crypto/signature_cgo.go:31 +
-crypto/crypto.go:194), which keeps the whole sender-recovery hot path
+crypto/crypto.go:194), keeping the whole sender-recovery hot path
 (SURVEY §3.5) on-device.  Fixed input length per call site; multi-block
-absorption is unrolled at trace time for lengths >= the 136-byte rate.
+absorption unrolls at trace time.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 RATE = 136  # bytes, Keccak-256 (capacity 512)
 
-_RC = [
+_RC = np.array([
     0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
     0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
     0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
@@ -33,75 +37,85 @@ _RC = [
     0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
     0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
     0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
-]
+], dtype=np.uint64)
+_RC_LO = jnp.asarray((_RC & 0xFFFFFFFF).astype(np.uint32))
+_RC_HI = jnp.asarray((_RC >> 32).astype(np.uint32))
 
-# rho rotation offsets, indexed [x][y] (column-major state layout A[x,y])
-_ROT = [
+# lane index l = x + 5*y
+_X = np.arange(25) % 5
+_Y = np.arange(25) // 5
+
+# rho rotation offsets per lane (ref layout: offset[x][y])
+_ROT_TBL = np.array([
     [0, 36, 3, 41, 18],
     [1, 44, 10, 45, 2],
     [62, 6, 43, 15, 61],
     [28, 55, 25, 21, 56],
     [27, 20, 39, 8, 14],
-]
+])
+# pi: B[y + 5*((2x+3y)%5)] = rot(A[x+5y], ROT[x][y]).  Express as a
+# gather: for destination lane dl, SRC[dl] is the source lane and
+# ROT[dl] the rotation applied.
+_PI_SRC = np.zeros(25, np.int32)
+_PI_ROT = np.zeros(25, np.int32)
+for _x in range(5):
+    for _y in range(5):
+        _dl = _y + 5 * ((2 * _x + 3 * _y) % 5)
+        _PI_SRC[_dl] = _x + 5 * _y
+        _PI_ROT[_dl] = _ROT_TBL[_x][_y]
 
-_M32 = jnp.uint32(0xFFFFFFFF)
 
-
-def _rotl64(lo, hi, r: int):
-    """Rotate a (lo, hi) uint32 pair left by a constant r in [0, 64)."""
-    r %= 64
-    if r == 0:
-        return lo, hi
-    if r == 32:
-        return hi, lo
-    if r > 32:
-        lo, hi = hi, lo
-        r -= 32
-    nl = ((lo << r) | (hi >> (32 - r))) & _M32
-    nh = ((hi << r) | (lo >> (32 - r))) & _M32
+def _rotl_pairs(lo, hi, amounts: np.ndarray):
+    """Rotate 64-bit (lo, hi) pairs left by per-lane CONSTANT amounts."""
+    r = amounts % 64
+    swap = r >= 32
+    rr = jnp.asarray((r % 32).astype(np.uint32))
+    l0 = jnp.where(jnp.asarray(swap), hi, lo)
+    h0 = jnp.where(jnp.asarray(swap), lo, hi)
+    # rr == 0 lanes must not shift by 32
+    nz = jnp.asarray((r % 32 != 0))
+    inv = jnp.asarray(((32 - (r % 32)) % 32).astype(np.uint32))
+    nl = jnp.where(nz, (l0 << rr) | (h0 >> inv), l0)
+    nh = jnp.where(nz, (h0 << rr) | (l0 >> inv), h0)
     return nl, nh
 
 
-def _keccak_f(lanes_lo, lanes_hi):
-    """Keccak-f[1600] permutation on lists of 25 lane pairs.
+def _keccak_f(lo: jnp.ndarray, hi: jnp.ndarray):
+    """Keccak-f[1600]: state as ``[..., 25]`` uint32 pairs."""
 
-    ``lanes_lo/hi[x + 5*y]`` are batched uint32 arrays.
-    """
-    A_lo = list(lanes_lo)
-    A_hi = list(lanes_hi)
-    for rnd in range(24):
+    def round_fn(rnd, state):
+        lo, hi = state
         # theta
-        C_lo = [A_lo[x] ^ A_lo[x + 5] ^ A_lo[x + 10] ^ A_lo[x + 15] ^ A_lo[x + 20]
-                for x in range(5)]
-        C_hi = [A_hi[x] ^ A_hi[x + 5] ^ A_hi[x + 10] ^ A_hi[x + 15] ^ A_hi[x + 20]
-                for x in range(5)]
-        for x in range(5):
-            rl, rh = _rotl64(C_lo[(x + 1) % 5], C_hi[(x + 1) % 5], 1)
-            d_lo = C_lo[(x + 4) % 5] ^ rl
-            d_hi = C_hi[(x + 4) % 5] ^ rh
-            for y in range(5):
-                A_lo[x + 5 * y] = A_lo[x + 5 * y] ^ d_lo
-                A_hi[x + 5 * y] = A_hi[x + 5 * y] ^ d_hi
-        # rho + pi
-        B_lo = [None] * 25
-        B_hi = [None] * 25
-        for x in range(5):
-            for y in range(5):
-                nl, nh = _rotl64(A_lo[x + 5 * y], A_hi[x + 5 * y], _ROT[x][y])
-                B_lo[y + 5 * ((2 * x + 3 * y) % 5)] = nl
-                B_hi[y + 5 * ((2 * x + 3 * y) % 5)] = nh
-        # chi
-        for y in range(5):
-            row_lo = [B_lo[x + 5 * y] for x in range(5)]
-            row_hi = [B_hi[x + 5 * y] for x in range(5)]
-            for x in range(5):
-                A_lo[x + 5 * y] = row_lo[x] ^ (~row_lo[(x + 1) % 5] & row_lo[(x + 2) % 5])
-                A_hi[x + 5 * y] = row_hi[x] ^ (~row_hi[(x + 1) % 5] & row_hi[(x + 2) % 5])
+        grid_lo = lo.reshape(*lo.shape[:-1], 5, 5)  # [..., y, x]
+        grid_hi = hi.reshape(*hi.shape[:-1], 5, 5)
+        c_lo = jax.lax.reduce(grid_lo, jnp.uint32(0), jax.lax.bitwise_xor,
+                              [grid_lo.ndim - 2])
+        c_hi = jax.lax.reduce(grid_hi, jnp.uint32(0), jax.lax.bitwise_xor,
+                              [grid_hi.ndim - 2])
+        rot_lo = (c_lo << 1) | (c_hi >> 31)
+        rot_hi = (c_hi << 1) | (c_lo >> 31)
+        d_lo = jnp.roll(c_lo, 1, axis=-1) ^ jnp.roll(rot_lo, -1, axis=-1)
+        d_hi = jnp.roll(c_hi, 1, axis=-1) ^ jnp.roll(rot_hi, -1, axis=-1)
+        lo = lo ^ jnp.tile(d_lo, (*([1] * (d_lo.ndim - 1)), 5))
+        hi = hi ^ jnp.tile(d_hi, (*([1] * (d_hi.ndim - 1)), 5))
+        # rho + pi (constant gather + constant-amount rotations)
+        src = jnp.asarray(_PI_SRC)
+        b_lo = jnp.take(lo, src, axis=-1)
+        b_hi = jnp.take(hi, src, axis=-1)
+        b_lo, b_hi = _rotl_pairs(b_lo, b_hi, _PI_ROT)
+        # chi: A[x] = B[x] ^ (~B[x+1] & B[x+2]) along each row of 5
+        g_lo = b_lo.reshape(*b_lo.shape[:-1], 5, 5)
+        g_hi = b_hi.reshape(*b_hi.shape[:-1], 5, 5)
+        lo = (g_lo ^ (~jnp.roll(g_lo, -1, axis=-1)
+                      & jnp.roll(g_lo, -2, axis=-1))).reshape(lo.shape)
+        hi = (g_hi ^ (~jnp.roll(g_hi, -1, axis=-1)
+                      & jnp.roll(g_hi, -2, axis=-1))).reshape(hi.shape)
         # iota
-        rc = _RC[rnd]
-        A_lo[0] = A_lo[0] ^ jnp.uint32(rc & 0xFFFFFFFF)
-        A_hi[0] = A_hi[0] ^ jnp.uint32(rc >> 32)
-    return A_lo, A_hi
+        lo = lo.at[..., 0].set(lo[..., 0] ^ _RC_LO[rnd])
+        hi = hi.at[..., 0].set(hi[..., 0] ^ _RC_HI[rnd])
+        return lo, hi
+
+    return jax.lax.fori_loop(0, 24, round_fn, (lo, hi))
 
 
 def keccak256_fixed(data: jnp.ndarray) -> jnp.ndarray:
@@ -119,30 +133,30 @@ def keccak256_fixed(data: jnp.ndarray) -> jnp.ndarray:
     pad = jnp.zeros((*batch, padded_len - L), jnp.uint8)
     buf = jnp.concatenate([data, pad], axis=-1)
     buf = buf.at[..., L].set(jnp.uint8(0x01))
-    buf = buf.at[..., padded_len - 1].set(buf[..., padded_len - 1] | jnp.uint8(0x80))
+    buf = buf.at[..., padded_len - 1].set(buf[..., padded_len - 1]
+                                          | jnp.uint8(0x80))
 
-    zeros = jnp.zeros(batch, jnp.uint32)
-    A_lo = [zeros] * 25
-    A_hi = [zeros] * 25
+    lo = jnp.zeros((*batch, 25), jnp.uint32)
+    hi = jnp.zeros((*batch, 25), jnp.uint32)
     b32 = buf.astype(jnp.uint32)
+    words = b32.reshape(*batch, nblocks, RATE // 4, 4)
+    lanes = (words[..., 0] | (words[..., 1] << 8) | (words[..., 2] << 16)
+             | (words[..., 3] << 24))  # [..., nblocks, 34] LE 32-bit words
     for blk in range(nblocks):
-        off = blk * RATE
-        for lane in range(RATE // 8):
-            base = off + 8 * lane
-            lo = (b32[..., base] | (b32[..., base + 1] << 8)
-                  | (b32[..., base + 2] << 16) | (b32[..., base + 3] << 24))
-            hi = (b32[..., base + 4] | (b32[..., base + 5] << 8)
-                  | (b32[..., base + 6] << 16) | (b32[..., base + 7] << 24))
-            A_lo[lane] = A_lo[lane] ^ lo
-            A_hi[lane] = A_hi[lane] ^ hi
-        A_lo, A_hi = _keccak_f(A_lo, A_hi)
+        w = lanes[..., blk, :]  # [..., 34]
+        blo = w[..., 0::2]      # 17 lanes' low words
+        bhi = w[..., 1::2]
+        lo = lo.at[..., :17].set(lo[..., :17] ^ blo)
+        hi = hi.at[..., :17].set(hi[..., :17] ^ bhi)
+        lo, hi = _keccak_f(lo, hi)
 
-    out = []
-    for lane in range(4):  # 32 bytes = 4 lanes
-        for word in (A_lo[lane], A_hi[lane]):
-            for shift in (0, 8, 16, 24):
-                out.append(((word >> shift) & 0xFF).astype(jnp.uint8))
-    return jnp.stack(out, axis=-1)
+    # squeeze 32 bytes = lanes 0..3
+    out_words = jnp.stack([lo[..., 0], hi[..., 0], lo[..., 1], hi[..., 1],
+                           lo[..., 2], hi[..., 2], lo[..., 3], hi[..., 3]],
+                          axis=-1)  # [..., 8] u32 LE
+    shifts = jnp.asarray([0, 8, 16, 24], jnp.uint32)
+    out = ((out_words[..., :, None] >> shifts) & 0xFF).astype(jnp.uint8)
+    return out.reshape(*batch, 32)
 
 
 def pubkey_to_address(qx_bytes: jnp.ndarray, qy_bytes: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,221 @@
+"""JSON-RPC server: the user-facing API layer.
+
+Covers the capability role of the reference's ``rpc/`` + ``internal/
+ethapi`` stack (ref: rpc/server.go, internal/ethapi/api.go:489+) for
+the Geec path, plus the ``thw`` namespace the engine registers
+(ref: consensus/geec/geec.go:450-457).  JSON-RPC 2.0 over HTTP on
+asyncio streams — no external web framework, single event loop shared
+with the consensus node.
+
+Methods:
+  eth_blockNumber, eth_getBlockByNumber, eth_getBlockByHash,
+  eth_sendRawTransaction, net_version, web3_clientVersion,
+  thw_register, thw_membership, thw_status, thw_pendingGeecTxns
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from eges_tpu.core import rlp
+from eges_tpu.core.types import Block, Transaction
+
+
+def _hex(n: int) -> str:
+    return hex(n)
+
+
+def _block_json(b: Block, full: bool) -> dict:
+    h = b.header
+    return {
+        "number": _hex(h.number),
+        "hash": "0x" + b.hash.hex(),
+        "parentHash": "0x" + h.parent_hash.hex(),
+        "stateRoot": "0x" + h.root.hex(),
+        "transactionsRoot": "0x" + h.tx_hash.hex(),
+        "receiptsRoot": "0x" + h.receipt_hash.hex(),
+        "miner": "0x" + h.coinbase.hex(),
+        "difficulty": _hex(h.difficulty),
+        "gasLimit": _hex(h.gas_limit),
+        "gasUsed": _hex(h.gas_used),
+        "timestamp": _hex(h.time),
+        "extraData": "0x" + h.extra.hex(),
+        "trustRand": _hex(h.trust_rand),
+        "registrations": [
+            {"account": "0x" + r.account.hex(), "ip": r.ip, "port": r.port,
+             "renew": r.renew} for r in h.regs],
+        "geecTxnCount": len(b.geec_txns),
+        "fakeTxnCount": len(b.fake_txns),
+        "confirm": None if b.confirm is None else {
+            "blockNumber": b.confirm.block_number,
+            "hash": "0x" + b.confirm.hash.hex(),
+            "confidence": b.confirm.confidence,
+            "supporters": ["0x" + s.hex() for s in b.confirm.supporters],
+            "emptyBlock": b.confirm.empty_block,
+        },
+        "transactions": (
+            [_txn_json(t) for t in b.transactions] if full
+            else ["0x" + t.hash.hex() for t in b.transactions]),
+    }
+
+
+def _txn_json(t: Transaction) -> dict:
+    return {
+        "hash": "0x" + t.hash.hex(),
+        "nonce": _hex(t.nonce),
+        "gasPrice": _hex(t.gas_price),
+        "gas": _hex(t.gas_limit),
+        "to": None if t.to is None else "0x" + t.to.hex(),
+        "value": _hex(t.value),
+        "input": "0x" + t.payload.hex(),
+        "isGeec": t.is_geec,
+        "v": _hex(t.v), "r": _hex(t.r), "s": _hex(t.s),
+    }
+
+
+class RpcError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class RpcServer:
+    def __init__(self, chain, node=None, txpool=None, *,
+                 bind_ip: str = "127.0.0.1", port: int = 8545,
+                 chain_id: int = 930412):
+        self.chain = chain
+        self.node = node
+        self.txpool = txpool
+        self.bind_ip = bind_ip
+        self.port = port
+        self.chain_id = chain_id
+        self._server = None
+
+    # -- method handlers --------------------------------------------------
+
+    def _resolve_block(self, tag) -> Block | None:
+        if tag in ("latest", "pending", None):
+            return self.chain.head()
+        if tag == "earliest":
+            return self.chain.get_block_by_number(0)
+        return self.chain.get_block_by_number(int(tag, 16))
+
+    def dispatch(self, method: str, params: list):
+        if method == "eth_blockNumber":
+            return _hex(self.chain.height())
+        if method == "eth_getBlockByNumber":
+            blk = self._resolve_block(params[0])
+            full = bool(params[1]) if len(params) > 1 else False
+            return None if blk is None else _block_json(blk, full)
+        if method == "eth_getBlockByHash":
+            blk = self.chain.get_block(bytes.fromhex(params[0][2:]))
+            full = bool(params[1]) if len(params) > 1 else False
+            return None if blk is None else _block_json(blk, full)
+        if method == "eth_sendRawTransaction":
+            if self.txpool is None:
+                raise RpcError(-32000, "no transaction pool")
+            raw = bytes.fromhex(params[0][2:])
+            try:
+                txn = Transaction.decode(raw)
+            except rlp.RLPError as e:
+                raise RpcError(-32602, f"invalid transaction RLP: {e}")
+            self.txpool.add_remotes([txn])
+            return "0x" + txn.hash.hex()
+        if method == "net_version":
+            return str(self.chain_id)
+        if method == "web3_clientVersion":
+            return "eges-tpu/0.1.0"
+        if method == "thw_register":
+            # (ref: consensus/geec/api.go Register)
+            if self.node is None:
+                raise RpcError(-32000, "no consensus node")
+            self.node._start_registration(renew=0)
+            return True
+        if method == "thw_membership":
+            if self.node is None:
+                raise RpcError(-32000, "no consensus node")
+            return [{"account": "0x" + m.addr.hex(), "ip": m.ip,
+                     "port": m.port, "ttl": m.ttl,
+                     "joinedBlock": m.joined_block}
+                    for m in self.node.membership.members()]
+        if method == "thw_status":
+            if self.node is None:
+                raise RpcError(-32000, "no consensus node")
+            return {
+                "height": self.chain.height(),
+                "workingBlock": self.node.wb.blk_num,
+                "maxConfirmed": self.node.max_confirmed_block,
+                "registered": self.node.registered,
+                "members": len(self.node.membership),
+                "pendingGeecTxns": len(self.node.pending_geec_txns),
+                "badBlocks": self.chain.bad_blocks,
+            }
+        if method == "thw_pendingGeecTxns":
+            if self.node is None:
+                raise RpcError(-32000, "no consensus node")
+            return len(self.node.pending_geec_txns)
+        raise RpcError(-32601, f"method {method} not found")
+
+    # -- JSON-RPC plumbing ------------------------------------------------
+
+    def _handle_body(self, body: bytes) -> bytes:
+        try:
+            req = json.loads(body)
+        except json.JSONDecodeError:
+            return json.dumps({"jsonrpc": "2.0", "id": None,
+                               "error": {"code": -32700,
+                                         "message": "parse error"}}).encode()
+        batch = isinstance(req, list)
+        reqs = req if batch else [req]
+        out = []
+        for r in reqs:
+            rid = r.get("id")
+            try:
+                result = self.dispatch(r.get("method", ""),
+                                       r.get("params", []) or [])
+                out.append({"jsonrpc": "2.0", "id": rid, "result": result})
+            except RpcError as e:
+                out.append({"jsonrpc": "2.0", "id": rid,
+                            "error": {"code": e.code, "message": e.message}})
+            except Exception as e:  # robustness: malformed params etc.
+                out.append({"jsonrpc": "2.0", "id": rid,
+                            "error": {"code": -32603, "message": str(e)}})
+        return json.dumps(out if batch else out[0]).encode()
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                # minimal HTTP/1.1 request parsing
+                line = await reader.readline()
+                if not line:
+                    break
+                headers = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                length = int(headers.get("content-length", 0))
+                body = await reader.readexactly(length) if length else b""
+                resp = self._handle_body(body)
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                    + f"Content-Length: {len(resp)}\r\n".encode()
+                    + b"Connection: keep-alive\r\n\r\n" + resp)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError):
+            pass
+        finally:
+            writer.close()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.bind_ip, self.port)
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.close()

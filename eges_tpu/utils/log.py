@@ -1,0 +1,62 @@
+"""Structured logging with the Geec extensions.
+
+The reference adds two log levels to log15 — ``LvlGeec`` and ``Gdbug``
+(ref: log/logger.go:23,85-88, log/root.go:63-68) — and emits its
+``--breakdown`` phase timings as log lines harvested by ``grep.py``
+(SURVEY §5: "observability is logging-first").  Same model here: stdlib
+logging with two custom levels between the standard ones, key=value
+formatting, and a helper the harness's grep-style assertions parse.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+# Between WARNING(30) and INFO(20), like the reference's ordering
+GEEC = 25
+GDBUG = 15
+
+logging.addLevelName(GEEC, "GEEC")
+logging.addLevelName(GDBUG, "GDBUG")
+
+
+def _fmt_kv(kwargs: dict) -> str:
+    return " ".join(f"{k}={v}" for k, v in kwargs.items())
+
+
+class GeecLogger(logging.LoggerAdapter):
+    """``log.geec("Elected", blk=5, version=0)`` -> ``GEEC Elected blk=5 ...``"""
+
+    def geec(self, msg: str, **kw) -> None:
+        self.logger.log(GEEC, "%s %s", msg, _fmt_kv(kw))
+
+    def gdbug(self, msg: str, **kw) -> None:
+        self.logger.log(GDBUG, "%s %s", msg, _fmt_kv(kw))
+
+    def info(self, msg: str, **kw) -> None:  # type: ignore[override]
+        self.logger.info("%s %s", msg, _fmt_kv(kw))
+
+    def warn(self, msg: str, **kw) -> None:
+        self.logger.warning("%s %s", msg, _fmt_kv(kw))
+
+    def breakdown(self, phase: str, dt: float, **kw) -> None:
+        """Phase timing lines (ref: '[Breakdown 1] Election time',
+        consensus/geec/geec.go:313-317)."""
+        self.logger.info("[Breakdown] %s time=%.6fs %s", phase, dt, _fmt_kv(kw))
+
+
+def get_logger(name: str, verbosity: int = 3,
+               stream=None) -> GeecLogger:
+    """Verbosity mapping follows geth --verbosity: 1=error..5=trace."""
+    level = {1: logging.ERROR, 2: logging.WARNING, 3: GEEC,
+             4: logging.DEBUG, 5: 1}.get(verbosity, GEEC)
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    if not logger.handlers:
+        h = logging.StreamHandler(stream or sys.stdout)
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)-5s %(name)s %(message)s",
+            datefmt="%H:%M:%S"))
+        logger.addHandler(h)
+    return GeecLogger(logger, {})

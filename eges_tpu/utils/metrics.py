@@ -1,0 +1,143 @@
+"""Metrics registry: counters / gauges / meters / timers.
+
+Role parity with the reference's ``metrics/`` fork (ref:
+metrics/metrics.go:25 ``--metrics`` flag; instrumented in p2p/metrics.go,
+eth/metrics.go, eth/downloader/metrics.go).  In-process registry with
+snapshot export; the RPC layer and harness read snapshots instead of the
+reference's influxdb/librato push exporters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+
+class Meter:
+    """Event rate: count + rate over the process lifetime and a 1-minute
+    sliding window."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self.count = 0
+        self._start = clock()
+        self._window: list[tuple[float, int]] = []
+
+    def mark(self, n: int = 1) -> None:
+        self.count += n
+        now = self._clock()
+        self._window.append((now, n))
+        cutoff = now - 60.0
+        while self._window and self._window[0][0] < cutoff:
+            self._window.pop(0)
+
+    @property
+    def rate_mean(self) -> float:
+        dt = self._clock() - self._start
+        return self.count / dt if dt > 0 else 0.0
+
+    @property
+    def rate_1m(self) -> float:
+        return sum(n for _, n in self._window) / 60.0
+
+
+class Timer:
+    """Duration accumulator with count/total/min/max/mean."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def update(self, seconds: float) -> None:
+        self.count += 1
+        self.total += seconds
+        self.min = min(self.min, seconds)
+        self.max = max(self.max, seconds)
+
+    def time(self):
+        t0 = self._clock()
+        timer = self
+
+        class _Ctx:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                timer.update(timer._clock() - t0)
+
+        return _Ctx()
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def _get(self, name: str, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls()
+                self._metrics[name] = m
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def meter(self, name: str) -> Meter:
+        return self._get(name, Meter)
+
+    def timer(self, name: str) -> Timer:
+        return self._get(name, Timer)
+
+    def snapshot(self) -> dict:
+        out = {}
+        with self._lock:
+            for name, m in sorted(self._metrics.items()):
+                if isinstance(m, Counter):
+                    out[name] = m.value
+                elif isinstance(m, Gauge):
+                    out[name] = m.value
+                elif isinstance(m, Meter):
+                    out[name] = {"count": m.count,
+                                 "rate_mean": round(m.rate_mean, 3),
+                                 "rate_1m": round(m.rate_1m, 3)}
+                elif isinstance(m, Timer):
+                    out[name] = {"count": m.count,
+                                 "mean_s": round(m.mean, 6),
+                                 "max_s": round(m.max, 6)}
+        return out
+
+
+DEFAULT = Registry()

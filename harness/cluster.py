@@ -1,0 +1,182 @@
+"""Local-cluster harness: the reference's ``test.py``/``start.py``/
+``kill.py``/``grep.py`` workflow for this build.
+
+Spawns N real node processes on localhost (distinct port triples like
+the reference's 619NN/81NN/100NN scheme, ref: test.py), generates keys
+and the genesis ``thw`` bootstrap section, tails logs, and asserts chain
+liveness the same way the authors did (grep the logs — SURVEY §4 "logs
+as the oracle").
+
+Usage:
+    python harness/cluster.py start --nodes 3 --dir /tmp/geec-cluster
+    python harness/cluster.py status --dir /tmp/geec-cluster
+    python harness/cluster.py kill --dir /tmp/geec-cluster
+    python harness/cluster.py soak --nodes 3 --dir /tmp/geec-soak --seconds 60
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from eges_tpu.crypto import secp256k1 as secp  # noqa: E402
+
+GOSSIP_BASE = 6190   # ref test.py port scheme
+CONSENSUS_BASE = 8100
+TXN_BASE = 10000
+
+
+def node_key(i: int) -> bytes:
+    return bytes([i + 1]) * 32
+
+
+def write_genesis(path: str, n: int, *, validate_timeout_ms=500,
+                  election_timeout_ms=100, backoff_ms=0,
+                  reg_timeout_s=10) -> None:
+    boot = []
+    for i in range(n):
+        addr = secp.pubkey_to_address(secp.privkey_to_pubkey(node_key(i)))
+        boot.append({"account": addr.hex(), "ip": "127.0.0.1",
+                     "port": str(CONSENSUS_BASE + i)})
+    doc = {
+        "config": {
+            "chainId": 930412,
+            "thw": {
+                "bootstrap": boot,
+                "reg_per_blk": 10,
+                "registration_timeout": reg_timeout_s,
+                "validate_timeout": validate_timeout_ms,
+                "election_timeout": election_timeout_ms,
+                "backoff_time": backoff_ms,
+            },
+        },
+        "timestamp": "0x0",
+        "extraData": "geec-tpu-cluster",
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+
+
+def start_cluster(dirpath: str, n: int, *, txn_per_block=100, txn_size=100,
+                  block_timeout=20.0, mine=True, extra_args=()) -> list[int]:
+    os.makedirs(dirpath, exist_ok=True)
+    genesis = os.path.join(dirpath, "genesis.json")
+    write_genesis(genesis, n)
+    peers = ",".join(f"127.0.0.1:{GOSSIP_BASE + i}" for i in range(n))
+    pids = []
+    for i in range(n):
+        datadir = os.path.join(dirpath, f"node{i}")
+        log_path = os.path.join(dirpath, f"node{i}.log")
+        cmd = [
+            sys.executable, "-m", "eges_tpu.node",
+            "--datadir", datadir, "--genesis", genesis,
+            "--keyhex", node_key(i).hex(),
+            "--consensusIP", "127.0.0.1",
+            "--consensusPort", str(CONSENSUS_BASE + i),
+            "--gossipPort", str(GOSSIP_BASE + i),
+            "--geecTxnPort", str(TXN_BASE + i),
+            "--peers", peers,
+            "--txnPerBlock", str(txn_per_block),
+            "--txnSize", str(txn_size),
+            "--blockTimeout", str(block_timeout),
+            "--totalNodes", str(n),
+            "--breakdown",
+        ] + (["--mine"] if mine else []) + list(extra_args)
+        env = dict(os.environ, PYTHONPATH=REPO)
+        with open(log_path, "wb") as logf:
+            proc = subprocess.Popen(cmd, stdout=logf, stderr=subprocess.STDOUT,
+                                    env=env, cwd=REPO)
+        pids.append(proc.pid)
+    with open(os.path.join(dirpath, "pids"), "w") as f:
+        f.write("\n".join(map(str, pids)))
+    return pids
+
+
+def kill_cluster(dirpath: str) -> None:
+    """(ref: kill.py)"""
+    pid_file = os.path.join(dirpath, "pids")
+    if not os.path.exists(pid_file):
+        return
+    with open(pid_file) as f:
+        for line in f:
+            try:
+                os.kill(int(line.strip()), signal.SIGTERM)
+            except (ProcessLookupError, ValueError):
+                pass
+    os.remove(pid_file)
+
+
+_HEAD_RE = re.compile(r"head height=(\d+)")
+
+
+def node_heights(dirpath: str) -> list[int]:
+    """Log-grep liveness oracle (ref: grep.py + test-sep-2.sh)."""
+    heights = []
+    for name in sorted(os.listdir(dirpath)):
+        if not name.endswith(".log"):
+            continue
+        h = -1
+        with open(os.path.join(dirpath, name), "rb") as f:
+            for line in f.read().decode(errors="replace").splitlines():
+                m = _HEAD_RE.search(line)
+                if m:
+                    h = int(m.group(1))
+        heights.append(h)
+    return heights
+
+
+def soak(dirpath: str, n: int, seconds: float, **kw) -> bool:
+    """Liveness soak (ref: test-sep-2.sh's 5-min loop): chain must keep
+    advancing on every node."""
+    start_cluster(dirpath, n, **kw)
+    try:
+        deadline = time.time() + seconds
+        last = [-1] * n
+        while time.time() < deadline:
+            time.sleep(5)
+            cur = node_heights(dirpath)
+            print(f"[soak] heights={cur}")
+            last = cur
+        return all(h >= 3 for h in last)
+    finally:
+        kill_cluster(dirpath)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("cmd", choices=["start", "kill", "status", "soak"])
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--seconds", type=float, default=60)
+    ap.add_argument("--txnPerBlock", type=int, default=100)
+    ap.add_argument("--blockTimeout", type=float, default=20.0)
+    args = ap.parse_args()
+    if args.cmd == "start":
+        pids = start_cluster(args.dir, args.nodes,
+                             txn_per_block=args.txnPerBlock,
+                             block_timeout=args.blockTimeout)
+        print("started pids:", pids)
+    elif args.cmd == "kill":
+        kill_cluster(args.dir)
+        print("killed")
+    elif args.cmd == "status":
+        print("heights:", node_heights(args.dir))
+    elif args.cmd == "soak":
+        ok = soak(args.dir, args.nodes, args.seconds,
+                  txn_per_block=args.txnPerBlock,
+                  block_timeout=args.blockTimeout)
+        print("SOAK", "PASS" if ok else "FAIL")
+        sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

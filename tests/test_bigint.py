@@ -61,6 +61,8 @@ def test_big_mul_extremes():
 
 @pytest.mark.parametrize("mod,extremes", [(FP, EXTREMES_P), (FN, EXTREMES_N)])
 def test_mod_mul_add_sub(mod, extremes):
+    # FP's fast path produces RELAXED values (in [0, 2^256), == expected
+    # mod P); FN's generic path stays canonical.  Compare accordingly.
     vals_a, a = _rand_batch(mod.m, 12, extremes)
     vals_b, b = _rand_batch(mod.m, 12, list(reversed(extremes)))
     got_mul = mod.mul(a, b)
@@ -68,10 +70,31 @@ def test_mod_mul_add_sub(mod, extremes):
     got_sub = mod.sub(a, b)
     got_neg = mod.neg(a)
     for i in range(12):
-        assert limbs_to_int(got_mul[i]) == vals_a[i] * vals_b[i] % mod.m, i
-        assert limbs_to_int(got_add[i]) == (vals_a[i] + vals_b[i]) % mod.m, i
-        assert limbs_to_int(got_sub[i]) == (vals_a[i] - vals_b[i]) % mod.m, i
-        assert limbs_to_int(got_neg[i]) == (-vals_a[i]) % mod.m, i
+        for got, want in [(got_mul, vals_a[i] * vals_b[i]),
+                          (got_add, vals_a[i] + vals_b[i]),
+                          (got_sub, vals_a[i] - vals_b[i]),
+                          (got_neg, -vals_a[i])]:
+            v = limbs_to_int(got[i])
+            assert v % mod.m == want % mod.m, i
+            assert v < (1 << 256), i
+        assert limbs_to_int(mod.canon(got_mul)[i]) == (
+            vals_a[i] * vals_b[i]) % mod.m, i
+
+
+def test_fp_relaxed_inputs():
+    """FP ops must accept non-canonical inputs in [0, 2^256)."""
+    vals_a, a = _rand_batch(1 << 256, 8, [P, (1 << 256) - 1, 0])
+    vals_b, b = _rand_batch(1 << 256, 8, [(1 << 256) - 1, P, 1])
+    for got, want in [(FP.mul(a, b), lambda i: vals_a[i] * vals_b[i]),
+                      (FP.sub(a, b), lambda i: vals_a[i] - vals_b[i]),
+                      (FP.add(a, b), lambda i: vals_a[i] + vals_b[i])]:
+        for i in range(8):
+            v = limbs_to_int(got[i])
+            assert v % P == want(i) % P and v < (1 << 256), i
+    # zero detection across representatives 0 and P
+    z = jnp.asarray(np.stack([int_to_limbs(0), int_to_limbs(P),
+                              int_to_limbs(1)]))
+    assert np.asarray(FP.is_zero_mod(z)).tolist() == [1, 1, 0]
 
 
 @pytest.mark.parametrize("mod,extremes", [(FP, EXTREMES_P), (FN, EXTREMES_N)])
@@ -79,7 +102,10 @@ def test_mod_inv(mod, extremes):
     vals, a = _rand_batch(mod.m, 8, [1, mod.m - 1])
     inv = mod.inv(a)
     for i, v in enumerate(vals):
-        assert limbs_to_int(inv[i]) == pow(v, -1, mod.m), i
+        assert limbs_to_int(inv[i]) % mod.m == pow(v, -1, mod.m), i
+    binv = mod.batch_inv(a)
+    for i, v in enumerate(vals):
+        assert limbs_to_int(binv[i]) % mod.m == pow(v, -1, mod.m), i
 
 
 def test_sqrt():
@@ -88,7 +114,7 @@ def test_sqrt():
     root, ok = FP.sqrt(sq)
     assert np.all(np.asarray(ok) == 1)
     for i, v in enumerate(vals):
-        r = limbs_to_int(root[i])
+        r = limbs_to_int(root[i]) % P
         assert r == v % P or r == (P - v) % P, i
     # a known non-residue: 3 is a QR mod P? check explicitly via Euler
     nonres = next(x for x in range(2, 50) if pow(x, (P - 1) // 2, P) == P - 1)
@@ -101,7 +127,7 @@ def test_pow_const():
     e = 0xDEADBEEFCAFE1234567890
     got = FP.pow_const(a, e)
     for i, v in enumerate(vals):
-        assert limbs_to_int(got[i]) == pow(v, e, P), i
+        assert limbs_to_int(got[i]) % P == pow(v, e, P), i
 
 
 def test_predicates():

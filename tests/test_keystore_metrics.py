@@ -1,0 +1,61 @@
+"""Keystore (web3 v3 scrypt) and metrics-registry tests."""
+
+import secrets
+
+import pytest
+
+from eges_tpu.crypto import secp256k1 as secp
+from eges_tpu.crypto.keystore import (
+    Keystore, decrypt_key, encrypt_key, _aes128_encrypt_block,
+)
+from eges_tpu.utils.metrics import Registry
+
+
+def test_aes_fips197_vector():
+    ct = _aes128_encrypt_block(bytes(range(16)),
+                               bytes.fromhex("00112233445566778899aabbccddeeff"))
+    assert ct.hex() == "69c4e0d86a7b0430d8cdb78070b4c55a"
+
+
+def test_keystore_roundtrip(tmp_path):
+    ks = Keystore(str(tmp_path))
+    priv = secrets.token_bytes(32)
+    addr = ks.import_key(priv, "hunter2")
+    assert addr == secp.pubkey_to_address(secp.privkey_to_pubkey(priv))
+    assert ks.accounts() == [addr]
+    assert ks.get_key(addr, "hunter2") == priv
+    with pytest.raises(ValueError):
+        ks.get_key(addr, "wrong-password")
+    addr2 = ks.new_account("pw2")
+    assert len(ks.accounts()) == 2
+    assert len(ks.get_key(addr2, "pw2")) == 32
+
+
+def test_v3_dict_stability():
+    priv = secrets.token_bytes(32)
+    obj = encrypt_key(priv, "pw")
+    assert obj["version"] == 3
+    assert obj["crypto"]["kdf"] == "scrypt"
+    assert decrypt_key(obj, "pw") == priv
+
+
+def test_metrics_registry():
+    reg = Registry()
+    reg.counter("blocks").inc()
+    reg.counter("blocks").inc(2)
+    reg.gauge("height").set(7)
+    t = [0.0]
+    meter = reg.meter("txns")
+    meter._clock = lambda: t[0]
+    meter._start = 0.0
+    t[0] = 1.0
+    meter.mark(50)
+    timer = reg.timer("verify")
+    timer.update(0.25)
+    timer.update(0.75)
+    snap = reg.snapshot()
+    assert snap["blocks"] == 3
+    assert snap["height"] == 7
+    assert snap["txns"]["count"] == 50
+    assert snap["verify"]["count"] == 2
+    assert snap["verify"]["mean_s"] == 0.5

@@ -1,0 +1,59 @@
+"""Cross-checks: native C++ crypto vs the pure-Python golden model.
+
+Mirrors the role of libsecp256k1's own test harness
+(crypto/secp256k1/libsecp256k1/src/tests.c) for this build's native lib.
+Skipped when the library is not built (`make -C native`).
+"""
+
+import secrets
+
+import pytest
+
+from eges_tpu.crypto import native
+from eges_tpu.crypto import secp256k1 as s
+from eges_tpu.crypto.keccak import keccak256_py
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native lib not built")
+
+
+def test_keccak_matches_python():
+    for n in (0, 1, 135, 136, 137, 1000):
+        data = secrets.token_bytes(n)
+        assert native.keccak256(data) == keccak256_py(data)
+
+
+def test_sign_recover_verify_roundtrip_matches_golden():
+    for _ in range(8):
+        priv = secrets.token_bytes(32)
+        msg = secrets.token_bytes(32)
+        sig_n = native.ec_sign(msg, priv)
+        sig_p = s.ecdsa_sign_py(msg, priv)
+        assert sig_n == sig_p, "deterministic RFC6979 signatures must agree"
+        pub = s.privkey_to_pubkey_py(priv)
+        assert native.ec_pubkey(priv) == pub
+        assert native.ec_recover(msg, sig_n) == pub
+        assert native.ec_verify(msg, sig_n[:64], pub)
+        # wrong message fails
+        assert not native.ec_verify(secrets.token_bytes(32), sig_n[:64], pub)
+
+
+def test_recover_rejects_invalid():
+    with pytest.raises(ValueError):
+        native.ec_recover(bytes(32), bytes(64) + b"\x09")  # bad recid
+    with pytest.raises(ValueError):
+        native.ec_recover(bytes(32), bytes(65))  # r = s = 0
+
+
+def test_batch_recover():
+    import numpy as np
+
+    n = 16
+    hashes = b"".join(secrets.token_bytes(32) for _ in range(n))
+    privs = [secrets.token_bytes(32) for _ in range(n)]
+    sigs = b"".join(s.ecdsa_sign_py(hashes[32 * i:32 * i + 32], privs[i])
+                    for i in range(n))
+    pubs, ok = native.ec_recover_batch(hashes, sigs, n)
+    assert all(ok)
+    for i in range(n):
+        assert pubs[64 * i:64 * i + 64] == s.privkey_to_pubkey_py(privs[i])

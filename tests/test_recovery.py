@@ -13,7 +13,7 @@ from eges_tpu.core.chain import BlockChain, make_genesis
 from eges_tpu.core.types import (
     Block, ConfirmBlockMsg, Header, Transaction, new_block, EMPTY_ADDR,
 )
-from eges_tpu.crypto.verifier import batch_verify_txns
+from eges_tpu.crypto.verify_host import batch_verify_txns
 from eges_tpu.sim.cluster import SimCluster
 
 

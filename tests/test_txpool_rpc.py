@@ -1,0 +1,117 @@
+"""TxPool batching-window admission and JSON-RPC surface tests."""
+
+import json
+import secrets
+
+import pytest
+
+from eges_tpu.core.txpool import TxPool
+from eges_tpu.core.types import Transaction
+from eges_tpu.crypto import secp256k1 as host
+from eges_tpu.rpc.server import RpcServer, RpcError
+from eges_tpu.sim.cluster import SimCluster
+from eges_tpu.sim.simnet import SimClock
+
+
+def _signed(priv, nonce=0, cid=1):
+    return Transaction(nonce=nonce, gas_limit=21000, to=bytes(20),
+                       value=1).signed(priv, chain_id=cid)
+
+
+def test_txpool_window_batches_and_rejects():
+    clock = SimClock()
+    pool = TxPool(clock, verifier=None, window_ms=5, max_batch=8)
+    priv = secrets.token_bytes(32)
+    good = [_signed(priv, nonce=i) for i in range(3)]
+    bad = Transaction(nonce=9, v=29, r=1, s=1)  # malformed v
+    pool.add_remotes(good + [bad])
+    assert len(pool) == 0  # window not elapsed
+    clock.run_until(0.01)
+    assert len(pool) == 3
+    assert pool.stats["admitted"] == 3
+    assert pool.stats["rejected"] == 1
+    assert pool.stats["batches"] == 1
+    # duplicates ignored
+    pool.add_remotes(good)
+    clock.run_until(0.02)
+    assert pool.stats["duplicate"] >= 3
+
+    # full-batch flush happens immediately without waiting for the window
+    more = [_signed(priv, nonce=10 + i) for i in range(8)]
+    pool.add_remotes(more)
+    assert len(pool) == 11
+
+    pool.remove_included(good)
+    assert len(pool) == 8
+
+
+def test_txpool_txns_flow_into_blocks_and_verify():
+    c = SimCluster(3, txn_per_block=4, seed=21)
+    pool = TxPool(c.clock, verifier=None, window_ms=1)
+    c.nodes[0].node.txpool = pool
+    c.start()
+    priv = secrets.token_bytes(32)
+    txns = [_signed(priv, nonce=i) for i in range(3)]
+    pool.add_remotes(txns)
+    c.run(120, stop_condition=lambda: c.min_height() >= 8)
+    # the signed txns landed in some canonical block, rooted + verified
+    found = 0
+    chain = c.nodes[1].chain
+    for n in range(1, chain.height() + 1):
+        blk = chain.get_block_by_number(n)
+        found += len(blk.transactions)
+        for t in blk.transactions:
+            assert t.sender() == host.pubkey_to_address(
+                host.privkey_to_pubkey(priv))
+    assert found == 3
+    # included txns were removed from the pool
+    assert len(pool) == 0
+
+
+def test_rpc_dispatch():
+    c = SimCluster(3, txn_per_block=2, seed=2)
+    c.start()
+    c.run(60, stop_condition=lambda: c.min_height() >= 5)
+    node = c.nodes[0]
+    pool = TxPool(c.clock, verifier=None, window_ms=1)
+    rpc = RpcServer(node.chain, node=node.node, txpool=pool)
+
+    assert int(rpc.dispatch("eth_blockNumber", []), 16) >= 5
+    blk = rpc.dispatch("eth_getBlockByNumber", ["0x3", True])
+    assert blk["number"] == "0x3"
+    assert blk["confirm"] is not None
+    by_hash = rpc.dispatch("eth_getBlockByHash", [blk["hash"], False])
+    assert by_hash["number"] == "0x3"
+    assert rpc.dispatch("net_version", []) == "930412"
+
+    status = rpc.dispatch("thw_status", [])
+    assert status["height"] >= 5 and status["members"] == 3
+    members = rpc.dispatch("thw_membership", [])
+    assert len(members) == 3
+
+    tx = _signed(secrets.token_bytes(32))
+    h = rpc.dispatch("eth_sendRawTransaction", ["0x" + tx.encode().hex()])
+    assert h == "0x" + tx.hash.hex()
+    c.run(1)
+    assert len(pool) == 1
+
+    with pytest.raises(RpcError):
+        rpc.dispatch("eth_noSuchMethod", [])
+
+
+def test_rpc_http_body_handling():
+    c = SimCluster(3, txn_per_block=2, seed=2)
+    rpc = RpcServer(c.nodes[0].chain, node=c.nodes[0].node)
+    resp = json.loads(rpc._handle_body(json.dumps(
+        {"jsonrpc": "2.0", "id": 1, "method": "eth_blockNumber",
+         "params": []}).encode()))
+    assert resp["result"] == "0x0"
+    # batch + error paths
+    resp = json.loads(rpc._handle_body(json.dumps([
+        {"jsonrpc": "2.0", "id": 1, "method": "eth_blockNumber"},
+        {"jsonrpc": "2.0", "id": 2, "method": "nope"},
+    ]).encode()))
+    assert resp[0]["result"] == "0x0"
+    assert resp[1]["error"]["code"] == -32601
+    resp = json.loads(rpc._handle_body(b"not json"))
+    assert resp["error"]["code"] == -32700
