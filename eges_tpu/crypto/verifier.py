@@ -86,7 +86,6 @@ def make_sharded_ecrecover(mesh: jax.sharding.Mesh, axis: str = "dp"):
     (ref: core/geec_state.go:1184-1227 handleVerifyReplies), so counting
     valid signatures costs one scalar collective instead of a host gather.
     """
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as PS
 
     def shard_fn(sigs, hashes):
@@ -95,7 +94,7 @@ def make_sharded_ecrecover(mesh: jax.sharding.Mesh, axis: str = "dp"):
         return addrs, pubs, ok, tally
 
     return jax.jit(
-        shard_map(
+        jax.shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(PS(axis), PS(axis)),

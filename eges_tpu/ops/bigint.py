@@ -239,7 +239,11 @@ class Mod:
         """``a ** e mod m`` for a constant exponent, via a rolled bit loop."""
         nbits = e.bit_length()
         bits = jnp.asarray([(e >> i) & 1 for i in range(nbits)], dtype=jnp.uint32)
-        one = jnp.broadcast_to(jnp.asarray(int_to_limbs(1)), a.shape)
+        # Derive the constant from ``a`` (a*0 + 1) so its varying-axes type
+        # matches ``a`` under shard_map: a fori_loop carry must keep a
+        # consistent type across iterations (mixing an unvarying constant
+        # with a device-varying base trips the vma check).
+        one = a * 0 + jnp.asarray(int_to_limbs(1))
 
         def body(i, state):
             result, base = state

@@ -43,7 +43,10 @@ SEVEN = 7
 
 
 def _const(x: int, like: jnp.ndarray) -> jnp.ndarray:
-    return jnp.broadcast_to(jnp.asarray(int_to_limbs(x)), like.shape)
+    # ``like*0 + const`` (not broadcast_to) so the result's varying-axes
+    # type matches ``like`` under shard_map — these constants seed fori_loop
+    # carries (strauss accumulators), which must keep a consistent type.
+    return like * 0 + jnp.asarray(int_to_limbs(x))
 
 
 # A Jacobian point batch is the tuple (X, Y, Z), each [..., 16] uint32.
