@@ -1,4 +1,4 @@
-"""Benchmark: batched secp256k1 ecrecover throughput on one chip.
+"""Benchmark: batched secp256k1 ecrecover throughput + latency on one chip.
 
 The BASELINE.json primary metric — secp256k1 verifies/sec/chip — measured
 on whatever accelerator JAX finds (the driver runs this on a real TPU).
@@ -6,13 +6,21 @@ The CPU reference point is the single-threaded cgo ecrecover path the
 fork serializes every transaction through (~12-20k/s/core class,
 BASELINE.md), so ``vs_baseline`` is throughput / 16k.
 
+The workload is honest: real signatures (so the verifier does full work),
+plus a sprinkling of invalid rows (corrupted s, bad recovery id) so the
+masking path is part of the measured graph — and their rejection is
+asserted, as is address correctness vs the independent host model.
+Also reports p50/p99 latency at the 1024-row operating point
+(BASELINE.md: <50 ms p50 @ 1k validators).
+
 Prints exactly ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 """
 
 from __future__ import annotations
 
 import json
+import os
 import secrets
 import sys
 import time
@@ -22,49 +30,127 @@ sys.path.insert(0, __file__.rsplit("/", 1)[0])
 CPU_BASELINE_VERIFIES_PER_S = 16_000.0  # mid of 12-20k/s/core (BASELINE.md)
 
 
-def main() -> None:
+def _make_workload(batch: int, invalid_every: int = 17):
+    """Signatures + hashes with one invalid row per ``invalid_every``."""
     import numpy as np
-    import jax
-
     from eges_tpu.crypto import secp256k1 as host
-    from eges_tpu.crypto.verifier import ecrecover_batch
 
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
-    # deterministic workload: real signatures so the verifier does full work
-    rng_msgs = [secrets.token_bytes(32) for _ in range(64)]
-    privs = [secrets.token_bytes(32) for _ in range(64)]
+    n_keys = 64
+    msgs = [secrets.token_bytes(32) for _ in range(n_keys)]
+    privs = [secrets.token_bytes(32) for _ in range(n_keys)]
+    sig_cache = [np.frombuffer(host.ecdsa_sign(m, p), np.uint8)
+                 for m, p in zip(msgs, privs)]
+    addr_cache = [host.pubkey_to_address(host.privkey_to_pubkey(p))
+                  for p in privs]
+
     sigs = np.zeros((batch, 65), np.uint8)
     hashes = np.zeros((batch, 32), np.uint8)
-    expect = []
+    valid = np.ones(batch, bool)
+    expect = [b""] * batch
     for i in range(batch):
-        m, p = rng_msgs[i % 64], privs[i % 64]
-        s = host.ecdsa_sign(m, p)
-        sigs[i] = np.frombuffer(s, np.uint8)
-        hashes[i] = np.frombuffer(m, np.uint8)
-        if i < 4:
-            expect.append(host.pubkey_to_address(host.privkey_to_pubkey(p)))
+        k = i % n_keys
+        sigs[i] = sig_cache[k]
+        hashes[i] = np.frombuffer(msgs[k], np.uint8)
+        expect[i] = addr_cache[k]
+        if i % invalid_every == 5:
+            valid[i] = False
+            if i % 2:
+                sigs[i, 40] ^= 0xFF  # corrupt s: recovers a wrong address
+                expect[i] = None      # (still a valid point — addr differs)
+            else:
+                sigs[i, 64] = 9       # invalid recovery id: masked row
+                expect[i] = b"\0" * 20
+    return sigs, hashes, valid, expect
+
+
+def main() -> None:
+    # persistent compilation cache: the big recover graph compiles once
+    # per machine, not once per bench run
+    import jax
+
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+    import numpy as np
+
+    from eges_tpu.crypto.verifier import ecrecover_batch
+
+    # default to the 1024-row operating point: its graph is the
+    # known-good compile; larger batches scale throughput further
+    # (pass e.g. 4096/16384 when the device session is stable)
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    lat_batch = 1024  # BASELINE.md p50 operating point
 
     fn = jax.jit(ecrecover_batch)
-    js, jh = jax.numpy.asarray(sigs), jax.numpy.asarray(hashes)
-    addrs, _, ok = fn(js, jh)  # compile + warmup
-    addrs, ok = np.asarray(addrs), np.asarray(ok)
-    assert ok.all(), "verifier rejected valid signatures"
-    for i in range(4):
-        assert bytes(addrs[i]) == expect[i], "address mismatch vs host model"
 
-    n_iters = 5
+    # -- correctness gate (includes invalid-row masking); same shape as the
+    # latency measurement so the bench compiles exactly two graphs --------
+    sigs, hashes, valid, expect = _make_workload(lat_batch)
+    js, jh = jax.numpy.asarray(sigs), jax.numpy.asarray(hashes)
+    addrs, _, ok = fn(js, jh)
+    addrs, ok = np.asarray(addrs), np.asarray(ok).astype(bool)
+    for i in range(len(sigs)):
+        if expect[i] is None:
+            continue  # corrupted-s rows recover some *other* address
+        if valid[i]:
+            assert ok[i], f"row {i}: valid signature rejected"
+            assert bytes(addrs[i]) == expect[i], f"row {i}: address mismatch"
+        else:
+            assert not ok[i], f"row {i}: invalid signature accepted"
+
+    # -- throughput at the main batch size ----------------------------------
+    # Distinct pre-uploaded inputs per call: the runtime memoizes repeat
+    # dispatches of (executable, same input buffers), so timing a loop
+    # over one input set measures nothing (observed 478M "verifies"/s).
+    n_iters = 12
+    base_s, base_h, _, _ = _make_workload(batch)
+    sets = []
+    for i in range(n_iters + 1):
+        # distinct content + distinct device buffers per call (row roll is
+        # enough to defeat the dispatch memoization without re-signing)
+        sets.append((jax.numpy.asarray(np.roll(base_s, i, axis=0)),
+                     jax.numpy.asarray(np.roll(base_h, i, axis=0))))
+    jax.block_until_ready(sets)
+    jax.block_until_ready(fn(*sets[-1]))  # compile + warmup
     t0 = time.perf_counter()
-    for _ in range(n_iters):
-        out = fn(js, jh)
-    jax.block_until_ready(out)
+    for i in range(n_iters):
+        out = fn(*sets[i])
+        jax.block_until_ready(out)
     dt = time.perf_counter() - t0
     per_sec = batch * n_iters / dt
+
+    # -- p50/p99 latency at 1024 rows (distinct inputs each call) -----------
+    n_lat = 30
+    lbase_s, lbase_h, _, _ = _make_workload(lat_batch)
+    lsets = []
+    for i in range(n_lat + 1):
+        lsets.append((jax.numpy.asarray(np.roll(lbase_s, i, axis=0)),
+                      jax.numpy.asarray(np.roll(lbase_h, i, axis=0))))
+    jax.block_until_ready(lsets)
+    jax.block_until_ready(fn(*lsets[-1]))
+    lats = []
+    for i in range(n_lat):
+        a, b = lsets[i]
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(a, b))
+        lats.append(time.perf_counter() - t0)
+    lats.sort()
+    p50 = lats[len(lats) // 2] * 1e3
+    p99 = lats[int(len(lats) * 0.99)] * 1e3
 
     print(json.dumps({
         "metric": "secp256k1_ecrecover_verifies_per_sec_per_chip",
         "value": round(per_sec, 1),
         "unit": "verifies/s",
         "vs_baseline": round(per_sec / CPU_BASELINE_VERIFIES_PER_S, 3),
+        "batch": batch,
+        "p50_latency_ms_at_1024": round(p50, 3),
+        "p99_latency_ms_at_1024": round(p99, 3),
+        "device": str(jax.devices()[0]),
     }))
 
 

@@ -102,6 +102,7 @@ class GeecNode:
         self.pending_regs: dict[bytes, Registration] = {}
         self.registered = self.coinbase in self.membership
         self.pending_geec_txns: list[Transaction] = []
+        self._proposal_geec_txns: list[Transaction] = []
         self.geec_txn_sink = None  # app-layer callback for confirmed geec txns
         self.txpool = None  # optional TxPool; proposals drain it
 
@@ -324,6 +325,9 @@ class GeecNode:
         n = min(len(self.pending_geec_txns), self.cfg.txn_per_block)
         geec_txns = tuple(self.pending_geec_txns[:n])
         self.pending_geec_txns = self.pending_geec_txns[n:]
+        # remember the drained txns so an aborted proposal re-queues them
+        # instead of silently dropping UDP-ingested transactions
+        self._proposal_geec_txns = list(geec_txns)
         fakes = tuple(fake_txn(self.cfg.txn_size, seq=i)
                       for i in range(self.cfg.txn_per_block - n))
         txs = (tuple(self.txpool.pending_txns(self.cfg.txn_per_block))
@@ -364,9 +368,17 @@ class GeecNode:
                                                      retry + 1))
 
     def _handle_validate_reply(self, reply: M.ValidateReply) -> None:
-        """Tally ACKs (ref: handleVerifyReplies geec_state.go:1184-1227)."""
+        """Tally ACKs (ref: handleVerifyReplies geec_state.go:1184-1227).
+
+        Only replies from the seeded acceptor window for this height may
+        count toward the quorum (the reference gates acceptor identity via
+        IsValidator on the reply path, geec_state.go:439-521) — otherwise
+        a single peer could fabricate a validate quorum."""
         wb = self.wb
         if reply.block_num != wb.blk_num or reply.author in wb.validate_replies:
+            return
+        seed = self.seed_for(reply.block_num)
+        if seed is None or not self.membership.is_acceptor(reply.author, seed):
             return
         for blk in reply.fill_blocks:  # backfilled empty blocks
             self.chain.offer(blk)
@@ -401,12 +413,20 @@ class GeecNode:
         sealed = block.with_confirm(confirm)
         self._phase = IDLE
         self._proposal = None
+        self._proposal_geec_txns = []  # included in the sealed block
         self.chain.offer(sealed)  # our own insert funnel
         self.transport.gossip(M.pack_gossip(M.GOSSIP_CONFIRM_BLOCK, confirm))
 
     def _abort_proposal(self) -> None:
         self._phase = IDLE
         self._proposal = None
+        drained = getattr(self, "_proposal_geec_txns", None)
+        if drained:
+            # an aborted proposal returns its geec txns to the front of
+            # the queue; duplicates vs a block that actually included
+            # them are removed again at ingest time
+            self.pending_geec_txns = drained + self.pending_geec_txns
+        self._proposal_geec_txns = []
         self._cancel_timer("election")
         self._cancel_timer("validate")
         self._cancel_timer("backoff")
@@ -427,6 +447,15 @@ class GeecNode:
             return
         if wb.max_version > em.version:
             return  # old version (election_go.go:205)
+        # Elections are a committee-only protocol: both candidacies and
+        # votes must come from the seeded committee window for this
+        # height/version, or one outside peer could seed itself as
+        # delegator / fabricate an election quorum.
+        seed = self.seed_for(em.block_num)
+        if (seed is None
+                or not self.membership.is_committee(em.author, seed,
+                                                    em.version)):
+            return
         if wb.max_version < em.version:
             wb.bump_version(em.version)
             if self._phase in (ELECTING, VALIDATING):
@@ -495,6 +524,15 @@ class GeecNode:
             return
         if req.version < wb.max_version:
             return
+        # Only the elected proposer — a committee member for this
+        # height/version — may ask for ACKs; gate before relaying or
+        # stashing the block so an unauthenticated peer cannot seed
+        # pending_blocks with crafted blocks.
+        seed = self.seed_for(req.block_num)
+        if (seed is None
+                or not self.membership.is_committee(req.author, seed,
+                                                    req.version)):
+            return
         if req.version > wb.max_version:
             wb.bump_version(req.version)
         if req.retry <= wb.max_validate_retry:
@@ -538,32 +576,59 @@ class GeecNode:
     # confirm handling (ref: eth/handler.go:785-871)
     # ------------------------------------------------------------------
 
+    # accept confirm effects only this far ahead of our head: a forged
+    # confirm with a huge block_number must not wedge max_confirmed_block
+    # (confirms are unauthenticated gossip until the signed-vote layer)
+    CONFIRM_WINDOW = 256
+
     def _handle_confirm(self, confirm: ConfirmBlockMsg) -> None:
         if confirm.block_number <= self.max_confirmed_block:
             return
+        if confirm.block_number > self.chain.height() + self.CONFIRM_WINDOW:
+            # too far ahead to act on: if it's real we are badly behind —
+            # sync first (rate-limited), and let later confirms land
+            # normally once the gap closes; if forged, nothing was harmed
+            self._request_backfill(confirm.block_number)
+            return
         if confirm.empty_block:
             for n in sorted(self.pending_blocks):
-                if n < confirm.block_number:
-                    blk = self.pending_blocks.pop(n).with_confirm(confirm)
-                    self.chain.offer(blk)
-                elif n == confirm.block_number:
+                if n <= confirm.block_number:
+                    # an empty confirm vouches for no pending hash below
+                    # it; dropped pendings are healed by backfill
                     del self.pending_blocks[n]
             if self.chain.height() == confirm.block_number - 1:
                 empty = self.chain.make_empty_block().with_confirm(confirm)
                 self.chain.offer(empty)
         else:
-            for n in sorted(self.pending_blocks):
-                if n > confirm.block_number:
+            # A confirm vouches for exactly one suffix: walk parent_hash
+            # back from the confirmed hash and apply only pending blocks
+            # on that path (cf. the hash check on the query path,
+            # geec_state.go:1370).  A losing proposal stashed at a lower
+            # height — e.g. confirm(N+1) arriving before confirm(N) while
+            # a competing block is pending at N — must never be inserted:
+            # it would wedge the chain under an 'unknown ancestor' that
+            # backfill cannot displace.
+            chained: dict[int, Block] = {}
+            want = confirm.hash
+            n = confirm.block_number
+            while n > 0:
+                blk = self.pending_blocks.get(n)
+                if blk is None or blk.hash != want:
                     break
-                blk = self.pending_blocks.pop(n)
-                if n == confirm.block_number and blk.hash != confirm.hash:
-                    # a confirm only vouches for its own hash; a stale or
-                    # forged pending block at that height must not be
-                    # stamped confirmed (cf. the hash check on the query
-                    # path, geec_state.go:1370) — drop it and let
-                    # backfill fetch the real one
-                    continue
-                self.chain.offer(blk.with_confirm(confirm))
+                chained[n] = blk
+                want = blk.header.parent_hash
+                n -= 1
+            for n in list(self.pending_blocks):
+                if n <= confirm.block_number:
+                    del self.pending_blocks[n]
+            # every block on the vouched suffix gets the confirm stamped,
+            # ancestors included — the reference attaches the same
+            # ConfirmMessage to all pendings it pops (eth/handler.go:
+            # 785-871), and downstream consumers (replace_suffix's
+            # "replacements must be confirmed", TTL rewards) rely on a
+            # non-None confirm
+            for n in sorted(chained):
+                self.chain.offer(chained[n].with_confirm(confirm))
         self.max_confirmed_block = confirm.block_number
         # unconditional re-broadcast; loop broken by max_confirmed gate
         self.transport.gossip(M.pack_gossip(M.GOSSIP_CONFIRM_BLOCK, confirm))
@@ -656,6 +721,18 @@ class GeecNode:
         self.trust_rands[blk.number] = blk.header.trust_rand
         if self.txpool is not None and blk.transactions:
             self.txpool.remove_included(blk.transactions)
+        if blk.geec_txns:
+            # drop geec txns the landed block already included — from the
+            # pending queue AND from any in-flight proposal's drained list
+            # (the abort below would otherwise re-queue them after this
+            # dedup already ran)
+            included = {t.hash for t in blk.geec_txns}
+            self.pending_geec_txns = [
+                t for t in self.pending_geec_txns if t.hash not in included]
+            if self._proposal_geec_txns:
+                self._proposal_geec_txns = [
+                    t for t in self._proposal_geec_txns
+                    if t.hash not in included]
         if blk.header.coinbase == EMPTY_ADDR:
             if blk.number not in self.empty_block_list:
                 self.empty_block_list.append(blk.number)
@@ -808,10 +885,15 @@ class GeecNode:
                         lambda: self._query_retry(blk_num, version, retry + 1))
 
     def _handle_query_reply(self, reply: M.QueryReply) -> None:
-        """(ref: handleQueryReply geec_state.go:1231-1283)"""
+        """(ref: handleQueryReply geec_state.go:1231-1283).  Same
+        acceptor-window gate as the ACK tally: only seeded acceptors may
+        count toward the query quorum."""
         wb = self.wb
         if (reply.block_num != wb.blk_num or reply.version != wb.max_version
                 or reply.author in wb.query_replies):
+            return
+        seed = self.seed_for(reply.block_num)
+        if seed is None or not self.membership.is_acceptor(reply.author, seed):
             return
         wb.query_replies[reply.author] = reply.retry
         if reply.empty:

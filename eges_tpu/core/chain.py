@@ -155,13 +155,19 @@ class BlockChain:
     (core/geec_state.go:962).
     """
 
+    _MAX_CANDIDATES = 4  # buffered blocks per height (distinct hashes)
+
     def __init__(self, store=None, genesis: Block | None = None,
                  verifier=None, listeners=()):
         self.store = store if store is not None else MemoryStore()
         self.verifier = verifier
         self._listeners = list(listeners)
         self._lock = threading.RLock()
-        self._future: dict[int, Block] = {}
+        # out-of-order buffer: up to _MAX_CANDIDATES first-seen distinct
+        # blocks per height, so neither "stale block squats the slot" nor
+        # "late conflicting offer displaces the good block" can stall the
+        # funnel — insertion tries every candidate when the height opens
+        self._future: dict[int, list[Block]] = {}
         self.bad_blocks = 0
         self.last_error: str | None = None
 
@@ -243,20 +249,27 @@ class BlockChain:
             inserted = []
             if block.number <= self._head.number:
                 return inserted  # duplicate/old — fetcher-style dedup
-            self._future[block.number] = block
-            while (nxt := self._future.get(self._head.number + 1)) is not None:
+            if block.number > self._head.number + 256:
+                return inserted  # beyond the buffer window: sync, don't buffer
+            cands = self._future.setdefault(block.number, [])
+            if (len(cands) < self._MAX_CANDIDATES
+                    and all(b.hash != block.hash for b in cands)):
+                cands.append(block)
+            while (cands := self._future.get(self._head.number + 1)):
                 del self._future[self._head.number + 1]
-                try:
-                    self._insert(nxt)
-                except ChainError as e:
-                    self.bad_blocks += 1
-                    self.last_error = str(e)
+                ok = None
+                for cand in cands:
+                    try:
+                        self._insert(cand)
+                        ok = cand
+                        break
+                    except ChainError as e:
+                        self.bad_blocks += 1
+                        self.last_error = str(e)
+                if ok is None:
                     break
-                inserted.append(nxt)
-            # cap the out-of-order buffer (a peer can't balloon memory)
-            if len(self._future) > 256:
-                for n in sorted(self._future)[:-256]:
-                    del self._future[n]
+                inserted.append(ok)
+            # memory bound: 256-height window x _MAX_CANDIDATES per height
             return inserted
 
     def replace_suffix(self, blocks: list[Block]) -> bool:

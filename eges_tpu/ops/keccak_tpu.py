@@ -38,8 +38,14 @@ _RC = np.array([
     0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
     0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
 ], dtype=np.uint64)
-_RC_LO = jnp.asarray((_RC & 0xFFFFFFFF).astype(np.uint32))
-_RC_HI = jnp.asarray((_RC >> 32).astype(np.uint32))
+# NB: keep module-level constants as NUMPY arrays, converting to jnp only
+# inside a trace.  A module-level jnp array closed over by a jitted
+# function is a captured device buffer, and on the TPU runtime a loop
+# body referencing a captured buffer falls off the fast path (~1000x:
+# measured 64 ms instead of 60 us for this very function, and it drags
+# every other loop in the same executable down with it).
+_RC_LO_NP = (_RC & 0xFFFFFFFF).astype(np.uint32)
+_RC_HI_NP = (_RC >> 32).astype(np.uint32)
 
 # lane index l = x + 5*y
 _X = np.arange(25) % 5
@@ -83,6 +89,9 @@ def _rotl_pairs(lo, hi, amounts: np.ndarray):
 def _keccak_f(lo: jnp.ndarray, hi: jnp.ndarray):
     """Keccak-f[1600]: state as ``[..., 25]`` uint32 pairs."""
 
+    rc_lo = jnp.asarray(_RC_LO_NP)  # trace-time constants (see note above)
+    rc_hi = jnp.asarray(_RC_HI_NP)
+
     def round_fn(rnd, state):
         lo, hi = state
         # theta
@@ -111,8 +120,8 @@ def _keccak_f(lo: jnp.ndarray, hi: jnp.ndarray):
         hi = (g_hi ^ (~jnp.roll(g_hi, -1, axis=-1)
                       & jnp.roll(g_hi, -2, axis=-1))).reshape(hi.shape)
         # iota
-        lo = lo.at[..., 0].set(lo[..., 0] ^ _RC_LO[rnd])
-        hi = hi.at[..., 0].set(hi[..., 0] ^ _RC_HI[rnd])
+        lo = lo.at[..., 0].set(lo[..., 0] ^ rc_lo[rnd])
+        hi = hi.at[..., 0].set(hi[..., 0] ^ rc_hi[rnd])
         return lo, hi
 
     return jax.lax.fori_loop(0, 24, round_fn, (lo, hi))

@@ -1,0 +1,201 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+
+* high  — out-of-order confirms must not wedge a node by inserting a
+  losing proposal at a skipped height (node.py _handle_confirm).
+* medium — validate/query replies and election messages only count when
+  the author is inside the seeded acceptor/committee window.
+* low — far-future spam must not evict the head+1 buffer entry; a later
+  conflicting offer must not displace a buffered block (chain.offer).
+* low — geec txns drained into an aborted proposal are re-queued.
+* low — validate requests from non-committee authors are ignored.
+"""
+
+from eges_tpu.consensus import messages as M
+from eges_tpu.consensus.config import (
+    BootstrapNode, ChainGeecConfig, NodeConfig,
+)
+from eges_tpu.consensus.membership import derive_seed
+from eges_tpu.consensus.node import GeecNode, ELECTING
+from eges_tpu.consensus.working_block import ELEC_CANDIDATE
+from eges_tpu.core.chain import BlockChain, make_genesis
+from eges_tpu.core.types import (
+    Block, ConfirmBlockMsg, Header, new_block, geec_txn,
+)
+from eges_tpu.sim.simnet import SimClock
+
+
+class StubTransport:
+    def __init__(self):
+        self.gossiped = []
+        self.directs = []
+
+    def gossip(self, data):
+        self.gossiped.append(data)
+
+    def send_direct(self, ip, port, data):
+        self.directs.append((ip, port, data))
+
+
+def mk_node(n_members=8, n_candidates=3, n_acceptors=4, mine=False):
+    addrs = [bytes([i + 1]) * 20 for i in range(n_members)]
+    boot = tuple(BootstrapNode(account=a, ip=f"10.0.0.{i+1}", port=8100 + i)
+                 for i, a in enumerate(addrs))
+    ccfg = ChainGeecConfig(bootstrap=boot)
+    ncfg = NodeConfig(coinbase=addrs[0], consensus_ip="10.0.0.1",
+                      consensus_port=8100, n_candidates=n_candidates,
+                      n_acceptors=n_acceptors, txn_per_block=4,
+                      total_nodes=n_members)
+    chain = BlockChain(genesis=make_genesis())
+    clock = SimClock()
+    node = GeecNode(chain, clock, StubTransport(), ncfg, ccfg, mine=mine)
+    return node, addrs
+
+
+def mk_block(parent: Block, coinbase: bytes, trust_rand=7) -> Block:
+    return new_block(Header(parent_hash=parent.hash, number=parent.number + 1,
+                            coinbase=coinbase, time=parent.header.time + 1,
+                            trust_rand=trust_rand))
+
+
+def test_out_of_order_confirm_does_not_insert_losing_proposal():
+    """ADVICE high: confirm(N+1) before confirm(N) with a losing proposal
+    pending at N must not insert the loser; backfill then heals."""
+    node, addrs = mk_node()
+    g = node.chain.head()
+    a1 = mk_block(g, addrs[1])          # the quorum's block at height 1
+    b1 = mk_block(g, addrs[2])          # losing proposal at height 1
+    a2 = mk_block(a1, addrs[3])         # quorum block at height 2
+    assert a1.hash != b1.hash
+
+    node.pending_blocks[1] = b1         # we only saw the loser at 1
+    node.pending_blocks[2] = a2
+    confirm2 = ConfirmBlockMsg(block_number=2, hash=a2.hash, confidence=2000)
+    node._handle_confirm(confirm2)
+
+    # the loser must NOT be on the chain; a2 waits buffered for its parent
+    assert node.chain.height() == 0
+    assert node.chain.get_block_by_number(1) is None
+    # backfill was requested (we are behind the quorum head)
+    assert any(M.unpack_gossip(d)[0] == M.GOSSIP_GET_BLOCKS
+               for d in node.transport.gossiped)
+
+    # backfill delivers the real block 1 -> chain heals through 2
+    node._handle_blocks_reply(M.BlocksReply(blocks=(a1,)))
+    assert node.chain.height() == 2
+    assert node.chain.get_block_by_number(1).hash == a1.hash
+    assert node.chain.get_block_by_number(2).hash == a2.hash
+
+
+def test_chained_pendings_applied_on_out_of_order_confirm():
+    """The happy path of the same fix: pendings that hash-chain into the
+    confirmed block are all applied."""
+    node, addrs = mk_node()
+    g = node.chain.head()
+    a1 = mk_block(g, addrs[1])
+    a2 = mk_block(a1, addrs[3])
+    node.pending_blocks[1] = a1
+    node.pending_blocks[2] = a2
+    node._handle_confirm(ConfirmBlockMsg(block_number=2, hash=a2.hash,
+                                         confidence=2000))
+    assert node.chain.height() == 2
+    assert node.chain.get_block_by_number(1).hash == a1.hash
+
+
+def test_forged_validate_reply_does_not_count():
+    """ADVICE medium: only seeded acceptors count toward the ACK quorum."""
+    node, addrs = mk_node(n_members=8, n_acceptors=2)
+    seed = node.seed_for(node.wb.blk_num)
+    accs = {m.addr for m in node.membership.acceptors(seed)}
+    outsider = next(a for a in addrs if a not in accs)
+    insider = next(iter(accs))
+
+    node._phase = 2  # VALIDATING
+    node.wb.validate_threshold = 99  # don't trip quorum in this test
+    node._handle_validate_reply(M.ValidateReply(
+        block_num=node.wb.blk_num, author=outsider))
+    assert outsider not in node.wb.validate_replies
+    node._handle_validate_reply(M.ValidateReply(
+        block_num=node.wb.blk_num, author=insider))
+    assert insider in node.wb.validate_replies
+
+
+def test_forged_query_reply_does_not_count():
+    node, addrs = mk_node(n_members=8, n_acceptors=2)
+    seed = node.seed_for(node.wb.blk_num)
+    accs = {m.addr for m in node.membership.acceptors(seed)}
+    outsider = next(a for a in addrs if a not in accs)
+
+    node.wb.query_threshold = 99
+    node._handle_query_reply(M.QueryReply(
+        block_num=node.wb.blk_num, author=outsider, version=0))
+    assert outsider not in node.wb.query_replies
+
+
+def test_vote_from_non_committee_is_ignored():
+    node, addrs = mk_node(n_members=8, n_candidates=2)
+    seed = node.seed_for(node.wb.blk_num)
+    committee = {m.addr for m in node.membership.committee(seed, 0)}
+    outsider = next(a for a in addrs if a not in committee)
+
+    node.wb.elect_state = ELEC_CANDIDATE
+    node._phase = ELECTING
+    node.wb.election_threshold = 99
+    node._handle_elect_message(M.ElectMessage(
+        code=M.MSG_VOTE, block_num=node.wb.blk_num, author=outsider))
+    assert outsider not in node.wb.supporters
+    if committee:
+        insider = next(iter(committee))
+        node._handle_elect_message(M.ElectMessage(
+            code=M.MSG_VOTE, block_num=node.wb.blk_num, author=insider))
+        assert insider in node.wb.supporters
+
+
+def test_validate_request_from_non_committee_ignored():
+    """ADVICE low: non-committee authors must not seed pending_blocks."""
+    node, addrs = mk_node(n_members=8, n_candidates=2)
+    seed = node.seed_for(node.wb.blk_num)
+    committee = {m.addr for m in node.membership.committee(seed, 0)}
+    outsider = next(a for a in addrs if a not in committee)
+    blk = mk_block(node.chain.head(), outsider)
+    node._handle_validate_request(M.ValidateRequest(
+        block_num=1, author=outsider, block=blk, ip="10.9.9.9", port=1))
+    assert 1 not in node.pending_blocks
+    assert not node.transport.gossiped  # not relayed either
+
+
+def test_geec_txns_requeued_on_abort():
+    """ADVICE low: aborting a proposal returns drained geec txns."""
+    node, addrs = mk_node()
+    t1, t2 = geec_txn(b"payload-1"), geec_txn(b"payload-2")
+    node.pending_geec_txns = [t1, t2]
+    node._build_proposal(1)
+    assert node.pending_geec_txns == []
+    node._abort_proposal()
+    assert node.pending_geec_txns == [t1, t2]
+    # and a landed block that includes one of them dedups it
+    blk = new_block(Header(parent_hash=node.chain.head().hash, number=1,
+                           coinbase=addrs[1], time=1, trust_rand=3),
+                    geec_txns=(t1,))
+    node.chain.offer(blk)
+    assert node.pending_geec_txns == [t2]
+
+
+def test_future_buffer_keeps_near_head_blocks():
+    """ADVICE low: far-future spam must not evict head+1; later offers do
+    not displace a first-seen buffered block."""
+    bc = BlockChain()
+    g = bc.head()
+    b1 = mk_block(g, b"\x01" * 20)
+    b2 = mk_block(b1, b"\x01" * 20)
+    bc.offer(b2)  # buffered (parent missing)
+    # spam far-future heights — must all be rejected or evicted, never b2
+    for n in range(500, 990):
+        bc.offer(new_block(Header(parent_hash=b"\xee" * 32, number=n,
+                                  time=n, trust_rand=1)))
+    # conflicting offer at height 2 must not displace the good one
+    evil2 = new_block(Header(parent_hash=b"\xdd" * 32, number=2, time=9,
+                             trust_rand=2))
+    bc.offer(evil2)
+    bc.offer(b1)
+    assert bc.height() == 2
+    assert bc.get_block_by_number(2).hash == b2.hash
