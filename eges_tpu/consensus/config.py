@@ -44,6 +44,12 @@ class ChainGeecConfig:
     validate_timeout_ms: float = 500.0  # validate_timeout (ms) — ACK retry
     election_timeout_ms: float = 100.0  # election_timeout (ms)
     backoff_time_ms: float = 0.0       # backoff_time (ms) before confirm
+    # This build's upgrade over the reference's trustedHW assumption
+    # (unsigned ValidateReply, core/geec_state.go:528-591): when True,
+    # election votes / ACKs / query replies / confirms must carry valid
+    # secp256k1 signatures, tallied through the device batch verifier.
+    # Consensus-critical: must agree across the chain.
+    signed_votes: bool = False
 
     @classmethod
     def from_json(cls, obj: dict) -> "ChainGeecConfig":
@@ -55,6 +61,7 @@ class ChainGeecConfig:
             validate_timeout_ms=float(obj.get("validate_timeout", 500)),
             election_timeout_ms=float(obj.get("election_timeout", 100)),
             backoff_time_ms=float(obj.get("backoff_time", 0)),
+            signed_votes=bool(obj.get("signed_votes", False)),
         )
 
     def to_json(self) -> dict:
@@ -65,6 +72,7 @@ class ChainGeecConfig:
             "validate_timeout": self.validate_timeout_ms,
             "election_timeout": self.election_timeout_ms,
             "backoff_time": self.backoff_time_ms,
+            "signed_votes": self.signed_votes,
         }
 
 
@@ -84,6 +92,9 @@ class NodeConfig:
     breakdown: bool = False             # --breakdown (phase timing logs)
     failure_test: bool = False          # --failureTest (TTL economy on)
     total_nodes: int = 3                # --totalNodes
+    privkey: bytes = b""                # consensus signing key (32 bytes)
+    #                                     — required when the chain runs
+    #                                     with signed_votes
 
     # TPU-native addition: verify signatures in device batches of up to
     # this many rows (the reference has no analogue — it verifies one

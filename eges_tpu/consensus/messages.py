@@ -22,6 +22,7 @@ from dataclasses import dataclass, field
 
 from eges_tpu.core import rlp
 from eges_tpu.core.types import Block, ConfirmBlockMsg, QueryBlockMsg, Registration
+from eges_tpu.crypto.keccak import keccak256
 
 # Direct-plane (UDP envelope) codes (ref: core/geecCore/Types.go:59-63)
 UDP_EXAMINE_REPLY = 0x01
@@ -49,7 +50,12 @@ class ElectMessage:
 
     ``code`` MSG_ELECT announces candidacy with ``rand``; MSG_VOTE carries a
     vote for ``author`` (on transfer, ``author`` stays the ORIGINAL voter —
-    the vote-transfer semantics of election_go.go:276-310)."""
+    the vote-transfer semantics of election_go.go:276-310).
+
+    ``sig`` signs :meth:`signing_hash` — the stable election content
+    (code, height, author, rand, version) but NOT transport details
+    (ip/port/retry), so retries and vote transfer keep the original
+    signature valid."""
 
     code: int
     block_num: int
@@ -59,19 +65,27 @@ class ElectMessage:
     retry: int = 0
     ip: str = ""
     port: int = 0
+    sig: bytes = b""
 
     def to_rlp(self) -> list:
         return [self.code, self.block_num, self.author, self.rand,
-                self.version, self.retry, self.ip.encode(), self.port]
+                self.version, self.retry, self.ip.encode(), self.port,
+                self.sig]
 
     @classmethod
     def from_rlp(cls, item: list) -> "ElectMessage":
-        code, blk, author, rand, version, retry, ip, port = item
+        code, blk, author, rand, version, retry, ip, port = item[:8]
         return cls(code=rlp.decode_uint(code), block_num=rlp.decode_uint(blk),
                    author=bytes(author), rand=rlp.decode_uint(rand),
                    version=rlp.decode_uint(version),
                    retry=rlp.decode_uint(retry), ip=ip.decode(),
-                   port=rlp.decode_uint(port))
+                   port=rlp.decode_uint(port),
+                   sig=bytes(item[8]) if len(item) > 8 else b"")
+
+    def signing_hash(self) -> bytes:
+        return keccak256(b"geec/elect" + rlp.encode(
+            [self.code, self.block_num, self.author, self.rand,
+             self.version]))
 
 
 @dataclass(frozen=True)
@@ -89,20 +103,29 @@ class ValidateRequest:
     retry: int = 0
     version: int = 0
     empty_list: tuple[int, ...] = ()
+    sig: bytes = b""  # proposer's signature over signing_hash()
 
     def to_rlp(self) -> list:
         return [self.block_num, self.author, self.block.to_rlp(),
                 self.ip.encode(), self.port, self.retry, self.version,
-                list(self.empty_list)]
+                list(self.empty_list), self.sig]
 
     @classmethod
     def from_rlp(cls, item: list) -> "ValidateRequest":
-        blk_num, author, block, ip, port, retry, version, empties = item
+        blk_num, author, block, ip, port, retry, version, empties = item[:8]
         return cls(block_num=rlp.decode_uint(blk_num), author=bytes(author),
                    block=Block.from_rlp(block), ip=ip.decode(),
                    port=rlp.decode_uint(port), retry=rlp.decode_uint(retry),
                    version=rlp.decode_uint(version),
-                   empty_list=tuple(rlp.decode_uint(e) for e in empties))
+                   empty_list=tuple(rlp.decode_uint(e) for e in empties),
+                   sig=bytes(item[8]) if len(item) > 8 else b"")
+
+    def signing_hash(self) -> bytes:
+        """Binds proposer, height, version and the exact proposed block
+        (by hash) — retry and transport fields excluded so rebroadcasts
+        reuse one signature."""
+        return keccak256(b"geec/validate-req" + rlp.encode(
+            [self.block_num, self.author, self.block.hash, self.version]))
 
 
 @dataclass(frozen=True)
@@ -116,18 +139,30 @@ class ValidateReply:
     accepted: bool = True
     retry: int = 0
     fill_blocks: tuple[Block, ...] = ()
+    block_hash: bytes = bytes(32)  # the exact proposal being ACKed
+    sig: bytes = b""               # acceptor's signature over signing_hash()
 
     def to_rlp(self) -> list:
         return [self.block_num, self.author, int(self.accepted), self.retry,
-                [b.to_rlp() for b in self.fill_blocks]]
+                [b.to_rlp() for b in self.fill_blocks], self.block_hash,
+                self.sig]
 
     @classmethod
     def from_rlp(cls, item: list) -> "ValidateReply":
-        blk, author, acc, retry, fills = item
+        blk, author, acc, retry, fills = item[:5]
         return cls(block_num=rlp.decode_uint(blk), author=bytes(author),
                    accepted=bool(rlp.decode_uint(acc)),
                    retry=rlp.decode_uint(retry),
-                   fill_blocks=tuple(Block.from_rlp(b) for b in fills))
+                   fill_blocks=tuple(Block.from_rlp(b) for b in fills),
+                   block_hash=bytes(item[5]) if len(item) > 5 else bytes(32),
+                   sig=bytes(item[6]) if len(item) > 6 else b"")
+
+    def signing_hash(self) -> bytes:
+        """An ACK binds (height, acceptor, verdict, block hash): a vote
+        for proposal X must never count for proposal Y."""
+        return keccak256(b"geec/ack" + rlp.encode(
+            [self.block_num, self.author, int(self.accepted),
+             self.block_hash]))
 
 
 @dataclass(frozen=True)
@@ -141,18 +176,25 @@ class QueryReply:
     retry: int = 0
     empty: bool = True
     block_hash: bytes = bytes(32)
+    sig: bytes = b""  # acceptor's signature over signing_hash()
 
     def to_rlp(self) -> list:
         return [self.block_num, self.author, self.version, self.retry,
-                int(self.empty), self.block_hash]
+                int(self.empty), self.block_hash, self.sig]
 
     @classmethod
     def from_rlp(cls, item: list) -> "QueryReply":
-        blk, author, version, retry, empty, h = item
+        blk, author, version, retry, empty, h = item[:6]
         return cls(block_num=rlp.decode_uint(blk), author=bytes(author),
                    version=rlp.decode_uint(version),
                    retry=rlp.decode_uint(retry),
-                   empty=bool(rlp.decode_uint(empty)), block_hash=bytes(h))
+                   empty=bool(rlp.decode_uint(empty)), block_hash=bytes(h),
+                   sig=bytes(item[6]) if len(item) > 6 else b"")
+
+    def signing_hash(self) -> bytes:
+        return keccak256(b"geec/query-reply" + rlp.encode(
+            [self.block_num, self.author, self.version, int(self.empty),
+             self.block_hash]))
 
 
 @dataclass(frozen=True)

@@ -84,6 +84,14 @@ class GeecNode:
         self.coinbase = node_cfg.coinbase
         self._log = log or (lambda *a, **k: None)
 
+        # signed-vote mode (ChainGeecConfig.signed_votes): every election
+        # vote / ACK / query reply / confirm carries a secp256k1 signature
+        # and quorum tallies run through the device batch verifier —
+        # BASELINE config 3's "vote-sig batch verify on TPU"
+        self._signing = bool(chain_cfg.signed_votes)
+        if self._signing and mine and len(node_cfg.privkey) != 32:
+            raise ValueError("signed_votes chain requires a 32-byte privkey")
+
         tp = ttl_params(node_cfg.total_nodes)
         self.membership = Membership(node_cfg.n_candidates,
                                      node_cfg.n_acceptors, **tp)
@@ -129,6 +137,48 @@ class GeecNode:
         self.max_confirmed_block = chain.height()
         if self.coinbase in self.membership:
             self.registered = True
+
+    # ------------------------------------------------------------------
+    # vote authentication (signed-vote mode)
+    # ------------------------------------------------------------------
+
+    def _sign(self, sighash: bytes) -> bytes:
+        if not self._signing or len(self.cfg.privkey) != 32:
+            return b""
+        from eges_tpu.crypto import secp256k1 as host
+        return host.ecdsa_sign(sighash, self.cfg.privkey)
+
+    def _verify_single(self, sighash: bytes, sig: bytes,
+                       author: bytes) -> bool:
+        """One-off signature check (candidacies, proposals, confirms)."""
+        if not self._signing:
+            return True
+        if len(sig) != 65:
+            return False
+        from eges_tpu.crypto.verify_host import recover_signers
+        return recover_signers([(sighash, sig)], self.verifier)[0] == author
+
+    def _recover_entries(self, entries) -> list:
+        """Recover the signer of each ``(author, sighash, sig)`` entry in
+        ONE verifier batch; per-entry result is the claimed author when
+        the signature checks out, else None.  With signing off every
+        entry passes."""
+        if not self._signing:
+            return [a for a, _, _ in entries]
+        from eges_tpu.crypto.verify_host import recover_signers
+        rec = recover_signers([(h, s) for _, h, s in entries], self.verifier)
+        return [a if r == a else None
+                for (a, _, _), r in zip(entries, rec)]
+
+    def _verify_quorum(self, entries) -> dict[bytes, bytes]:
+        """Quorum tally over possibly-multiple entries per author:
+        returns ``{author: verified_sig}`` for every author with at least
+        one valid entry (sig is ``b""`` when signing is off)."""
+        out: dict[bytes, bytes] = {}
+        for (a, _, s), r in zip(entries, self._recover_entries(entries)):
+            if r is not None and a not in out:
+                out[a] = s if self._signing else b""
+        return out
 
     # ------------------------------------------------------------------
     # timers
@@ -272,14 +322,15 @@ class GeecNode:
                 or wb.elect_state == ELEC_VOTED):
             self._abort_proposal()
             return
-        if len(wb.supporters) >= wb.election_threshold:
-            self._on_elected()
+        if (len(wb.supporters) >= wb.election_threshold
+                and self._on_elected()):
             return
         em = M.ElectMessage(code=M.MSG_ELECT, block_num=blk_num,
                             author=self.coinbase, rand=wb.my_rand,
                             version=version, retry=retry,
                             ip=self.cfg.consensus_ip,
                             port=self.cfg.consensus_port)
+        em = dataclasses.replace(em, sig=self._sign(em.signing_hash()))
         payload = M.pack_direct(M.UDP_ELECT, self.coinbase, em)
         for m in committee:
             if m.addr == self.coinbase:
@@ -290,11 +341,24 @@ class GeecNode:
                         lambda: self._election_retry(blk_num, version,
                                                      committee, retry + 1))
 
-    def _on_elected(self) -> None:
-        """Threshold of votes reached -> build + broadcast the proposal."""
+    def _on_elected(self) -> bool:
+        """Threshold of votes reached -> verify the vote signatures as one
+        device batch, then build + broadcast the proposal.  Returns False
+        (election continues) if pruning forged votes drops the count back
+        below the threshold."""
         wb = self.wb
         if self._phase != ELECTING:
-            return
+            return False
+        if self._signing:
+            items = [(a, h, s) for a in wb.supporters
+                     for (h, s) in wb.supporter_votes.get(a, ())]
+            valid = self._verify_quorum(items)
+            for a in list(wb.supporters):
+                if a not in valid:
+                    wb.supporters.discard(a)
+                    wb.supporter_votes.pop(a, None)
+            if len(wb.supporters) < wb.election_threshold:
+                return False
         wb.elect_state = ELEC_ELECTED
         wb.is_proposer = True
         wb.validate_threshold = self.membership.validate_threshold()
@@ -306,8 +370,9 @@ class GeecNode:
         if self._proposal_version > 0:
             # recovered leader: query what happened first
             self._start_query(wb.blk_num, self._proposal_version)
-            return
+            return True
         self._build_and_validate(wb.blk_num, self._proposal_version)
+        return True
 
     def _build_proposal(self, blk_num: int) -> Block:
         """Assemble header+body (ref: Prepare geec.go:228-264 + Seal's txn
@@ -346,6 +411,7 @@ class GeecNode:
             retry=0, version=version,
             empty_list=tuple(self.empty_block_list),
         )
+        req = dataclasses.replace(req, sig=self._sign(req.signing_hash()))
         self._ask_for_ack(req)
 
     def _ask_for_ack(self, req: M.ValidateRequest) -> None:
@@ -354,6 +420,7 @@ class GeecNode:
         self._phase = VALIDATING
         self._validate_req = req
         self.wb.validate_replies.clear()
+        self.wb.validate_cert = {}
         self.wb.validate_succeeded = False
         self._ack_t = self.clock.now()
         self._validate_retry(req.block_num, req.version, 0)
@@ -375,18 +442,42 @@ class GeecNode:
         IsValidator on the reply path, geec_state.go:439-521) — otherwise
         a single peer could fabricate a validate quorum."""
         wb = self.wb
-        if reply.block_num != wb.blk_num or reply.author in wb.validate_replies:
+        if reply.block_num != wb.blk_num:
             return
         seed = self.seed_for(reply.block_num)
         if seed is None or not self.membership.is_acceptor(reply.author, seed):
             return
-        for blk in reply.fill_blocks:  # backfilled empty blocks
+        # backfilled empty blocks ride the same certification gate as the
+        # sync plane — an unverified reply must not inject history
+        fills = (self._filter_certified(list(reply.fill_blocks))
+                 if self._signing else reply.fill_blocks)
+        for blk in fills:
             self.chain.offer(blk)
         if not reply.accepted:
             return  # an explicit NACK never counts toward the quorum
-        wb.validate_replies[reply.author] = reply.retry
+        if (self._proposal is not None
+                and reply.block_hash != self._proposal.hash):
+            return  # an ACK binds a specific block; not ours -> not ours
+        # up to 2 distinct stored replies per author (spoof-squat defense)
+        lst = wb.validate_replies.setdefault(reply.author, [])
+        if len(lst) < 2 and all(r.sig != reply.sig for r in lst):
+            lst.append(reply)
         if (len(wb.validate_replies) >= wb.validate_threshold
                 and not wb.validate_succeeded and self._phase == VALIDATING):
+            if self._signing:
+                # the config-3 batch point: recover every collected ACK
+                # signature in ONE device call, prune forgeries, and only
+                # then trip the quorum.  The verified signatures become
+                # the confirm's quorum certificate.
+                items = [(r.author, r.signing_hash(), r.sig)
+                         for rl in wb.validate_replies.values() for r in rl]
+                cert = self._verify_quorum(items)
+                for a in list(wb.validate_replies):
+                    if a not in cert:
+                        del wb.validate_replies[a]
+                if len(wb.validate_replies) < wb.validate_threshold:
+                    return  # keep collecting; retry loop re-solicits
+                wb.validate_cert = cert
             wb.validate_succeeded = True
             self._cancel_timer("validate")
             if self.cfg.breakdown:
@@ -409,7 +500,12 @@ class GeecNode:
         confirm = ConfirmBlockMsg(
             block_number=block.number, hash=block.hash,
             confidence=calc_confidence(parent_conf), supporters=supporters,
-            empty_block=False)
+            empty_block=False,
+            supporter_sigs=tuple(self.wb.validate_cert.get(a, b"")
+                                 for a in supporters)
+            if self._signing else ())
+        confirm = dataclasses.replace(confirm,
+                                      sig=self._sign(confirm.signing_hash()))
         sealed = block.with_confirm(confirm)
         self._phase = IDLE
         self._proposal = None
@@ -462,6 +558,10 @@ class GeecNode:
                 self._abort_proposal()
 
         if em.code == M.MSG_ELECT:
+            # a forged candidacy would steal this node's vote — verify
+            # the candidate's signature before voting for it
+            if not self._verify_single(em.signing_hash(), em.sig, em.author):
+                return
             if wb.elect_state == ELEC_CANDIDATE:
                 if (wb.my_rand > em.rand
                         or (wb.my_rand == em.rand
@@ -482,21 +582,38 @@ class GeecNode:
                                wb.delegator_port, em.version)
                     wb.max_election_retry = em.retry
         elif em.code == M.MSG_VOTE:
+            # votes are stashed with their signatures and batch-verified
+            # when the threshold is reached (_on_elected)
             if wb.elect_state == ELEC_CANDIDATE or self._phase == ELECTING:
                 wb.supporters.add(em.author)
+                self._stash_vote(em)
                 if (len(wb.supporters) >= wb.election_threshold
                         and self._phase == ELECTING):
                     self._on_elected()
             elif wb.elect_state == ELEC_VOTED:
-                # vote transfer: forward the original author's vote
+                # vote transfer: forward the original author's vote with
+                # its original signature (the signed fields exclude
+                # transport details, so the signature stays valid)
                 wb.supporters.add(em.author)
+                self._stash_vote(em)
                 fwd = M.ElectMessage(code=M.MSG_VOTE, block_num=em.block_num,
-                                     author=em.author, version=em.version,
+                                     author=em.author, rand=em.rand,
+                                     version=em.version,
                                      ip=self.cfg.consensus_ip,
-                                     port=self.cfg.consensus_port)
+                                     port=self.cfg.consensus_port,
+                                     sig=em.sig)
                 self.transport.send_direct(
                     wb.delegator_ip, wb.delegator_port,
                     M.pack_direct(M.UDP_ELECT, self.coinbase, fwd))
+
+    def _stash_vote(self, em: M.ElectMessage) -> None:
+        """Keep up to 2 distinct (sighash, sig) entries per claimed voter
+        so a spoofed garbage-sig vote can neither squat the slot nor
+        overwrite the genuine signature before the tally verifies."""
+        lst = self.wb.supporter_votes.setdefault(em.author, [])
+        entry = (em.signing_hash(), em.sig)
+        if len(lst) < 2 and entry not in lst:
+            lst.append(entry)
 
     def _vote(self, blk_num: int, ip: str, port: int, version: int) -> None:
         """(ref: vote election_go.go:312-340)"""
@@ -504,6 +621,8 @@ class GeecNode:
                                author=self.coinbase, version=version,
                                ip=self.cfg.consensus_ip,
                                port=self.cfg.consensus_port)
+        reply = dataclasses.replace(reply,
+                                    sig=self._sign(reply.signing_hash()))
         self.transport.send_direct(ip, port,
                                    M.pack_direct(M.UDP_ELECT, self.coinbase,
                                                  reply))
@@ -533,6 +652,9 @@ class GeecNode:
                 or not self.membership.is_committee(req.author, seed,
                                                     req.version)):
             return
+        # the proposal itself must be signed by the claimed proposer
+        if not self._verify_single(req.signing_hash(), req.sig, req.author):
+            return
         if req.version > wb.max_version:
             wb.bump_version(req.version)
         if req.retry <= wb.max_validate_retry:
@@ -556,7 +678,10 @@ class GeecNode:
                 fills.append(b)
         reply = M.ValidateReply(block_num=req.block_num, author=self.coinbase,
                                 accepted=True, retry=req.retry,
-                                fill_blocks=tuple(fills))
+                                fill_blocks=tuple(fills),
+                                block_hash=req.block.hash)
+        reply = dataclasses.replace(reply,
+                                    sig=self._sign(reply.signing_hash()))
         self.transport.send_direct(
             req.ip, req.port,
             M.pack_direct(M.UDP_EXAMINE_REPLY, self.coinbase, reply))
@@ -589,6 +714,8 @@ class GeecNode:
             # sync first (rate-limited), and let later confirms land
             # normally once the gap closes; if forged, nothing was harmed
             self._request_backfill(confirm.block_number)
+            return
+        if self._signing and not self._confirm_ok(confirm):
             return
         if confirm.empty_block:
             for n in sorted(self.pending_blocks):
@@ -639,6 +766,68 @@ class GeecNode:
         if behind or forked:
             self._request_backfill(confirm.block_number)
 
+    def _confirm_cert_entries(self, confirm: ConfirmBlockMsg):
+        """Reconstruct the per-supporter signing hashes of a confirm's
+        quorum certificate, or None if structurally invalid.
+
+        ``version == 0``: supporters signed ACKs (ValidateReply sighash,
+        which binds height + acceptor + the exact block hash).
+        ``version > 0``: supporters signed query replies for the
+        timeout-recovery outcome.  Receivers can therefore re-verify the
+        quorum with NO trust in the proposer — the upgrade over the
+        reference's trustedHW assumption (and over a single-member
+        signature, which one malicious member could mint alone)."""
+        sups, sigs = confirm.supporters, confirm.supporter_sigs
+        if (len(sups) != len(sigs) or len(set(sups)) != len(sups)
+                or len(sups) < self.membership.validate_threshold()):
+            return None
+        entries = []
+        for a, s in zip(sups, sigs):
+            if confirm.version == 0:
+                h = M.ValidateReply(block_num=confirm.block_number, author=a,
+                                    accepted=True,
+                                    block_hash=confirm.hash).signing_hash()
+            else:
+                h = M.QueryReply(
+                    block_num=confirm.block_number, author=a,
+                    version=confirm.version, empty=confirm.empty_block,
+                    block_hash=bytes(32) if confirm.empty_block
+                    else confirm.hash).signing_hash()
+            entries.append((a, h, s))
+        return entries
+
+    def _confirm_ok(self, confirm: ConfirmBlockMsg) -> bool:
+        """Signed-vote mode: a gossiped confirm is accepted only with a
+        valid quorum certificate (>= validate_threshold verified
+        supporter signatures; acceptor-window-checked when the seed for
+        that height is known) AND a member signature from its builder
+        (binds the confidence/supporter packaging to a member key).
+
+        The threshold is evaluated against membership as currently known.
+        A syncing node's membership starts at the genesis bootstrap list
+        and grows in step with the blocks it applies, so historical certs
+        meet the as-of-then threshold; the one rough edge is a live
+        confirm racing a threshold-raising membership change, which the
+        timeout/re-election ladder recovers from."""
+        entries = self._confirm_cert_entries(confirm)
+        if entries is None:
+            return False
+        valid = [a for a in self._recover_entries(entries) if a is not None]
+        need = self.membership.validate_threshold()
+        if len(valid) < need:
+            return False
+        seed = self.seed_for(confirm.block_number)
+        if seed is not None and sum(
+                1 for a in valid
+                if self.membership.is_acceptor(a, seed)) < need:
+            return False
+        if len(confirm.sig) != 65:
+            return False
+        from eges_tpu.crypto.verify_host import recover_signers
+        signer = recover_signers(
+            [(confirm.signing_hash(), confirm.sig)], self.verifier)[0]
+        return signer is not None and signer in self.membership
+
     # ------------------------------------------------------------------
     # backfill (downloader-sync stand-in; SURVEY §5 checkpoint/resume)
     # ------------------------------------------------------------------
@@ -678,11 +867,46 @@ class GeecNode:
             req.ip, req.port,
             M.pack_direct(M.UDP_BLOCKS, self.coinbase, reply))
 
+    def _filter_certified(self, blocks) -> list:
+        """Drop backfilled blocks whose quorum confirm doesn't verify —
+        a sync peer must not be able to hand us fabricated "confirmed"
+        history.  Locally-forced empty blocks (confidence 0) are
+        legitimately uncertified, and are exactly the blocks
+        replace_suffix may later displace.  All certificates across the
+        reply are recovered in ONE verifier batch."""
+        need = self.membership.validate_threshold()
+        spans = []          # (block_index, entry_span) needing verification
+        all_entries = []
+        keep = [True] * len(blocks)
+        for i, b in enumerate(blocks):
+            if b.confirm is None or b.confirm.confidence == 0:
+                continue
+            entries = self._confirm_cert_entries(b.confirm)
+            if entries is None:
+                keep[i] = False
+                continue
+            spans.append((i, len(all_entries), len(entries)))
+            all_entries.extend(entries)
+        recovered = self._recover_entries(all_entries) if all_entries else []
+        for i, start, n in spans:
+            valid = [a for a in recovered[start:start + n] if a is not None]
+            ok = len(valid) >= need
+            if ok:
+                seed = self.seed_for(blocks[i].number)
+                if seed is not None and sum(
+                        1 for a in valid
+                        if self.membership.is_acceptor(a, seed)) < need:
+                    ok = False
+            keep[i] = ok
+        return [b for i, b in enumerate(blocks) if keep[i]]
+
     def _handle_blocks_reply(self, reply: M.BlocksReply) -> None:
         """Backfilled canonical blocks: heal a local-empty-block fork via
         reorg, then extend normally.  If the fork is deeper than the
         reply's overlap, re-request further back (doubling window)."""
         blocks = sorted(reply.blocks, key=lambda b: b.number)
+        if self._signing:
+            blocks = self._filter_certified(blocks)
         if not blocks:
             return
         head = self.chain.height()
@@ -889,20 +1113,49 @@ class GeecNode:
         acceptor-window gate as the ACK tally: only seeded acceptors may
         count toward the query quorum."""
         wb = self.wb
-        if (reply.block_num != wb.blk_num or reply.version != wb.max_version
-                or reply.author in wb.query_replies):
+        if reply.block_num != wb.blk_num or reply.version != wb.max_version:
             return
         seed = self.seed_for(reply.block_num)
         if seed is None or not self.membership.is_acceptor(reply.author, seed):
             return
-        wb.query_replies[reply.author] = reply.retry
-        if reply.empty:
-            wb.query_empty_count += 1
-        else:
-            wb.query_nonempty_count += 1
-            self._query_block_hash = reply.block_hash
+        lst = wb.query_replies.setdefault(reply.author, [])
+        if len(lst) < 2 and all(r.sig != reply.sig for r in lst):
+            lst.append(reply)
         if (len(wb.query_replies) >= wb.query_threshold
                 and not wb.query_recv_majority):
+            if self._signing:
+                items = [(r.author, r.signing_hash(), r.sig)
+                         for rl in wb.query_replies.values() for r in rl]
+                cert = self._verify_quorum(items)
+                for a in list(wb.query_replies):
+                    if a not in cert:
+                        del wb.query_replies[a]
+                if len(wb.query_replies) < wb.query_threshold:
+                    return  # keep collecting; query retry re-solicits
+                wb.query_cert = cert
+                # the verified reply per author = the one whose sig the
+                # batch recovered
+                wb.query_verified = {
+                    a: next(r for r in rl if r.sig == cert[a])
+                    for a, rl in wb.query_replies.items()}
+            else:
+                wb.query_verified = {a: rl[0]
+                                     for a, rl in wb.query_replies.items()}
+            # tally from the verified replies only
+            replies = list(wb.query_verified.values())
+            wb.query_empty_count = sum(1 for r in replies if r.empty)
+            nonempty = [r.block_hash for r in replies if not r.empty]
+            if nonempty:
+                # majority hash among non-empty answers
+                self._query_block_hash = max(set(nonempty),
+                                             key=nonempty.count)
+            if self._signing:
+                # the cert must be coherent: only same-hash answers can
+                # certify a non-empty outcome
+                wb.query_nonempty_count = (
+                    nonempty.count(self._query_block_hash) if nonempty else 0)
+            else:
+                wb.query_nonempty_count = len(nonempty)
             wb.query_recv_majority = True
             self._cancel_timer("query")
             self._resolve_query(reply.block_num, reply.version)
@@ -912,24 +1165,41 @@ class GeecNode:
         wb = self.wb
         head = self.chain.head()
         head_conf = head.confirm.confidence if head.confirm else 0
+        def query_cert(members) -> tuple[tuple, tuple]:
+            sups = tuple(members)
+            sigs = (tuple(wb.query_cert.get(a, b"") for a in sups)
+                    if self._signing else ())
+            return sups, sigs
+
         if wb.query_empty_count >= wb.query_threshold:
-            # nobody saw a block: confirm an empty one
+            # nobody saw a block: confirm an empty one.  The quorum cert
+            # is the empty-answering repliers' signatures (version > 0
+            # marks it as a query cert for receivers).
             self._phase = IDLE
             empty = self.chain.make_empty_block()
+            sups, sigs = query_cert(
+                a for a, r in wb.query_verified.items() if r.empty)
             confirm = ConfirmBlockMsg(block_number=blk_num, hash=empty.hash,
                                       confidence=calc_confidence(head_conf),
-                                      supporters=tuple(wb.query_replies),
-                                      empty_block=True)
+                                      supporters=sups, empty_block=True,
+                                      version=version, supporter_sigs=sigs)
+            confirm = dataclasses.replace(
+                confirm, sig=self._sign(confirm.signing_hash()))
             self.chain.offer(empty.with_confirm(confirm))
             self.transport.gossip(M.pack_gossip(M.GOSSIP_CONFIRM_BLOCK, confirm))
         elif wb.query_nonempty_count >= wb.query_threshold:
             # majority saw the block: confirm it
             self._phase = IDLE
+            sups, sigs = query_cert(
+                a for a, r in wb.query_verified.items()
+                if not r.empty and r.block_hash == self._query_block_hash)
             confirm = ConfirmBlockMsg(block_number=blk_num,
                                       hash=self._query_block_hash,
                                       confidence=calc_confidence(head_conf),
-                                      supporters=tuple(wb.query_replies),
-                                      empty_block=False)
+                                      supporters=sups, empty_block=False,
+                                      version=version, supporter_sigs=sigs)
+            confirm = dataclasses.replace(
+                confirm, sig=self._sign(confirm.signing_hash()))
             pending = self.pending_blocks.get(blk_num)
             if pending is not None and pending.hash == confirm.hash:
                 self.chain.offer(pending.with_confirm(confirm))
@@ -945,6 +1215,7 @@ class GeecNode:
                 ip=self.cfg.consensus_ip, port=self.cfg.consensus_port,
                 retry=0, version=version,
                 empty_list=tuple(self.empty_block_list))
+            req = dataclasses.replace(req, sig=self._sign(req.signing_hash()))
             self._proposal = pending
             self._proposal_version = version
             self._ask_for_ack(req)
@@ -977,6 +1248,8 @@ class GeecNode:
             version=query.version, retry=query.retry,
             empty=pending is None,
             block_hash=pending.hash if pending is not None else bytes(32))
+        reply = dataclasses.replace(reply,
+                                    sig=self._sign(reply.signing_hash()))
         self.transport.send_direct(
             query.ip, query.port,
             M.pack_direct(M.UDP_QUERY_REPLY, self.coinbase, reply))

@@ -43,6 +43,11 @@ class WorkingBlock:
         # election
         self.elect_state = ELEC_CANDIDATE
         self.supporters: set[bytes] = set()
+        # signed-vote mode: up to 2 distinct (signing_hash, sig) entries
+        # per claimed voter, batch-verified when the threshold is reached
+        # — multiple entries so a spoofed garbage-sig vote can neither
+        # squat the slot nor overwrite the genuine one
+        self.supporter_votes: dict[bytes, list[tuple[bytes, bytes]]] = {}
         self.my_rand = self._rng.getrandbits(64)
         self.delegator: bytes = self.coinbase
         self.delegator_ip: str = ""
@@ -50,13 +55,20 @@ class WorkingBlock:
         self.max_election_retry = 0
         self.n_candidates = 0
         self.election_threshold = 1 << 62
-        # validation (proposer side)
+        # validation (proposer side) — up to 2 distinct stored replies per
+        # claimed author (see supporter_votes note)
         self.is_proposer = False
-        self.validate_replies: dict[bytes, int] = {}
+        self.validate_replies: dict[bytes, list] = {}  # addr -> [ValidateReply]
         self.validate_threshold = 1 << 62
         self.validate_succeeded = False
+        # signed-vote mode: the verified ACK signature per supporter,
+        # harvested at quorum time — becomes the confirm's quorum cert
+        self.validate_cert: dict[bytes, bytes] = {}
         # query (recovery side)
-        self.query_replies: dict[bytes, int] = {}
+        self.query_replies: dict[bytes, list] = {}  # addr -> [QueryReply]
+        # quorum-verified reply and signature per author (set at tally)
+        self.query_verified: dict[bytes, object] = {}
+        self.query_cert: dict[bytes, bytes] = {}
         self.query_empty_count = 0
         self.query_nonempty_count = 0
         self.query_threshold = 1 << 62
@@ -80,3 +92,4 @@ class WorkingBlock:
             self.max_validate_retry = -1
             self.elect_state = ELEC_CANDIDATE
             self.supporters.clear()
+            self.supporter_votes.clear()

@@ -74,23 +74,44 @@ class Registration:
 
 @dataclass(frozen=True)
 class ConfirmBlockMsg:
-    """Leader's confirmation broadcast (ref: core/types/geec.go:30-36)."""
+    """Leader's confirmation broadcast (ref: core/types/geec.go:30-36).
+
+    This build's upgrade over the reference's trustedHW assumption: in
+    signed-vote mode the confirm is a **quorum certificate** — beside the
+    proposer's own ``sig``, ``supporter_sigs[i]`` is ``supporters[i]``'s
+    signature over its ACK (``version == 0``) or query reply
+    (``version > 0``, the timeout-recovery path), so ANY receiver can
+    re-verify the whole quorum as one device batch without trusting the
+    proposer.  All three extra fields are empty in unsigned deployments."""
 
     block_number: int
     hash: bytes
     confidence: int
     supporters: tuple[bytes, ...] = ()
     empty_block: bool = False
+    sig: bytes = b""
+    version: int = 0
+    supporter_sigs: tuple[bytes, ...] = ()
 
     def to_rlp(self) -> list:
         return [self.block_number, self.hash, self.confidence,
-                list(self.supporters), int(self.empty_block)]
+                list(self.supporters), int(self.empty_block), self.sig,
+                self.version, list(self.supporter_sigs)]
 
     @classmethod
     def from_rlp(cls, item: list) -> "ConfirmBlockMsg":
-        num, h, conf, sup, empty = item
+        # tolerate the shorter pre-signature wire forms (old stored blocks)
+        num, h, conf, sup, empty = item[:5]
         return cls(rlp.decode_uint(num), bytes(h), rlp.decode_uint(conf),
-                   tuple(_addr(a) for a in sup), bool(rlp.decode_uint(empty)))
+                   tuple(_addr(a) for a in sup), bool(rlp.decode_uint(empty)),
+                   sig=bytes(item[5]) if len(item) > 5 else b"",
+                   version=rlp.decode_uint(item[6]) if len(item) > 6 else 0,
+                   supporter_sigs=tuple(bytes(s) for s in item[7])
+                   if len(item) > 7 else ())
+
+    def signing_hash(self) -> bytes:
+        return keccak256(b"geec/confirm" + rlp.encode(
+            self.to_rlp()[:5] + [self.version]))
 
 
 @dataclass(frozen=True)

@@ -44,3 +44,36 @@ def batch_verify_txns(txns, verifier) -> bool:
         hashes[i] = np.frombuffer(h, np.uint8)
     _, ok = verifier.recover_addresses(sigs, hashes)
     return bool(ok.all())
+
+
+def recover_signers(entries, verifier) -> list:
+    """Batch-recover the signer address of each ``(sighash32, sig65)``
+    entry; returns one 20-byte address or ``None`` per entry.
+
+    This is the vote-authentication path (BASELINE config 3: validator
+    ACK votes and election votes ride the device batch): a quorum tally
+    collects signed votes, then recovers ALL signers in one device call
+    and counts only votes whose signer matches the claimed author.
+    ``verifier=None`` falls back to per-entry host recovery.
+    """
+    out = []
+    if verifier is None:
+        from eges_tpu.crypto import secp256k1 as host
+
+        for h, sig in entries:
+            try:
+                out.append(host.recover_address(h, sig))
+            except Exception:
+                out.append(None)
+        return out
+    sigs = np.zeros((len(entries), 65), np.uint8)
+    hashes = np.zeros((len(entries), 32), np.uint8)
+    for i, (h, sig) in enumerate(entries):
+        if len(sig) != 65 or len(h) != 32:
+            continue  # left zeroed: an all-zero sig recovers as invalid
+        sigs[i] = np.frombuffer(sig, np.uint8)
+        hashes[i] = np.frombuffer(h, np.uint8)
+    addrs, ok = verifier.recover_addresses(sigs, hashes)
+    for i in range(len(entries)):
+        out.append(bytes(addrs[i]) if ok[i] else None)
+    return out

@@ -78,7 +78,8 @@ class NodeService:
 
         import dataclasses
         ncfg = dataclasses.replace(cfg.node or NodeConfig(),
-                                   coinbase=self.coinbase)
+                                   coinbase=self.coinbase,
+                                   privkey=priv)
 
         self.clock = AsyncioClock(asyncio.get_event_loop())
         self.node = GeecNode(self.chain, self.clock, None, ncfg, chain_cfg,

@@ -34,7 +34,7 @@ class SimCluster:
                  block_timeout_s: float = 20.0, validate_timeout_ms: float = 500,
                  backoff_time_ms: float = 0.0, reg_timeout_s: float = 10.0,
                  drop_rate: float = 0.0, failure_test: bool = False,
-                 verifier=None, mine=None):
+                 verifier=None, mine=None, signed: bool = False):
         self.clock = SimClock()
         self.net = SimNet(self.clock, seed=seed, drop_rate=drop_rate)
         self.nodes: list[SimNode] = []
@@ -51,7 +51,8 @@ class SimCluster:
         ccfg = ChainGeecConfig(bootstrap=boot,
                                validate_timeout_ms=validate_timeout_ms,
                                backoff_time_ms=backoff_time_ms,
-                               reg_timeout_s=reg_timeout_s)
+                               reg_timeout_s=reg_timeout_s,
+                               signed_votes=signed)
         genesis = make_genesis()
 
         for i in range(n_nodes):
@@ -61,7 +62,8 @@ class SimCluster:
                 consensus_port=8100 + i, n_candidates=n_candidates,
                 n_acceptors=n_acceptors, txn_per_block=txn_per_block,
                 txn_size=txn_size, block_timeout_s=block_timeout_s,
-                total_nodes=n_nodes, failure_test=failure_test)
+                total_nodes=n_nodes, failure_test=failure_test,
+                privkey=privs[i] if signed else b"")
             chain = BlockChain(genesis=genesis, verifier=verifier)
             node = GeecNode(chain, self.clock, None, ncfg, ccfg,
                             mine=(mine[i] if mine is not None else True),

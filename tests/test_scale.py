@@ -1,0 +1,78 @@
+"""Scale tests (BASELINE config 2 territory): 64-validator membership,
+election and quorum semantics, plus a soak-style liveness run.
+
+The round-1 suite never exceeded 4 nodes; these exercise the membership
+windows, vote fan-in and relay dedup at a size where committee << total
+and most nodes are pure followers.
+"""
+
+import pytest
+
+from eges_tpu.consensus.membership import Member, Membership, derive_seed
+from eges_tpu.sim.cluster import SimCluster
+
+
+def test_window_semantics_at_64():
+    """Committee/acceptor windows over 64 members: correct size, seed
+    dependence, wrap-around, and version derivation."""
+    m = Membership(n_candidates=8, n_acceptors=16)
+    addrs = [bytes([i + 1]) * 20 for i in range(64)]
+    for a in addrs:
+        m.add(Member(addr=a, ip="10.0.0.1", port=1, ttl=50))
+
+    for seed in (0, 7, 63, 64, 1 << 40):
+        com = m.committee(seed)
+        acc = m.acceptors(seed)
+        assert len(com) == 8 and len(acc) == 16
+        for mem in com:
+            assert m.is_committee(mem.addr, seed)
+        for mem in acc:
+            assert m.is_acceptor(mem.addr, seed)
+    # wrap-around window (start near the end)
+    com = m.committee(63)
+    assert len(com) == 8 and len({c.addr for c in com}) == 8
+    # most members are NOT committee at any given seed
+    outside = [a for a in addrs if not m.is_committee(a, 12345)]
+    assert len(outside) == 64 - 8
+    # versioned re-election moves the window deterministically
+    assert ({c.addr for c in m.committee(9, version=1)}
+            != {c.addr for c in m.committee(9, version=0)}
+            or derive_seed(9, 1) % 64 == 9 % 64)
+    # thresholds at this size
+    assert m.validate_threshold() == (16 + 1 + 1) // 2
+    assert m.election_threshold(8) == (8 + 1 + 1) // 2 - 1
+
+
+def test_64_node_cluster_liveness():
+    """64 real state machines confirm blocks in lockstep."""
+    c = SimCluster(64, n_candidates=8, n_acceptors=16, txn_per_block=2,
+                   seed=21)
+    c.start()
+    c.run(120, stop_condition=lambda: c.min_height() >= 5)
+    assert c.min_height() >= 5, sorted(set(c.heights()))
+    h = c.min_height()
+    assert len({sn.chain.get_block_by_number(h).hash for sn in c.nodes}) == 1
+
+
+def test_64_node_signed_soak():
+    """Soak at 64 validators with signed votes + native host crypto:
+    the test-sep-2.sh criterion (chain keeps advancing) at config-2
+    scale, with every quorum signature-verified."""
+    c = SimCluster(64, n_candidates=8, n_acceptors=16, txn_per_block=2,
+                   seed=33, signed=True)
+    c.start()
+    c.run(300, stop_condition=lambda: c.min_height() >= 12)
+    assert c.min_height() >= 12, sorted(set(c.heights()))
+    h = c.min_height()
+    assert len({sn.chain.get_block_by_number(h).hash for sn in c.nodes}) == 1
+
+
+def test_16_node_lossy_convergence():
+    """Packet loss at a size where relay redundancy matters."""
+    c = SimCluster(16, n_candidates=4, n_acceptors=8, txn_per_block=2,
+                   seed=5, drop_rate=0.1, block_timeout_s=2.0)
+    c.start()
+    c.run(240, stop_condition=lambda: c.min_height() >= 10)
+    assert c.min_height() >= 10, sorted(set(c.heights()))
+    h = c.min_height()
+    assert len({sn.chain.get_block_by_number(h).hash for sn in c.nodes}) == 1

@@ -1,0 +1,205 @@
+"""Signed-vote mode (BASELINE config 3): election votes, validator ACKs,
+query replies and confirms carry secp256k1 signatures, and quorum tallies
+batch-verify them — through the device verifier when one is attached.
+
+The reference skates on its trustedHW assumption (unsigned ValidateReply,
+ref: core/geec_state.go:528-591); this is the build's upgrade over it.
+"""
+
+import dataclasses
+
+from eges_tpu.consensus import messages as M
+from eges_tpu.consensus.config import BootstrapNode, ChainGeecConfig, NodeConfig
+from eges_tpu.consensus.node import GeecNode, ELECTING, VALIDATING
+from eges_tpu.consensus.working_block import ELEC_CANDIDATE
+from eges_tpu.core.chain import BlockChain, make_genesis
+from eges_tpu.core.types import ConfirmBlockMsg, Header, new_block
+from eges_tpu.crypto import secp256k1 as secp
+from eges_tpu.sim.cluster import SimCluster
+from eges_tpu.sim.simnet import SimClock
+
+
+class StubTransport:
+    def __init__(self):
+        self.gossiped = []
+        self.directs = []
+
+    def gossip(self, data):
+        self.gossiped.append(data)
+
+    def send_direct(self, ip, port, data):
+        self.directs.append((ip, port, data))
+
+
+def mk_signed_node(n_members=6, n_candidates=6, n_acceptors=6):
+    """A node on a signed chain whose members all have real keys."""
+    privs = [bytes([i + 1]) * 32 for i in range(n_members)]
+    addrs = [secp.pubkey_to_address(secp.privkey_to_pubkey(p)) for p in privs]
+    boot = tuple(BootstrapNode(account=a, ip=f"10.0.0.{i+1}", port=8100 + i)
+                 for i, a in enumerate(addrs))
+    ccfg = ChainGeecConfig(bootstrap=boot, signed_votes=True)
+    ncfg = NodeConfig(coinbase=addrs[0], consensus_ip="10.0.0.1",
+                      consensus_port=8100, n_candidates=n_candidates,
+                      n_acceptors=n_acceptors, txn_per_block=4,
+                      total_nodes=n_members, privkey=privs[0])
+    chain = BlockChain(genesis=make_genesis())
+    node = GeecNode(chain, SimClock(), StubTransport(), ncfg, ccfg, mine=True)
+    return node, privs, addrs
+
+
+def signed_ack(node, priv, addr, block):
+    r = M.ValidateReply(block_num=block.number, author=addr,
+                        block_hash=block.hash)
+    return dataclasses.replace(r, sig=secp.ecdsa_sign(r.signing_hash(), priv))
+
+
+def test_forged_ack_rejected_quorum_completes():
+    """A forged ACK (right acceptor address, wrong key) must not count;
+    the quorum still completes once enough genuine ACKs arrive."""
+    node, privs, addrs = mk_signed_node()
+    blk = new_block(Header(parent_hash=node.chain.head().hash, number=1,
+                           coinbase=addrs[0], time=1, trust_rand=5))
+    node._phase = VALIDATING
+    node._proposal = blk
+    node.wb.validate_threshold = 3
+
+    # two genuine ACKs from members 1,2
+    for i in (1, 2):
+        node._handle_validate_reply(signed_ack(node, privs[i], addrs[i], blk))
+    # forged ACK claiming member 3 but signed with the wrong key
+    forged = M.ValidateReply(block_num=1, author=addrs[3],
+                             block_hash=blk.hash)
+    forged = dataclasses.replace(
+        forged, sig=secp.ecdsa_sign(forged.signing_hash(), privs[4]))
+    node._handle_validate_reply(forged)
+    # threshold count was reached (3 stored) but the batch verify pruned
+    # the forgery -> still VALIDATING, not BACKOFF
+    assert node._phase == VALIDATING
+    assert addrs[3] not in node.wb.validate_replies
+
+    # a genuine third ACK completes the quorum
+    node._handle_validate_reply(signed_ack(node, privs[3], addrs[3], blk))
+    assert node._phase != VALIDATING  # moved to BACKOFF
+    assert set(node.wb.validate_replies) == {addrs[1], addrs[2], addrs[3]}
+
+
+def test_ack_for_wrong_block_ignored():
+    node, privs, addrs = mk_signed_node()
+    blk = new_block(Header(parent_hash=node.chain.head().hash, number=1,
+                           coinbase=addrs[0], time=1, trust_rand=5))
+    other = new_block(Header(parent_hash=node.chain.head().hash, number=1,
+                             coinbase=addrs[1], time=2, trust_rand=6))
+    node._phase = VALIDATING
+    node._proposal = blk
+    node.wb.validate_threshold = 1
+    node._handle_validate_reply(signed_ack(node, privs[1], addrs[1], other))
+    assert node._phase == VALIDATING  # ACK for a different proposal
+
+
+def test_forged_election_vote_pruned():
+    node, privs, addrs = mk_signed_node()
+    node._phase = ELECTING
+    node.wb.elect_state = ELEC_CANDIDATE
+    node.wb.election_threshold = 2
+    node.wb.max_version = 0  # _start_election would have set this
+
+    def vote(i, forge_with=None):
+        v = M.ElectMessage(code=M.MSG_VOTE, block_num=node.wb.blk_num,
+                           author=addrs[i])
+        key = privs[forge_with] if forge_with is not None else privs[i]
+        return dataclasses.replace(v, sig=secp.ecdsa_sign(v.signing_hash(), key))
+
+    node._handle_elect_message(vote(1))
+    node._handle_elect_message(vote(2, forge_with=3))  # forged
+    # count hit the threshold but the forged vote is pruned at the tally
+    assert node._phase == ELECTING
+    assert addrs[2] not in node.wb.supporters
+    node._handle_elect_message(vote(3))
+    assert node.wb.is_proposer  # genuine quorum elects
+
+
+def test_forged_candidacy_does_not_steal_vote():
+    node, privs, addrs = mk_signed_node()
+    cand = M.ElectMessage(code=M.MSG_ELECT, block_num=node.wb.blk_num,
+                          author=addrs[1], rand=1 << 63, ip="10.0.0.2",
+                          port=8101)
+    forged = dataclasses.replace(
+        cand, sig=secp.ecdsa_sign(cand.signing_hash(), privs[2]))
+    node._handle_elect_message(forged)
+    assert node.wb.elect_state == ELEC_CANDIDATE  # did not vote
+    genuine = dataclasses.replace(
+        cand, sig=secp.ecdsa_sign(cand.signing_hash(), privs[1]))
+    node._handle_elect_message(genuine)
+    assert node.wb.delegator == addrs[1]
+
+
+def test_confirm_requires_quorum_certificate():
+    """A confirm is only accepted with >= validate_threshold verified
+    supporter (ACK) signatures — a single member, malicious or not,
+    cannot mint confirmed history by itself."""
+    node, privs, addrs = mk_signed_node()
+    g = node.chain.head()
+    blk = new_block(Header(parent_hash=g.hash, number=1, coinbase=addrs[1],
+                           time=1, trust_rand=5))
+    node.pending_blocks[1] = blk
+    need = node.membership.validate_threshold()
+
+    def ack_sig(i, h=None):
+        r = M.ValidateReply(block_num=1, author=addrs[i], accepted=True,
+                            block_hash=h if h is not None else blk.hash)
+        return secp.ecdsa_sign(r.signing_hash(), privs[i])
+
+    base = ConfirmBlockMsg(block_number=1, hash=blk.hash, confidence=1000)
+    # no certificate at all
+    node._handle_confirm(base)
+    assert node.chain.height() == 0 and node.max_confirmed_block == 0
+    # proposer-signed but certless (the single-malicious-member attack)
+    node._handle_confirm(dataclasses.replace(
+        base, sig=secp.ecdsa_sign(base.signing_hash(), privs[1])))
+    assert node.chain.height() == 0
+    # cert signed entirely by ONE member repeated (duplicate supporters)
+    node._handle_confirm(dataclasses.replace(
+        base, supporters=(addrs[1],) * need,
+        supporter_sigs=(ack_sig(1),) * need))
+    assert node.chain.height() == 0
+    # cert with forged signatures (signed over a different block hash)
+    node._handle_confirm(dataclasses.replace(
+        base, supporters=tuple(addrs[1:need + 1]),
+        supporter_sigs=tuple(ack_sig(i, h=b"\xcd" * 32)
+                             for i in range(1, need + 1))))
+    assert node.chain.height() == 0
+    # genuine quorum certificate + member builder signature applies
+    good = dataclasses.replace(
+        base, supporters=tuple(addrs[1:need + 1]),
+        supporter_sigs=tuple(ack_sig(i) for i in range(1, need + 1)))
+    # ...but only when the builder signature is also a member's
+    node._handle_confirm(good)  # certified yet unsigned builder: dropped
+    assert node.chain.height() == 0
+    node._handle_confirm(dataclasses.replace(
+        good, sig=secp.ecdsa_sign(good.signing_hash(), privs[1])))
+    assert node.chain.height() == 1
+
+
+def test_signed_cluster_liveness():
+    """End-to-end: a 4-node signed-vote cluster keeps confirming blocks."""
+    c = SimCluster(4, txn_per_block=2, seed=3, signed=True)
+    c.start()
+    c.run(120, stop_condition=lambda: c.min_height() >= 10)
+    assert c.min_height() >= 10, c.heights()
+    h = c.min_height()
+    assert len({sn.chain.get_block_by_number(h).hash for sn in c.nodes}) == 1
+
+
+def test_signed_cluster_with_device_verifier():
+    """TPU-in-the-loop: the same signed cluster with a real BatchVerifier
+    — every quorum tally's signature batch runs through the device path
+    (CPU-jax under the test env)."""
+    from eges_tpu.crypto.verifier import BatchVerifier
+
+    bv = BatchVerifier()
+    c = SimCluster(3, txn_per_block=2, seed=7, signed=True, verifier=bv)
+    c.start()
+    c.run(60, stop_condition=lambda: c.min_height() >= 5)
+    assert c.min_height() >= 5, c.heights()
+    h = c.min_height()
+    assert len({sn.chain.get_block_by_number(h).hash for sn in c.nodes}) == 1
