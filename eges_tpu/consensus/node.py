@@ -380,13 +380,6 @@ class GeecNode:
         parent = self.chain.head()
         regs = tuple(self.pending_regs[a] for a in
                      sorted(self.pending_regs)[: self.ccfg.max_reg_per_blk])
-        header = Header(
-            parent_hash=parent.hash, number=blk_num,
-            coinbase=self.coinbase, difficulty=100,
-            time=max(int(self.clock.now()), parent.header.time + 1),
-            root=parent.header.root, regs=regs,
-            trust_rand=self.wb._rng.getrandbits(64),  # seed for NEXT block
-        )
         n = min(len(self.pending_geec_txns), self.cfg.txn_per_block)
         geec_txns = tuple(self.pending_geec_txns[:n])
         self.pending_geec_txns = self.pending_geec_txns[n:]
@@ -395,8 +388,25 @@ class GeecNode:
         self._proposal_geec_txns = list(geec_txns)
         fakes = tuple(fake_txn(self.cfg.txn_size, seq=i)
                       for i in range(self.cfg.txn_per_block - n))
-        txs = (tuple(self.txpool.pending_txns(self.cfg.txn_per_block))
+        # signed txns execute: dry-run them on the head state for the
+        # header's state/receipt/gas commitments (L3; worker.go:463-467)
+        txs = (tuple(self.txpool.pending_txns(
+            self.cfg.txn_per_block, state=self.chain.head_state()))
                if self.txpool is not None else ())
+        if txs:
+            txs, root, receipt_hash, gas_used = \
+                self.chain.execute_preview(txs, self.coinbase)
+        else:
+            from eges_tpu.core.trie import EMPTY_ROOT
+            root, receipt_hash, gas_used = (parent.header.root, EMPTY_ROOT, 0)
+        header = Header(
+            parent_hash=parent.hash, number=blk_num,
+            coinbase=self.coinbase, difficulty=100,
+            time=max(int(self.clock.now()), parent.header.time + 1),
+            root=root, receipt_hash=receipt_hash, gas_used=gas_used,
+            regs=regs,
+            trust_rand=self.wb._rng.getrandbits(64),  # seed for NEXT block
+        )
         return new_block(header, txs=txs, geec_txns=geec_txns,
                          fake_txns=fakes)
 
@@ -688,14 +698,11 @@ class GeecNode:
 
     def _validate_block(self, block: Block) -> bool:
         """Acceptor-side block check.  The reference ACKs unconditionally
-        (``valResult := true``, geec_state.go:545); here the signed txns
-        are batch-verified on device — the capability BASELINE.json
-        targets.  Same implementation as the insert path
-        (chain._verify_body) by construction."""
-        from eges_tpu.crypto.verify_host import batch_verify_txns
-        if self.verifier is None:
-            return True
-        return batch_verify_txns(block.transactions, self.verifier)
+        (``valResult := true``, geec_state.go:545); here the full insert
+        validation runs BEFORE ACKing: ancestry, tx root, batched sender
+        recovery on device, and the state/receipt/gas commitments — the
+        capability BASELINE.json targets."""
+        return self.chain.validate_candidate(block)
 
     # ------------------------------------------------------------------
     # confirm handling (ref: eth/handler.go:785-871)

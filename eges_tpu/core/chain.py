@@ -135,12 +135,16 @@ class FileStore(MemoryStore):
         self._log.close()
 
 
-def make_genesis(extra: bytes = b"geec-genesis", time: int = 0) -> Block:
+def make_genesis(extra: bytes = b"geec-genesis", time: int = 0,
+                 alloc: dict[bytes, int] | None = None) -> Block:
     """Genesis block; the ``"thw"`` consensus config lives in the genesis
     JSON beside it (ref: core/genesis.go SetupGenesisBlock +
-    params/config.go:124)."""
+    params/config.go:124).  ``alloc`` (address -> balance) sets the
+    genesis state root (ref: GenesisAlloc, core/genesis.go:228)."""
+    from eges_tpu.core.state import StateDB
+    root = StateDB.from_alloc(alloc or {}).root()
     return new_block(Header(number=0, time=time, extra=extra,
-                            parent_hash=ZERO_HASH, trust_rand=0))
+                            parent_hash=ZERO_HASH, trust_rand=0, root=root))
 
 
 class BlockChain:
@@ -157,8 +161,14 @@ class BlockChain:
 
     _MAX_CANDIDATES = 4  # buffered blocks per height (distinct hashes)
 
+    # keep a state snapshot for this many recent blocks (older heights
+    # are final many times over; restart replays from genesis anyway)
+    _STATE_KEEP = 1024
+
     def __init__(self, store=None, genesis: Block | None = None,
-                 verifier=None, listeners=()):
+                 verifier=None, listeners=(), alloc=None):
+        from eges_tpu.core.state import StateDB
+
         self.store = store if store is not None else MemoryStore()
         self.verifier = verifier
         self._listeners = list(listeners)
@@ -170,10 +180,16 @@ class BlockChain:
         self._future: dict[int, list[Block]] = {}
         self.bad_blocks = 0
         self.last_error: str | None = None
+        self.alloc = dict(alloc or {})
+        # state snapshots + receipts per canonical block hash (L3)
+        self._states: dict[bytes, object] = {}
+        self._state_height: dict[bytes, int] = {}
+        self._receipts: dict[bytes, tuple] = {}
 
         head_hash = self.store.get_head()
         if head_hash is None:
-            self.genesis = genesis if genesis is not None else make_genesis()
+            self.genesis = genesis if genesis is not None else make_genesis(
+                alloc=self.alloc)
             self.store.put_block(self.genesis)
             self.store.set_head(self.genesis.hash)
             self._head = self.genesis
@@ -181,6 +197,19 @@ class BlockChain:
             self._head = self.store.get_block(head_hash)
             g = self.store.get_block(self.store.get_hash_by_number(0))
             self.genesis = g if g is not None else genesis
+
+        gstate = StateDB.from_alloc(self.alloc)
+        if self.genesis is not None and self.genesis.header.root != gstate.root():
+            raise ChainError("genesis state root does not match alloc")
+        self._remember_state(self.genesis.hash, 0, gstate, ())
+        # restart: rebuild state snapshots by replaying the stored chain
+        # (the reference replays into StateDB from LevelDB; here states
+        # are in-memory and derived, SURVEY §5 checkpoint/resume)
+        for n in range(1, self._head.number + 1):
+            blk = self.get_block_by_number(n)
+            parent_state = self._states[blk.header.parent_hash]
+            state, receipts, _ = self._process(blk, parent_state)
+            self._remember_state(blk.hash, n, state, receipts)
 
     # -- reads ------------------------------------------------------------
 
@@ -220,8 +249,7 @@ class BlockChain:
 
     def _verify_body(self, block: Block) -> None:
         """Uncle/tx-root checks (ref: core/block_validator.go:51-76;
-        Geec/fake txns are outside TxHash by design) + batched sender
-        recovery of the rooted txns — the TPU hot path (SURVEY §3.5)."""
+        Geec/fake txns are outside TxHash by design)."""
         if block.uncles:
             raise ChainError("uncles not allowed")  # geec.go:215-219
         from eges_tpu.core.trie import derive_sha, EMPTY_ROOT
@@ -229,9 +257,114 @@ class BlockChain:
                 if block.transactions else EMPTY_ROOT)
         if block.header.tx_hash != want:
             raise ChainError("transaction root mismatch")
-        from eges_tpu.crypto.verify_host import batch_verify_txns
-        if not batch_verify_txns(block.transactions, self.verifier):
-            raise ChainError("invalid transaction signature")
+
+    def _process(self, block: Block, parent_state):
+        """Batched sender recovery (the TPU hot path, SURVEY §3.5) +
+        transaction application; validates state/receipt/gas commitments
+        (ref: core/block_validator.go:82-105 ValidateState)."""
+        from eges_tpu.core.state import (
+            StateError, process_block, receipts_root, recover_senders,
+        )
+        try:
+            senders = recover_senders(block.transactions, self.verifier)
+            state, receipts, gas = process_block(parent_state, block, senders)
+        except StateError as e:
+            raise ChainError(str(e))
+        if block.header.root != state.root():
+            raise ChainError("state root mismatch")
+        if block.header.receipt_hash != receipts_root(receipts):
+            raise ChainError("receipt root mismatch")
+        if block.header.gas_used != gas:
+            raise ChainError("gas used mismatch")
+        return state, receipts, gas
+
+    def _remember_state(self, block_hash: bytes, height: int, state,
+                        receipts) -> None:
+        self._states[block_hash] = state
+        self._state_height[block_hash] = height
+        self._receipts[block_hash] = tuple(receipts)
+        if len(self._states) > self._STATE_KEEP + 64:
+            # prune relative to the height being remembered, NOT the
+            # stored head: during restart replay the head is already at
+            # its final height while replay is still early, and pruning
+            # by the final head would delete the parent state the next
+            # replay iteration needs
+            floor = height - self._STATE_KEEP
+            for h, n in list(self._state_height.items()):
+                if 0 < n < floor:
+                    self._states.pop(h, None)
+                    self._state_height.pop(h, None)
+                    self._receipts.pop(h, None)
+
+    # -- state reads (L3 surface for RPC / txpool / acceptors) ------------
+
+    def state_at(self, block_hash: bytes):
+        return self._states.get(block_hash)
+
+    def head_state(self):
+        return self._states[self._head.hash]
+
+    def receipts_of(self, block_hash: bytes) -> tuple:
+        return self._receipts.get(block_hash, ())
+
+    def execute_preview(self, txs, coinbase: bytes = bytes(20)) -> tuple:
+        """Proposer-side dry run on top of the head state: greedily apply
+        ``txs``, dropping any that cannot execute, and return
+        ``(kept_txs, root, receipt_root, gas_used)`` for the new header
+        (the role of the worker's commitTransactions loop,
+        ref: miner/worker.go:463-467).  ``coinbase`` is the PROPOSED
+        block's fee recipient — it must match the header being built or
+        the state root will not."""
+        from eges_tpu.core.state import (
+            StateError, apply_txn, receipts_root, recover_senders,
+        )
+        with self._lock:
+            state = self.head_state().copy()
+            try:
+                senders = recover_senders(txs, self.verifier)
+            except StateError:
+                senders = [None] * len(txs)
+            kept, receipts, gas = [], [], 0
+            for t, sender in zip(txs, senders):
+                if sender is None:
+                    continue
+                try:
+                    r = apply_txn(state, t, sender, coinbase, gas)
+                except StateError:
+                    continue
+                gas = r.cumulative_gas_used
+                receipts.append(r)
+                kept.append(t)
+            return kept, state.root(), receipts_root(receipts), gas
+
+    def validate_candidate(self, block: Block) -> bool:
+        """Full acceptor-side validation of a proposed block WITHOUT
+        inserting: ancestry, tx root, signatures, state/receipt/gas
+        commitments — the checks the insert path will make, run before
+        ACKing (the reference acceptor ACKs unconditionally,
+        geec_state.go:545).  Falls back to body+signature checks when the
+        parent state is unknown (we are behind)."""
+        with self._lock:
+            try:
+                self._verify_body(block)
+            except ChainError:
+                return False
+            parent_state = self._states.get(block.header.parent_hash)
+            if parent_state is None:
+                # parent unknown: we are behind — signature checks only
+                from eges_tpu.crypto.verify_host import batch_verify_txns
+                return batch_verify_txns(block.transactions, self.verifier)
+            # parent known: the proposal must extend OUR head, or the
+            # insert path would reject what we ACKed ("non-sequential
+            # insert") and the quorum round is wasted on a stale parent
+            if (block.header.parent_hash != self._head.hash
+                    or block.header.number != self._head.number + 1):
+                return False
+            try:
+                self._process(block, parent_state)
+            except ChainError:
+                return False
+            return True
 
     # -- insert funnel ----------------------------------------------------
 
@@ -325,9 +458,14 @@ class BlockChain:
     def _insert(self, block: Block) -> None:
         self._verify_header(block.header)
         self._verify_body(block)
+        parent_state = self._states.get(block.header.parent_hash)
+        if parent_state is None:
+            raise ChainError("no state for parent")  # cannot happen in-order
+        state, receipts, _ = self._process(block, parent_state)
         self.store.put_block(block)
         self.store.set_head(block.hash)
         self._head = block
+        self._remember_state(block.hash, block.number, state, receipts)
         for fn in self._listeners:
             fn(block)
 

@@ -1,0 +1,225 @@
+"""Account state and transaction execution (the reference's L3).
+
+Covers the state layer the Geec capability set actually exercises
+(ref: core/state/statedb.go, core/state_processor.go:93,
+core/state_transition.go): an account model (nonce/balance), per-block
+transaction application with receipts, and state/receipt roots derived
+through the secure Merkle-Patricia trie.  The EVM itself is out of scope
+for now — Geec's operating workload is value-carrier transactions
+(plus the unsigned geec/fake txns, which never execute,
+ref: core/block_validator.go:72) — so ``to=None`` creations transfer
+value to the derived contract address without running code.
+
+TPU-first note: sender recovery for a whole block arrives as ONE device
+batch (``recover_senders``); execution itself is sequential host work by
+nature (nonce ordering), exactly like the reference's loop — minus its
+one-cgo-call-per-tx cost (SURVEY §3.5).
+
+Account RLP matches geth's shape ``[nonce, balance, storageRoot,
+codeHash]`` (ref: core/state/state_object.go Account) so state roots are
+format-compatible.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+import numpy as np
+
+from eges_tpu.core import rlp
+from eges_tpu.core.trie import EMPTY_ROOT, secure_trie_root, derive_sha
+from eges_tpu.crypto.keccak import keccak256
+
+EMPTY_CODE_HASH = keccak256(b"")
+INTRINSIC_GAS = 21_000  # params.TxGas (ref: core/state_transition.go IntrinsicGas)
+
+
+class StateError(Exception):
+    """A transaction that cannot be applied (invalid block if rooted)."""
+
+
+@dataclass(frozen=True)
+class Account:
+    nonce: int = 0
+    balance: int = 0
+
+    def to_rlp(self) -> list:
+        return [self.nonce, self.balance, EMPTY_ROOT, EMPTY_CODE_HASH]
+
+
+@dataclass(frozen=True)
+class Receipt:
+    """(ref: core/types/receipt.go — status-era encoding
+    [status, cumulativeGasUsed, bloom, logs])"""
+
+    status: int
+    cumulative_gas_used: int
+    logs: tuple = ()
+
+    def to_rlp(self) -> list:
+        return [self.status, self.cumulative_gas_used, bytes(256),
+                list(self.logs)]
+
+    def encode(self) -> bytes:
+        return rlp.encode(self.to_rlp())
+
+    @classmethod
+    def from_rlp(cls, item: list) -> "Receipt":
+        status, gas, _bloom, logs = item
+        return cls(status=rlp.decode_uint(status),
+                   cumulative_gas_used=rlp.decode_uint(gas),
+                   logs=tuple(logs))
+
+
+class StateDB:
+    """Flat account map with trie-root derivation.
+
+    Immutable-by-convention: :meth:`copy` before applying a block, so
+    every canonical block keeps its own state snapshot and reorgs just
+    re-point (the journaled-revert machinery of the reference collapses
+    to copy-on-write under the single insert funnel)."""
+
+    def __init__(self, accounts: dict[bytes, Account] | None = None):
+        self._accounts: dict[bytes, Account] = dict(accounts or {})
+
+    @classmethod
+    def from_alloc(cls, alloc: dict[bytes, int]) -> "StateDB":
+        """Genesis allocation: address -> balance
+        (ref: core/genesis.go GenesisAlloc)."""
+        return cls({a: Account(balance=b) for a, b in alloc.items() if b})
+
+    def copy(self) -> "StateDB":
+        return StateDB(self._accounts)
+
+    def account(self, addr: bytes) -> Account:
+        return self._accounts.get(addr, Account())
+
+    def balance(self, addr: bytes) -> int:
+        return self.account(addr).balance
+
+    def nonce(self, addr: bytes) -> int:
+        return self.account(addr).nonce
+
+    def _set(self, addr: bytes, acct: Account) -> None:
+        if acct == Account():
+            self._accounts.pop(addr, None)  # empty accounts are pruned
+        else:
+            self._accounts[addr] = acct
+
+    def add_balance(self, addr: bytes, amount: int) -> None:
+        a = self.account(addr)
+        self._set(addr, replace(a, balance=a.balance + amount))
+
+    def sub_balance(self, addr: bytes, amount: int) -> None:
+        a = self.account(addr)
+        if a.balance < amount:
+            raise StateError("insufficient balance")
+        self._set(addr, replace(a, balance=a.balance - amount))
+
+    def bump_nonce(self, addr: bytes) -> None:
+        a = self.account(addr)
+        self._set(addr, replace(a, nonce=a.nonce + 1))
+
+    def root(self) -> bytes:
+        """Secure-trie state root over geth-shaped account RLP."""
+        if not self._accounts:
+            return EMPTY_ROOT
+        return secure_trie_root({
+            addr: rlp.encode(acct.to_rlp())
+            for addr, acct in self._accounts.items()})
+
+    def __len__(self) -> int:
+        return len(self._accounts)
+
+
+def contract_address(sender: bytes, nonce: int) -> bytes:
+    """(ref: crypto.CreateAddress, crypto/crypto.go:198)"""
+    return keccak256(rlp.encode([sender, nonce]))[12:]
+
+
+def recover_senders(txns, verifier) -> list:
+    """One device batch of sender recovery for a block's signed txns;
+    geec/fake/unsigned rows come back as None (they carry no sender and
+    never execute).  Raises StateError on a malformed signature — a
+    rooted txn that cannot name a sender invalidates the block
+    (ref: core/state_processor.go:93 aborts on AsMessage error)."""
+    senders: list = [None] * len(txns)
+    rows = []
+    for i, t in enumerate(txns):
+        if t.is_geec or (t.v == 0 and t.r == 0 and t.s == 0):
+            continue
+        parts = t.signature_parts()
+        if parts is None:
+            raise StateError("malformed transaction signature")
+        rows.append((i, parts))
+    if not rows:
+        return senders
+    if verifier is None:
+        for i, _ in rows:
+            try:
+                senders[i] = txns[i].sender()
+            except ValueError:
+                raise StateError("unrecoverable transaction signature")
+        return senders
+    sigs = np.zeros((len(rows), 65), np.uint8)
+    hashes = np.zeros((len(rows), 32), np.uint8)
+    for k, (_, (sig, h)) in enumerate(rows):
+        sigs[k] = np.frombuffer(sig, np.uint8)
+        hashes[k] = np.frombuffer(h, np.uint8)
+    addrs, ok = verifier.recover_addresses(sigs, hashes)
+    for k, (i, _) in enumerate(rows):
+        if not ok[k]:
+            raise StateError("unrecoverable transaction signature")
+        senders[i] = bytes(addrs[k])
+    return senders
+
+
+def apply_txn(state: StateDB, txn, sender: bytes, coinbase: bytes,
+              gas_so_far: int) -> Receipt:
+    """Apply one signed transaction, mutating ``state``
+    (ref: core/state_transition.go TransitionDb: nonce check, balance
+    check, value transfer, fee to coinbase)."""
+    acct = state.account(sender)
+    if txn.nonce != acct.nonce:
+        raise StateError(f"nonce mismatch: txn {txn.nonce} vs state {acct.nonce}")
+    fee = INTRINSIC_GAS * txn.gas_price
+    if txn.gas_limit and txn.gas_limit < INTRINSIC_GAS:
+        raise StateError("intrinsic gas too low")
+    if acct.balance < txn.value + fee:
+        raise StateError("insufficient balance for value + fee")
+    state.sub_balance(sender, txn.value + fee)
+    state.bump_nonce(sender)
+    to = txn.to if txn.to is not None else contract_address(sender, txn.nonce)
+    state.add_balance(to, txn.value)
+    if fee:
+        state.add_balance(coinbase, fee)
+    return Receipt(status=1, cumulative_gas_used=gas_so_far + INTRINSIC_GAS)
+
+
+def process_block(parent_state: StateDB, block, senders) -> tuple:
+    """Apply a block's rooted transactions to a COPY of the parent state
+    (ref: StateProcessor.Process, core/state_processor.go:60-100).
+
+    Returns ``(state, receipts, gas_used)``; raises :class:`StateError`
+    if any rooted txn cannot apply — an invalid block.  Geec/fake txns
+    have no state effect (they live outside the tx root by design).
+    """
+    if not block.transactions:
+        return parent_state, (), 0  # share the snapshot: nothing changed
+    state = parent_state.copy()
+    receipts = []
+    gas = 0
+    coinbase = block.header.coinbase
+    for t, sender in zip(block.transactions, senders):
+        if sender is None:
+            raise StateError("rooted transaction without a sender")
+        r = apply_txn(state, t, sender, coinbase, gas)
+        gas = r.cumulative_gas_used
+        receipts.append(r)
+    return state, tuple(receipts), gas
+
+
+def receipts_root(receipts) -> bytes:
+    if not receipts:
+        return EMPTY_ROOT
+    return derive_sha([r.encode() for r in receipts])

@@ -32,8 +32,9 @@ class TxPool:
         self.max_pending = max_pending
         self.on_admitted = on_admitted
         # sender -> {nonce -> txn}; admission order preserved separately
+        # as (sender, txn) so selection never rescans the whole pool
         self.pending: dict[bytes, dict[int, Transaction]] = {}
-        self._order: list[Transaction] = []
+        self._order: list[tuple[bytes, Transaction]] = []
         self._known: set[bytes] = set()
         self._queue: list[Transaction] = []
         self._timer = None
@@ -98,37 +99,95 @@ class TxPool:
         if self._queue:
             self._flush()
 
+    # a replacement for a (sender, nonce) slot must bid >= 10% more gas
+    # price (ref: core/tx_pool.go PriceBump default 10)
+    PRICE_BUMP_PCT = 10
+
     def _admit(self, t: Transaction, sender: bytes) -> None:
         if len(self._order) >= self.max_pending:
             self.stats["rejected"] += 1
             return
         by_nonce = self.pending.setdefault(sender, {})
-        if t.nonce in by_nonce:  # replacement: keep first (no gas bidding here)
-            self.stats["duplicate"] += 1
-            return
+        old = by_nonce.get(t.nonce)
+        if old is not None:
+            # price-bump replacement (ref: core/tx_pool.go:571+)
+            if t.gas_price * 100 < old.gas_price * (100 + self.PRICE_BUMP_PCT):
+                self.stats["duplicate"] += 1
+                return
+            self._order = [(s, x) for s, x in self._order
+                           if x.hash != old.hash]
+            self.stats["replaced"] = self.stats.get("replaced", 0) + 1
         by_nonce[t.nonce] = t
-        self._order.append(t)
+        self._order.append((sender, t))
         self.stats["admitted"] += 1
         if self.on_admitted is not None:
             self.on_admitted(t, sender)
 
     # -- drain ------------------------------------------------------------
 
-    def pending_txns(self, limit: int | None = None) -> list[Transaction]:
-        """Admission-ordered pending txns for block building
-        (ref: TxPool.Pending, miner/worker.go:463)."""
-        return self._order[:limit] if limit else list(self._order)
+    def pending_txns(self, limit: int | None = None,
+                     state=None) -> list[Transaction]:
+        """Executable-ordered pending txns for block building: senders in
+        first-admission order, each sender's txns nonce-ascending
+        (ref: TxPool.Pending + types.TxsByPriceAndNonce,
+        miner/worker.go:463).
 
-    def remove_included(self, txns) -> None:
-        """Drop txns included in a canonical block."""
+        With ``state`` (a StateDB), only the currently *executable*
+        contiguous run per sender is returned — starting at the sender's
+        state nonce and staying within its balance — and already-mined
+        nonces are evicted.  This is the promote/demote split of the
+        reference pool (pending vs queued, core/tx_pool.go): a sender
+        with a nonce gap or empty purse no longer starves other senders
+        out of the per-block limit."""
+        seen: set[bytes] = set()
+        out: list[Transaction] = []
+        for s, _ in list(self._order):
+            if s in seen:
+                continue
+            seen.add(s)
+            by_nonce = self.pending.get(s)
+            if not by_nonce:
+                continue
+            run = sorted(by_nonce.items())
+            if state is not None:
+                start = state.nonce(s)
+                stale = [t for n, t in run if n < start]
+                if stale:
+                    self._evict(stale)
+                    run = [(n, t) for n, t in run if n >= start]
+                spendable = state.balance(s)
+                picked = []
+                want = start
+                for n, t in run:
+                    if n != want:
+                        break  # nonce gap: rest is non-executable
+                    cost = t.value + t.gas_price * 21_000
+                    if cost > spendable:
+                        break
+                    spendable -= cost
+                    picked.append(t)
+                    want += 1
+                out.extend(picked)
+            else:
+                out.extend(t for _, t in run)
+            if limit and len(out) >= limit:
+                break
+        return out[:limit] if limit else out
+
+    def _evict(self, txns) -> None:
         hashes = {t.hash for t in txns}
-        self._order = [t for t in self._order if t.hash not in hashes]
+        self._order = [(s, t) for s, t in self._order
+                       if t.hash not in hashes]
         for sender in list(self.pending):
             self.pending[sender] = {
                 n: t for n, t in self.pending[sender].items()
                 if t.hash not in hashes}
             if not self.pending[sender]:
                 del self.pending[sender]
+
+    def remove_included(self, txns) -> None:
+        """Drop txns included in a canonical block."""
+        self._evict(txns)
 
     def __len__(self) -> int:
         return len(self._order)

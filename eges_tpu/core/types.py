@@ -276,7 +276,7 @@ class Header:
     parent_hash: bytes = ZERO_HASH
     uncle_hash: bytes = EMPTY_UNCLE_HASH
     coinbase: bytes = ZERO_ADDR
-    root: bytes = ZERO_HASH
+    root: bytes = EMPTY_ROOT  # empty-state root (L3 checks it on insert)
     tx_hash: bytes = EMPTY_ROOT
     receipt_hash: bytes = EMPTY_ROOT
     bloom: bytes = bytes(256)

@@ -34,7 +34,8 @@ class SimCluster:
                  block_timeout_s: float = 20.0, validate_timeout_ms: float = 500,
                  backoff_time_ms: float = 0.0, reg_timeout_s: float = 10.0,
                  drop_rate: float = 0.0, failure_test: bool = False,
-                 verifier=None, mine=None, signed: bool = False):
+                 verifier=None, mine=None, signed: bool = False,
+                 alloc: dict | None = None, txpool: bool = False):
         self.clock = SimClock()
         self.net = SimNet(self.clock, seed=seed, drop_rate=drop_rate)
         self.nodes: list[SimNode] = []
@@ -53,7 +54,7 @@ class SimCluster:
                                backoff_time_ms=backoff_time_ms,
                                reg_timeout_s=reg_timeout_s,
                                signed_votes=signed)
-        genesis = make_genesis()
+        genesis = make_genesis(alloc=alloc)
 
         for i in range(n_nodes):
             name = f"node{i}"
@@ -64,10 +65,14 @@ class SimCluster:
                 txn_size=txn_size, block_timeout_s=block_timeout_s,
                 total_nodes=n_nodes, failure_test=failure_test,
                 privkey=privs[i] if signed else b"")
-            chain = BlockChain(genesis=genesis, verifier=verifier)
+            chain = BlockChain(genesis=genesis, verifier=verifier,
+                               alloc=alloc)
             node = GeecNode(chain, self.clock, None, ncfg, ccfg,
                             mine=(mine[i] if mine is not None else True),
                             verifier=verifier)
+            if txpool:
+                from eges_tpu.core.txpool import TxPool
+                node.txpool = TxPool(self.clock, verifier=verifier)
             transport = self.net.join(name, ncfg.consensus_ip,
                                       ncfg.consensus_port,
                                       node.on_gossip, node.on_direct)

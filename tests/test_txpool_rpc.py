@@ -46,11 +46,13 @@ def test_txpool_window_batches_and_rejects():
 
 
 def test_txpool_txns_flow_into_blocks_and_verify():
-    c = SimCluster(3, txn_per_block=4, seed=21)
+    priv = secrets.token_bytes(32)
+    sender = host.pubkey_to_address(host.privkey_to_pubkey(priv))
+    # the sender must be funded or the execution preview (L3) drops it
+    c = SimCluster(3, txn_per_block=4, seed=21, alloc={sender: 100})
     pool = TxPool(c.clock, verifier=None, window_ms=1)
     c.nodes[0].node.txpool = pool
     c.start()
-    priv = secrets.token_bytes(32)
     txns = [_signed(priv, nonce=i) for i in range(3)]
     pool.add_remotes(txns)
     c.run(120, stop_condition=lambda: c.min_height() >= 8)
