@@ -28,7 +28,8 @@ from eges_tpu.crypto.keccak import keccak256
 UDP_EXAMINE_REPLY = 0x01
 UDP_ELECT = 0x02
 UDP_QUERY_REPLY = 0x03
-UDP_BLOCKS = 0x04  # backfill reply (this build; see BlockFetchReq)
+UDP_BLOCKS = 0x04      # backfill reply (this build; see BlockFetchReq)
+UDP_GET_BLOCKS = 0x05  # peer-directed backfill request (sync protocol)
 
 # Election sub-codes (ref: consensus/geec/election/election_go.go:15-18)
 MSG_ELECT = 0x01
@@ -39,9 +40,11 @@ GOSSIP_VALIDATE_REQ = 0x11
 GOSSIP_QUERY = 0x12
 GOSSIP_REGISTER_REQ = 0x14
 GOSSIP_CONFIRM_BLOCK = 0x15
-GOSSIP_GET_BLOCKS = 0x16  # backfill request (this build's minimal stand-in
-#                           for the reference's downloader body sync,
-#                           eth/downloader/queue.go:65-67 Geec-extended)
+GOSSIP_GET_BLOCKS = 0x16  # backfill request (broadcast fallback of the
+#                           sync protocol; cf. the reference's downloader
+#                           body sync, eth/downloader/queue.go:65-67)
+GOSSIP_TXNS = 0x17  # transaction gossip (ref: TxMsg, eth/protocol.go:38 +
+#                     eth/handler.go:742-759 -> TxPool.AddRemotes)
 
 
 @dataclass(frozen=True)
@@ -239,6 +242,23 @@ class BlocksReply:
 
 
 @dataclass(frozen=True)
+class TxnsMsg:
+    """Transaction gossip payload (ref: TxMsg eth/protocol.go:38)."""
+
+    txns: tuple
+
+    def to_rlp(self) -> list:
+        return [[t.to_rlp() for t in self.txns]]
+
+    @classmethod
+    def from_rlp(cls, item: list) -> "TxnsMsg":
+        from eges_tpu.core.types import Transaction
+
+        (txns,) = item
+        return cls(txns=tuple(Transaction.from_rlp(t) for t in txns))
+
+
+@dataclass(frozen=True)
 class UdpEnvelope:
     """Direct-plane envelope (ref: core/geecCore/Types.go:68-72)."""
 
@@ -261,6 +281,7 @@ _DIRECT_BODY = {
     UDP_ELECT: ElectMessage,
     UDP_QUERY_REPLY: QueryReply,
     UDP_BLOCKS: BlocksReply,
+    UDP_GET_BLOCKS: BlockFetchReq,
 }
 
 
@@ -282,6 +303,7 @@ _GOSSIP_BODY = {
     GOSSIP_REGISTER_REQ: Registration,
     GOSSIP_CONFIRM_BLOCK: ConfirmBlockMsg,
     GOSSIP_GET_BLOCKS: BlockFetchReq,
+    GOSSIP_TXNS: TxnsMsg,
 }
 
 

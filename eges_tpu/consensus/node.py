@@ -111,6 +111,9 @@ class GeecNode:
         self.registered = self.coinbase in self.membership
         self.pending_geec_txns: list[Transaction] = []
         self._proposal_geec_txns: list[Transaction] = []
+        self._txn_seen: set[bytes] = set()
+        self._sync_target = 0
+        self._sync_progress = False
         self.geec_txn_sink = None  # app-layer callback for confirmed geec txns
         self.txpool = None  # optional TxPool; proposals drain it
 
@@ -227,6 +230,8 @@ class GeecNode:
             self._handle_confirm(msg)
         elif code == M.GOSSIP_GET_BLOCKS:
             self._serve_block_fetch(msg)
+        elif code == M.GOSSIP_TXNS:
+            self._handle_txns(msg)
 
     def on_direct(self, data: bytes) -> None:
         try:
@@ -241,6 +246,8 @@ class GeecNode:
             self._handle_query_reply(msg)
         elif code == M.UDP_BLOCKS:
             self._handle_blocks_reply(msg)
+        elif code == M.UDP_GET_BLOCKS:
+            self._serve_block_fetch(msg)
 
     def on_geec_txn(self, payload: bytes) -> None:
         """UDP txn ingest (ref: consensus/geec/geec_api.go:28-41)."""
@@ -771,7 +778,11 @@ class GeecNode:
         forked = (not confirm.empty_block and local is not None
                   and local.hash != confirm.hash)
         if behind or forked:
-            self._request_backfill(confirm.block_number)
+            # a fork at (or below) our head needs a target beyond our
+            # height or the sync tick would no-op before the overlapping
+            # request can expose the fork point to replace_suffix
+            target = confirm.block_number + (0 if behind else 1)
+            self._request_backfill(target)
 
     def _confirm_cert_entries(self, confirm: ConfirmBlockMsg):
         """Reconstruct the per-supporter signing hashes of a confirm's
@@ -836,33 +847,134 @@ class GeecNode:
         return signer is not None and signer in self.membership
 
     # ------------------------------------------------------------------
-    # backfill (downloader-sync stand-in; SURVEY §5 checkpoint/resume)
+    # transaction gossip (ref: TxMsg eth/handler.go:742-759 ->
+    # TxPool.AddRemotes; relay-once dedup by txn hash)
     # ------------------------------------------------------------------
 
-    def _request_backfill(self, target: int, start: int | None = None) -> None:
-        """Ask peers for the gap between our head and the quorum head.
+    _TXN_SEEN_CAP = 1 << 16
 
-        The request overlaps a few blocks *behind* our head so the reply
-        exposes the fork point when our tail is locally-forced empty
-        blocks (replace_suffix needs the anchor).  Rate-limited to one
-        outstanding request per validate-timeout.
-        """
-        if "backfill" in self._timers:
+    def submit_txns(self, txns) -> None:
+        """Local ingress (RPC eth_sendRawTransaction): admit to our pool;
+        admitted txns are broadcast via the pool's admission hook."""
+        txns = list(txns)
+        if self.txpool is not None:
+            self._ensure_pool_relay()
+            self.txpool.add_remotes(txns)
+        else:
+            self.broadcast_txns(txns)
+
+    def broadcast_txns(self, txns) -> None:
+        """Gossip txns to peers with relay-once dedup."""
+        fresh = [t for t in txns if t.hash not in self._txn_seen]
+        if not fresh:
+            return
+        self._mark_seen_txns(fresh)
+        self.transport.gossip(
+            M.pack_gossip(M.GOSSIP_TXNS, M.TxnsMsg(txns=tuple(fresh))))
+
+    def _handle_txns(self, msg: M.TxnsMsg) -> None:
+        fresh = [t for t in msg.txns if t.hash not in self._txn_seen]
+        if not fresh:
+            return
+        if self.txpool is not None:
+            # relay AFTER admission (signature verified in the pool's
+            # batch window) — an attacker's junk txns must not get
+            # network-wide fan-out amplification (the reference relays
+            # only pool-accepted txns, eth/handler.go:742-759)
+            self._ensure_pool_relay()
+            self.txpool.add_remotes(fresh)
+        else:
+            # pool-less follower: relay with dedup so txns still
+            # propagate through it (marked seen either way)
+            self.broadcast_txns(fresh)
+
+    def _ensure_pool_relay(self) -> None:
+        """Hook the pool's admission callback to broadcast admitted txns
+        (chained with any existing callback)."""
+        if getattr(self, "_pool_relay_hooked", None) is self.txpool:
+            return
+        prev = self.txpool.on_admitted
+
+        def hook(t, sender, _prev=prev):
+            if _prev is not None:
+                _prev(t, sender)
+            self.broadcast_txns([t])
+
+        self.txpool.on_admitted = hook
+        self._pool_relay_hooked = self.txpool
+
+    def _mark_seen_txns(self, txns) -> None:
+        if len(self._txn_seen) > self._TXN_SEEN_CAP:
+            self._txn_seen.clear()  # coarse LRU: dupes re-relay once
+        self._txn_seen.update(t.hash for t in txns)
+
+    # ------------------------------------------------------------------
+    # sync (the downloader role, ref: eth/downloader/downloader.go:931 —
+    # ranged, retried, peer-tracked; SURVEY §5 checkpoint/resume)
+    # ------------------------------------------------------------------
+
+    SYNC_BATCH = 128       # blocks per request (served cap matches)
+    SYNC_MAX_STALL = 8     # fruitless retries before giving up
+
+    def _request_backfill(self, target: int, start: int | None = None) -> None:
+        """Start (or extend) a sync toward ``target``.
+
+        One outstanding request at a time; each retry rotates to another
+        member peer (direct UDP), with a gossip broadcast as every third
+        fallback for peers not in the membership.  Progress (blocks
+        applied) resets the retry budget; a target that yields no blocks
+        after SYNC_MAX_STALL rotations is abandoned (a forged confirm
+        number must not keep the node polling forever)."""
+        self._sync_target = max(getattr(self, "_sync_target", 0), target)
+        if "backfill" not in self._timers:
+            self._sync_progress = False
+            self._sync_tick(start=start, retry=0)
+
+    def _sync_tick(self, start: int | None, retry: int) -> None:
+        height = self.chain.height()
+        if height >= self._sync_target:
+            self._cancel_timer("backfill")
+            return
+        if self._sync_progress:
+            retry = 0  # a reply delivered blocks: reset the stall budget
+            self._sync_progress = False
+        elif retry >= self.SYNC_MAX_STALL:
+            # no peer served anything across a full rotation: the target
+            # is unreachable (e.g. a forged confirm number) — abandon it
+            self._cancel_timer("backfill")
+            self._sync_target = 0
             return
         if start is None:
-            start = max(1, self.chain.height() - 7)
-        count = max(min(target - start + 1, 64), 1)
+            # overlap a few blocks behind our head so the reply exposes
+            # the fork point when our tail is locally-forced empties
+            # (replace_suffix needs the anchor)
+            start = max(1, height - 7)
+        count = max(min(self._sync_target - start + 1, self.SYNC_BATCH), 1)
         req = M.BlockFetchReq(start=start, count=count,
                               ip=self.cfg.consensus_ip,
                               port=self.cfg.consensus_port)
-        self._backfill_target = target
-        self.transport.gossip(M.pack_gossip(M.GOSSIP_GET_BLOCKS, req))
+        peer = self._pick_sync_peer(retry)
+        if peer is not None and retry % 3 != 2:
+            self.transport.send_direct(
+                peer.ip, peer.port,
+                M.pack_direct(M.UDP_GET_BLOCKS, self.coinbase, req))
+        else:
+            self.transport.gossip(M.pack_gossip(M.GOSSIP_GET_BLOCKS, req))
         self._set_timer("backfill", self.ccfg.validate_timeout_ms / 1e3,
-                        lambda: self._cancel_timer("backfill"))
+                        lambda: self._sync_tick(None, retry + 1))
+
+    def _pick_sync_peer(self, retry: int):
+        peers = [m for m in self.membership.members()
+                 if m.addr != self.coinbase and m.ip]
+        if not peers:
+            return None
+        self._sync_rr = getattr(self, "_sync_rr", 0) + 1
+        return peers[(self._sync_rr + retry) % len(peers)]
 
     def _serve_block_fetch(self, req: M.BlockFetchReq) -> None:
         blocks = []
-        for n in range(req.start, req.start + min(req.count, 64)):
+        for n in range(req.start, req.start + min(req.count,
+                                                  self.SYNC_BATCH)):
             b = self.chain.get_block_by_number(n)
             if b is None:
                 break
@@ -925,14 +1037,22 @@ class GeecNode:
                 [b for b in blocks if b.number >= conflict[0].number])
             if not done and conflict[0].number == blocks[0].number:
                 # fork point precedes the reply window — look deeper
+                # (keep the target above our head or the tick no-ops)
                 self._cancel_timer("backfill")
-                target = getattr(self, "_backfill_target", head + 1)
+                self._sync_target = max(self._sync_target, head + 1)
                 depth = 2 * max(head - blocks[0].number + 1, 8)
-                self._request_backfill(target,
-                                       start=max(1, head - depth + 1))
+                self._sync_tick(start=max(1, head - depth + 1), retry=0)
                 return
+            if done:
+                self._sync_progress = True
         for b in blocks:
-            self.chain.offer(b)
+            if self.chain.offer(b):
+                self._sync_progress = True
+        # continuation: more of the range outstanding -> next request now
+        if (self._sync_progress
+                and self.chain.height() < getattr(self, "_sync_target", 0)):
+            self._cancel_timer("backfill")
+            self._sync_tick(start=None, retry=0)
 
     # ------------------------------------------------------------------
     # chain listener (ref: handleNewBlock geec_state.go:964-1018 +

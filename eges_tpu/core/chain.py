@@ -198,8 +198,10 @@ class BlockChain:
             g = self.store.get_block(self.store.get_hash_by_number(0))
             self.genesis = g if g is not None else genesis
 
+        if self.genesis is None:
+            raise ChainError("store has a head but no genesis block")
         gstate = StateDB.from_alloc(self.alloc)
-        if self.genesis is not None and self.genesis.header.root != gstate.root():
+        if self.genesis.header.root != gstate.root():
             raise ChainError("genesis state root does not match alloc")
         self._remember_state(self.genesis.hash, 0, gstate, ())
         # restart: rebuild state snapshots by replaying the stored chain

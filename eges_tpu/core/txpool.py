@@ -104,11 +104,16 @@ class TxPool:
     PRICE_BUMP_PCT = 10
 
     def _admit(self, t: Transaction, sender: bytes) -> None:
-        if len(self._order) >= self.max_pending:
-            self.stats["rejected"] += 1
-            return
         by_nonce = self.pending.setdefault(sender, {})
         old = by_nonce.get(t.nonce)
+        if old is None and len(self._order) >= self.max_pending:
+            # capacity only limits NEW slots: a price-bump replacement
+            # keeps the pool size constant and must stay possible even
+            # when full (ref: core/tx_pool.go admits replacements)
+            self.stats["rejected"] += 1
+            if not by_nonce:
+                del self.pending[sender]
+            return
         if old is not None:
             # price-bump replacement (ref: core/tx_pool.go:571+)
             if t.gas_price * 100 < old.gas_price * (100 + self.PRICE_BUMP_PCT):
@@ -161,7 +166,8 @@ class TxPool:
                 for n, t in run:
                     if n != want:
                         break  # nonce gap: rest is non-executable
-                    cost = t.value + t.gas_price * 21_000
+                    from eges_tpu.core.state import INTRINSIC_GAS
+                    cost = t.value + t.gas_price * INTRINSIC_GAS
                     if cost > spendable:
                         break
                     spendable -= cost

@@ -9,6 +9,7 @@ with the consensus node.
 
 Methods:
   eth_blockNumber, eth_getBlockByNumber, eth_getBlockByHash,
+  eth_getBalance, eth_getTransactionCount, eth_getTransactionReceipt,
   eth_sendRawTransaction, net_version, web3_clientVersion,
   thw_register, thw_membership, thw_status, thw_pendingGeecTxns
 """
@@ -102,6 +103,43 @@ class RpcServer:
             return self.chain.get_block_by_number(0)
         return self.chain.get_block_by_number(int(tag, 16))
 
+    def _state_for(self, tag):
+        blk = self._resolve_block(tag)
+        if blk is None:
+            raise RpcError(-32602, "unknown block")
+        st = self.chain.state_at(blk.hash)
+        if st is None:
+            raise RpcError(-32000, "state pruned for that block")
+        return st
+
+    def _receipt_json(self, txn_hash: bytes):
+        """Linear scan over recent blocks' receipts (the reference keeps
+        a txn-hash index in LevelDB, core/database_util.go; recency scan
+        is adequate at Geec's operating point)."""
+        chain = self.chain
+        for n in range(chain.height(), max(0, chain.height() - 1024), -1):
+            blk = chain.get_block_by_number(n)
+            if blk is None:
+                continue
+            receipts = chain.receipts_of(blk.hash)
+            for i, t in enumerate(blk.transactions):
+                if t.hash == txn_hash and i < len(receipts):
+                    r = receipts[i]
+                    return {
+                        "transactionHash": "0x" + txn_hash.hex(),
+                        "blockNumber": _hex(n),
+                        "blockHash": "0x" + blk.hash.hex(),
+                        "transactionIndex": _hex(i),
+                        "status": _hex(r.status),
+                        "cumulativeGasUsed": _hex(r.cumulative_gas_used),
+                        "gasUsed": _hex(
+                            r.cumulative_gas_used
+                            - (receipts[i - 1].cumulative_gas_used
+                               if i else 0)),
+                        "logs": [],
+                    }
+        return None
+
     def dispatch(self, method: str, params: list):
         if method == "eth_blockNumber":
             return _hex(self.chain.height())
@@ -121,8 +159,23 @@ class RpcServer:
                 txn = Transaction.decode(raw)
             except rlp.RLPError as e:
                 raise RpcError(-32602, f"invalid transaction RLP: {e}")
-            self.txpool.add_remotes([txn])
+            if self.node is not None and self.node.txpool is self.txpool:
+                # pool admission + gossip broadcast to peers
+                # (ref: eth/handler.go:742-759 TxMsg fan-out)
+                self.node.submit_txns([txn])
+            else:
+                self.txpool.add_remotes([txn])
+                if self.node is not None:  # still broadcast to peers
+                    self.node.broadcast_txns([txn])
             return "0x" + txn.hash.hex()
+        if method == "eth_getBalance":
+            st = self._state_for(params[1] if len(params) > 1 else "latest")
+            return _hex(st.balance(bytes.fromhex(params[0][2:])))
+        if method == "eth_getTransactionCount":
+            st = self._state_for(params[1] if len(params) > 1 else "latest")
+            return _hex(st.nonce(bytes.fromhex(params[0][2:])))
+        if method == "eth_getTransactionReceipt":
+            return self._receipt_json(bytes.fromhex(params[0][2:]))
         if method == "net_version":
             return str(self.chain_id)
         if method == "web3_clientVersion":

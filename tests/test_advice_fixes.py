@@ -75,9 +75,14 @@ def test_out_of_order_confirm_does_not_insert_losing_proposal():
     # the loser must NOT be on the chain; a2 waits buffered for its parent
     assert node.chain.height() == 0
     assert node.chain.get_block_by_number(1) is None
-    # backfill was requested (we are behind the quorum head)
-    assert any(M.unpack_gossip(d)[0] == M.GOSSIP_GET_BLOCKS
-               for d in node.transport.gossiped)
+    # backfill was requested (we are behind the quorum head) — via the
+    # peer-directed sync plane or the gossip fallback
+    fetched = any(M.unpack_gossip(d)[0] == M.GOSSIP_GET_BLOCKS
+                  for d in node.transport.gossiped)
+    fetched = fetched or any(
+        M.unpack_direct(d)[0] == M.UDP_GET_BLOCKS
+        for _, _, d in node.transport.directs)
+    assert fetched
 
     # backfill delivers the real block 1 -> chain heals through 2
     node._handle_blocks_reply(M.BlocksReply(blocks=(a1,)))

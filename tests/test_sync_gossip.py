@@ -1,0 +1,99 @@
+"""Transaction gossip + the ranged/retried/peer-tracked sync protocol
+(ref: eth/handler.go:742-759 TxMsg; eth/downloader/downloader.go:931),
+plus the state-backed RPC methods."""
+
+from eges_tpu.core.state import INTRINSIC_GAS
+from eges_tpu.core.txpool import TxPool
+from eges_tpu.core.types import Transaction
+from eges_tpu.crypto import secp256k1 as secp
+from eges_tpu.rpc.server import RpcServer
+from eges_tpu.sim.cluster import SimCluster
+
+PRIV = bytes([0x31]) * 32
+SENDER = secp.pubkey_to_address(secp.privkey_to_pubkey(PRIV))
+DEST = bytes([0x99]) * 20
+ETH = 10**18
+
+
+def _signed(nonce, value=1, gas_price=0):
+    return Transaction(nonce=nonce, gas_price=gas_price,
+                       gas_limit=INTRINSIC_GAS, to=DEST,
+                       value=value).signed(PRIV, chain_id=1)
+
+
+def test_tx_gossip_reaches_every_pool_and_executes():
+    """A txn submitted at ONE node propagates to every pool via gossip
+    and is executed by whichever proposer includes it."""
+    c = SimCluster(4, txn_per_block=2, seed=6, alloc={SENDER: ETH},
+                   txpool=True)
+    c.start()
+    t = _signed(0, value=7)
+    c.nodes[0].node.submit_txns([t])
+    c.run(5)
+    # every pool heard about it exactly once (relay dedup)
+    for sn in c.nodes[1:]:
+        assert t.hash in sn.node._txn_seen
+    c.run(60, stop_condition=lambda: all(
+        sn.chain.head_state().balance(DEST) == 7 for sn in c.nodes))
+    for sn in c.nodes:
+        assert sn.chain.head_state().balance(DEST) == 7
+        assert len(sn.node.txpool) == 0  # included -> removed everywhere
+
+
+def test_fresh_node_syncs_long_chain():
+    """test-sync.py parity at VERDICT's operating point: a node that
+    missed 1000+ blocks catches up to the quorum head via the ranged,
+    peer-tracked, continuing sync."""
+    c = SimCluster(4, txn_per_block=2, seed=12, mine=[True, True, True,
+                                                      False])
+    c.net.partition("node3")
+    c.start()
+    survivors = c.nodes[:3]
+    c.run(600, stop_condition=lambda: min(
+        sn.chain.height() for sn in survivors) >= 1000)
+    assert min(sn.chain.height() for sn in survivors) >= 1000
+    assert c.nodes[3].chain.height() == 0
+
+    c.net.heal("node3")
+    target = max(sn.chain.height() for sn in survivors)
+    c.run(300, stop_condition=lambda: c.nodes[3].chain.height() >= target)
+    n3 = c.nodes[3].chain
+    assert n3.height() >= target, (
+        f"stuck at {n3.height()} vs {target}; err={n3.last_error}")
+    assert (n3.get_block_by_number(target).hash
+            == survivors[0].chain.get_block_by_number(target).hash)
+
+
+def test_sync_gives_up_on_phantom_target():
+    """A forged far-future confirm number must not leave the node
+    polling forever: the stall budget abandons the target."""
+    c = SimCluster(3, txn_per_block=2, seed=3)
+    c.start()
+    c.run(30, stop_condition=lambda: c.min_height() >= 3)
+    n0 = c.nodes[0].node
+    n0._request_backfill(10**6)
+    assert "backfill" in n0._timers
+    c.run(30)
+    assert "backfill" not in n0._timers  # gave up
+    assert n0._sync_target == 0
+
+
+def test_rpc_state_methods():
+    c = SimCluster(3, txn_per_block=2, seed=8, alloc={SENDER: ETH},
+                   txpool=True)
+    c.start()
+    t = _signed(0, value=5, gas_price=1)
+    c.nodes[0].node.submit_txns([t])
+    c.run(60, stop_condition=lambda:
+          c.nodes[0].chain.head_state().balance(DEST) == 5)
+    rpc = RpcServer(c.nodes[0].chain, node=c.nodes[0].node,
+                    txpool=c.nodes[0].node.txpool)
+    assert int(rpc.dispatch("eth_getBalance",
+                            ["0x" + DEST.hex(), "latest"]), 16) == 5
+    assert int(rpc.dispatch("eth_getTransactionCount",
+                            ["0x" + SENDER.hex(), "latest"]), 16) == 1
+    rcpt = rpc.dispatch("eth_getTransactionReceipt",
+                        ["0x" + t.hash.hex()])
+    assert rcpt is not None and rcpt["status"] == "0x1"
+    assert int(rcpt["gasUsed"], 16) == INTRINSIC_GAS
+    assert rpc.dispatch("eth_getTransactionReceipt", ["0x" + "ab" * 32]) is None
