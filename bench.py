@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import json
 import os
-import secrets
 import sys
 import time
 
@@ -30,37 +29,12 @@ sys.path.insert(0, __file__.rsplit("/", 1)[0])
 CPU_BASELINE_VERIFIES_PER_S = 16_000.0  # mid of 12-20k/s/core (BASELINE.md)
 
 
-def _make_workload(batch: int, invalid_every: int = 17):
-    """Signatures + hashes with one invalid row per ``invalid_every``."""
-    import numpy as np
-    from eges_tpu.crypto import secp256k1 as host
+def _make_workload(batch: int):
+    """Signatures + hashes with a sprinkling of invalid rows — the
+    flagship model's shared workload builder."""
+    from eges_tpu.models.flagship import example_batch
 
-    n_keys = 64
-    msgs = [secrets.token_bytes(32) for _ in range(n_keys)]
-    privs = [secrets.token_bytes(32) for _ in range(n_keys)]
-    sig_cache = [np.frombuffer(host.ecdsa_sign(m, p), np.uint8)
-                 for m, p in zip(msgs, privs)]
-    addr_cache = [host.pubkey_to_address(host.privkey_to_pubkey(p))
-                  for p in privs]
-
-    sigs = np.zeros((batch, 65), np.uint8)
-    hashes = np.zeros((batch, 32), np.uint8)
-    valid = np.ones(batch, bool)
-    expect = [b""] * batch
-    for i in range(batch):
-        k = i % n_keys
-        sigs[i] = sig_cache[k]
-        hashes[i] = np.frombuffer(msgs[k], np.uint8)
-        expect[i] = addr_cache[k]
-        if i % invalid_every == 5:
-            valid[i] = False
-            if i % 2:
-                sigs[i, 40] ^= 0xFF  # corrupt s: recovers a wrong address
-                expect[i] = None      # (still a valid point — addr differs)
-            else:
-                sigs[i, 64] = 9       # invalid recovery id: masked row
-                expect[i] = b"\0" * 20
-    return sigs, hashes, valid, expect
+    return example_batch(batch, invalid_every=17)
 
 
 def main() -> None:
@@ -82,8 +56,15 @@ def main() -> None:
     # default to the 1024-row operating point: its graph is the
     # known-good compile; larger batches scale throughput further
     # (pass e.g. 4096/16384 when the device session is stable)
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    args = [a for a in sys.argv[1:] if a != "--profile"]
+    profile = "--profile" in sys.argv[1:]
+    batch = int(args[0]) if args else 1024
     lat_batch = 1024  # BASELINE.md p50 operating point
+
+    if profile:
+        # device trace for xprof/tensorboard (VERDICT item 7: the
+        # profiling hook the round-1 build lacked)
+        jax.profiler.start_trace("/tmp/eges_tpu_profile")
 
     fn = jax.jit(ecrecover_batch)
 
@@ -141,6 +122,10 @@ def main() -> None:
     lats.sort()
     p50 = lats[len(lats) // 2] * 1e3
     p99 = lats[int(len(lats) * 0.99)] * 1e3
+
+    if profile:
+        jax.profiler.stop_trace()
+        print("# profile trace: /tmp/eges_tpu_profile", file=sys.stderr)
 
     print(json.dumps({
         "metric": "secp256k1_ecrecover_verifies_per_sec_per_chip",

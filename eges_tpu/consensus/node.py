@@ -366,6 +366,8 @@ class GeecNode:
                     wb.supporter_votes.pop(a, None)
             if len(wb.supporters) < wb.election_threshold:
                 return False
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+        metrics.counter("consensus.elected").inc()
         wb.elect_state = ELEC_ELECTED
         wb.is_proposer = True
         wb.validate_threshold = self.membership.validate_threshold()
@@ -527,6 +529,11 @@ class GeecNode:
         self._phase = IDLE
         self._proposal = None
         self._proposal_geec_txns = []  # included in the sealed block
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+        metrics.counter("consensus.sealed").inc()
+        if self.cfg.breakdown:
+            self._log("breakdown", phase="seal_total",
+                      dt=self.clock.now() - self._seal_t0, blk=block.number)
         self.chain.offer(sealed)  # our own insert funnel
         self.transport.gossip(M.pack_gossip(M.GOSSIP_CONFIRM_BLOCK, confirm))
 
@@ -1198,6 +1205,8 @@ class GeecNode:
 
     def _force_empty_block(self) -> None:
         """(ref: HandleBlockTimeout geec_state.go:927-953)"""
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+        metrics.counter("consensus.forced_empties").inc()
         empty = self.chain.make_empty_block()
         confirm = ConfirmBlockMsg(block_number=empty.number, hash=empty.hash,
                                   confidence=0, empty_block=True)

@@ -401,6 +401,8 @@ class BlockChain:
                     except ChainError as e:
                         self.bad_blocks += 1
                         self.last_error = str(e)
+                        from eges_tpu.utils.metrics import DEFAULT as metrics
+                        metrics.counter("chain.bad_blocks").inc()
                 if ok is None:
                     break
                 inserted.append(ok)
@@ -458,6 +460,11 @@ class BlockChain:
             return True
 
     def _insert(self, block: Block) -> None:
+        import time
+
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+
+        t0 = time.monotonic()
         self._verify_header(block.header)
         self._verify_body(block)
         parent_state = self._states.get(block.header.parent_hash)
@@ -468,6 +475,11 @@ class BlockChain:
         self.store.set_head(block.hash)
         self._head = block
         self._remember_state(block.hash, block.number, state, receipts)
+        metrics.timer("chain.insert").update(time.monotonic() - t0)
+        metrics.counter("chain.blocks").inc()
+        metrics.counter("chain.txns").inc(len(block.transactions))
+        metrics.counter("chain.geec_txns").inc(len(block.geec_txns))
+        metrics.gauge("chain.height").set(block.number)
         for fn in self._listeners:
             fn(block)
 

@@ -48,6 +48,19 @@ def _node_ref(encoded: bytes):
     return keccak256(encoded)
 
 
+def _lcp_below(items, depth: int) -> int:
+    """Longest common nibble prefix of ``items`` at/below ``depth``."""
+    first = items[0][0]
+    lcp = len(first)
+    for nib, _ in items[1:]:
+        i = depth
+        limit = min(len(first), len(nib))
+        while i < limit and nib[i] == first[i]:
+            i += 1
+        lcp = min(lcp, i)
+    return lcp
+
+
 def _build(items: list[tuple[list[int], bytes]], depth: int):
     """Build the node for items sharing a prefix of length ``depth``.
 
@@ -61,14 +74,7 @@ def _build(items: list[tuple[list[int], bytes]], depth: int):
         return [_hp_encode(nib[depth:], True), val]
 
     # longest common prefix below depth
-    first = items[0][0]
-    lcp = len(first)
-    for nib, _ in items[1:]:
-        i = depth
-        limit = min(len(first), len(nib))
-        while i < limit and nib[i] == first[i]:
-            i += 1
-        lcp = min(lcp, i)
+    lcp = _lcp_below(items, depth)
     if lcp > depth:
         child = _build(items, lcp)
         return [_hp_encode(first[depth:lcp], False), _node_ref(rlp.encode(child))]
@@ -105,3 +111,110 @@ def secure_trie_root(pairs: dict[bytes, bytes]) -> bytes:
 def derive_sha(encoded_items: list[bytes]) -> bytes:
     """Tx/receipt root: trie keyed by rlp(index) (ref: core/types/derive_sha.go:30)."""
     return trie_root({rlp.encode(i): item for i, item in enumerate(encoded_items)})
+
+
+# ---------------------------------------------------------------------------
+# proofs of inclusion / exclusion (ref: trie/proof.go Prove/VerifyProof)
+# ---------------------------------------------------------------------------
+
+def _hp_decode(data: bytes) -> tuple[list[int], bool]:
+    nibs = _nibbles(data)
+    flag = nibs[0]
+    terminal = flag >= 2
+    skip = 1 if flag % 2 else 2
+    return nibs[skip:], terminal
+
+
+def trie_prove(pairs: dict[bytes, bytes], key: bytes) -> list[bytes]:
+    """Merkle proof for ``key`` against ``trie_root(pairs)``: the encoded
+    nodes on the key's path that are referenced by hash (embedded short
+    nodes travel inside their parent, as in the reference's proof lists).
+    Valid for absent keys too (an exclusion proof)."""
+    if not pairs:
+        return []
+    nib = _nibbles(key)
+    items = sorted((_nibbles(k), v) for k, v in pairs.items())
+    depth = 0
+    proof: list[bytes] = []
+    enc = rlp.encode(_build(items, depth))  # root node
+    hashed = True  # the root is always by-hash
+    while True:
+        if hashed:
+            proof.append(enc)
+        if len(items) == 1:
+            return proof
+        lcp = _lcp_below(items, depth)
+        if lcp > depth:  # extension node
+            if nib[depth:lcp] != items[0][0][depth:lcp]:
+                return proof  # diverges here: exclusion proven
+            depth = lcp
+            enc = rlp.encode(_build(items, depth))
+            hashed = len(enc) >= 32
+            continue
+        # branch node
+        if len(nib) == depth:
+            return proof  # value (or absence) sits in this branch
+        bucket = [(n, v) for n, v in items
+                  if len(n) > depth and n[depth] == nib[depth]]
+        if not bucket:
+            return proof  # empty child slot: exclusion proven
+        items = bucket
+        depth += 1
+        enc = rlp.encode(_build(items, depth))
+        hashed = len(enc) >= 32
+
+
+def verify_proof(root: bytes, key: bytes, proof: list[bytes]):
+    """Walk ``proof`` from ``root``; returns the proven value, or None
+    when the proof shows the key absent.  Raises ValueError on any
+    inconsistency (a forged proof)."""
+    if root == EMPTY_ROOT:
+        if proof:
+            raise ValueError("non-empty proof for the empty trie")
+        return None
+    nib = _nibbles(key)
+    it = iter(proof)
+
+    def load(ref):
+        if isinstance(ref, (bytes, bytearray)) and len(ref) == 32:
+            enc = next(it, None)
+            if enc is None:
+                raise ValueError("proof truncated")
+            if keccak256(enc) != bytes(ref):
+                raise ValueError("proof node hash mismatch")
+            return rlp.decode(enc)
+        return ref  # embedded node (list) or empty slot (b"")
+
+    node = load(root)
+    i = 0
+    while True:
+        if isinstance(node, (bytes, bytearray)):
+            if len(node) == 0:
+                return None  # empty slot: key absent
+            raise ValueError("malformed proof node")
+        if len(node) == 17:  # branch
+            if i == len(nib):
+                val = bytes(node[16])
+                return val if val else None
+            node = load(node[nib[i]])
+            i += 1
+            continue
+        if len(node) != 2:
+            raise ValueError("malformed proof node")
+        path, terminal = _hp_decode(bytes(node[0]))
+        if terminal:
+            return bytes(node[1]) if nib[i:] == path else None
+        if nib[i:i + len(path)] != path:
+            return None  # extension diverges: key absent
+        i += len(path)
+        node = load(node[1])
+
+
+def secure_trie_prove(pairs: dict[bytes, bytes], key: bytes) -> list[bytes]:
+    """Proof against :func:`secure_trie_root` (keccak-hashed keys)."""
+    return trie_prove({keccak256(k): v for k, v in pairs.items()},
+                      keccak256(key))
+
+
+def verify_secure_proof(root: bytes, key: bytes, proof: list[bytes]):
+    return verify_proof(root, keccak256(key), proof)

@@ -1,0 +1,11 @@
+"""The framework's "model" registry.
+
+This domain's flagship model is the batched signature-verification
+pipeline (SURVEY §3.5's hot path as one fused device program); the
+registry gives the driver entry point, the benchmark, and tests one
+shared definition of "the model" and its example inputs.
+"""
+
+from eges_tpu.models.flagship import (  # noqa: F401
+    example_batch, flagship_forward,
+)

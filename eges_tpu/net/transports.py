@@ -8,10 +8,14 @@ reference's two planes (SURVEY §2.3):
   binds ``--consensusPort``; replies dial ``ip:port`` from the request).
 * **Gossip plane** — persistent TCP connections to a static peer list
   with length-prefixed frames.  The reference runs RLPx-encrypted devp2p
-  here (p2p/rlpx.go); a permissioned deployment's transport security is
-  orthogonal to consensus, so frames are plaintext for now and the
-  handshake/encryption layer can be added beneath this interface
-  (SURVEY §7 step 4: "discovery/RLPx crypto can come last").
+  here (p2p/rlpx.go); the RLPx-parity role in this permissioned design
+  is an authenticated handshake + per-frame keyed MAC (see
+  :class:`GossipPlane` with a ``secret``): nonce exchange derives
+  per-direction session keys from a network secret, every frame carries
+  a 16-byte keccak-MAC over (key, sequence, payload), and unauthentic
+  or replayed frames drop the connection.  Confidentiality is NOT
+  provided (consensus traffic is not secret in a permissioned
+  deployment); authenticity and network isolation are.
 
 Everything runs on one asyncio loop; inbound messages call straight into
 the single-threaded :class:`~eges_tpu.consensus.node.GeecNode`, so the
@@ -72,25 +76,94 @@ class DirectPlane:
             self._transport.close()
 
 
+class AuthError(Exception):
+    """Peer failed the gossip-plane handshake or sent a bad MAC."""
+
+
+class _FrameAuth:
+    """Per-connection frame authentication (the RLPx-parity layer).
+
+    Handshake: each side sends ``MAGIC || nonce16``; both derive
+    per-direction session keys ``keccak(secret || sender_nonce ||
+    receiver_nonce)``.  Every frame then carries
+    ``keccak(key || seq_be8 || payload)[:16]`` with a per-direction
+    monotonically increasing sequence — a wrong network secret, a
+    tampered payload, or a replayed/reordered frame all fail the check.
+    (A keccak prefix-MAC is sound: sponge constructions are not subject
+    to the length-extension attacks that force HMAC on SHA-2.)"""
+
+    MAGIC = b"geec-gossip-v1\x00\x00"
+
+    def __init__(self, secret: bytes):
+        import secrets as _secrets
+
+        self.secret = secret
+        self.my_nonce = _secrets.token_bytes(16)
+        self.send_key = b""
+        self.recv_key = b""
+        self.send_seq = 0
+        self.recv_seq = 0
+
+    def hello(self) -> bytes:
+        return self.MAGIC + self.my_nonce
+
+    def on_hello(self, data: bytes) -> None:
+        from eges_tpu.crypto.keccak import keccak256
+
+        if len(data) != len(self.MAGIC) + 16 or not data.startswith(self.MAGIC):
+            raise AuthError("bad hello")
+        peer_nonce = data[len(self.MAGIC):]
+        self.send_key = keccak256(self.secret + self.my_nonce + peer_nonce)
+        self.recv_key = keccak256(self.secret + peer_nonce + self.my_nonce)
+
+    def seal(self, payload: bytes) -> bytes:
+        from eges_tpu.crypto.keccak import keccak256
+
+        mac = keccak256(self.send_key + self.send_seq.to_bytes(8, "big")
+                        + payload)[:16]
+        self.send_seq += 1
+        return mac + payload
+
+    def open(self, frame: bytes) -> bytes:
+        import hmac as _hmac
+
+        from eges_tpu.crypto.keccak import keccak256
+
+        if len(frame) < 16:
+            raise AuthError("short frame")
+        mac, payload = frame[:16], frame[16:]
+        want = keccak256(self.recv_key + self.recv_seq.to_bytes(8, "big")
+                        + payload)[:16]
+        if not _hmac.compare_digest(mac, want):  # constant-time compare
+            raise AuthError("bad frame MAC")
+        self.recv_seq += 1
+        return payload
+
+
 class GossipPlane:
     """Static-peer-list TCP gossip with 4-byte length-prefixed frames.
 
     Reconnects with backoff; sends are fire-and-forget like the
     reference's per-peer ``p2p.Send`` loops (eth/handler.go:1071-1080).
+    With ``secret`` set, every connection runs the :class:`_FrameAuth`
+    handshake and per-frame MAC (the p2p/rlpx.go role); ``secret=None``
+    keeps the plaintext wire for tests/local rigs.
     """
 
     MAX_FRAME = 64 * 1024 * 1024
 
     def __init__(self, bind_ip: str, bind_port: int, peers: list[tuple[str, int]],
-                 on_gossip):
+                 on_gossip, secret: bytes | None = None):
         self.bind_ip = bind_ip
         self.bind_port = bind_port
         self.peers = [p for p in peers if p != (bind_ip, bind_port)]
         self._on_gossip = on_gossip
+        self.secret = secret
         self._server: asyncio.AbstractServer | None = None
-        self._writers: dict[tuple[str, int], asyncio.StreamWriter] = {}
+        self._writers: dict[tuple[str, int], tuple] = {}  # peer -> (writer, auth)
         self._tasks: list[asyncio.Task] = []
         self._closed = False
+        self.auth_failures = 0
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -98,21 +171,46 @@ class GossipPlane:
         for peer in self.peers:
             self._tasks.append(asyncio.create_task(self._dial_loop(peer)))
 
+    @staticmethod
+    async def _read_frame(reader) -> bytes:
+        hdr = await reader.readexactly(4)
+        (n,) = struct.unpack("<I", hdr)
+        if n > GossipPlane.MAX_FRAME:
+            raise AuthError("oversized frame")
+        return await reader.readexactly(n)
+
+    @staticmethod
+    def _frame(data: bytes) -> bytes:
+        return struct.pack("<I", len(data)) + data
+
+    async def _handshake(self, reader, writer):
+        """Returns a ready _FrameAuth, or None in plaintext mode."""
+        if self.secret is None:
+            return None
+        auth = _FrameAuth(self.secret)
+        writer.write(self._frame(auth.hello()))
+        await writer.drain()
+        auth.on_hello(await asyncio.wait_for(self._read_frame(reader),
+                                             timeout=5.0))
+        return auth
+
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
         try:
+            auth = await self._handshake(reader, writer)
             while True:
-                hdr = await reader.readexactly(4)
-                (n,) = struct.unpack("<I", hdr)
-                if n > self.MAX_FRAME:
-                    break
-                frame = await reader.readexactly(n)
+                frame = await self._read_frame(reader)
+                if auth is not None:
+                    frame = auth.open(frame)
                 try:
                     self._on_gossip(frame)
                 except Exception:
                     pass
-        except (asyncio.IncompleteReadError, ConnectionError):
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.TimeoutError):
             pass
+        except AuthError:
+            self.auth_failures += 1
         finally:
             writer.close()
 
@@ -120,23 +218,28 @@ class GossipPlane:
         backoff = 0.2
         while not self._closed:
             try:
-                _, writer = await asyncio.open_connection(*peer)
-                self._writers[peer] = writer
+                reader, writer = await asyncio.open_connection(*peer)
+                try:
+                    auth = await self._handshake(reader, writer)
+                except AuthError:
+                    self.auth_failures += 1
+                    raise ConnectionError
+                self._writers[peer] = (writer, auth)
                 backoff = 0.2
                 # hold the connection; writer errors surface on send
                 while not writer.is_closing() and not self._closed:
                     await asyncio.sleep(0.5)
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError, asyncio.TimeoutError):
                 pass
             self._writers.pop(peer, None)
             await asyncio.sleep(backoff)
             backoff = min(backoff * 2, 5.0)
 
     def broadcast(self, data: bytes) -> None:
-        frame = struct.pack("<I", len(data)) + data
-        for peer, writer in list(self._writers.items()):
+        for peer, (writer, auth) in list(self._writers.items()):
             try:
-                writer.write(frame)
+                payload = auth.seal(data) if auth is not None else data
+                writer.write(self._frame(payload))
             except Exception:
                 self._writers.pop(peer, None)
 
@@ -144,7 +247,7 @@ class GossipPlane:
         self._closed = True
         for t in self._tasks:
             t.cancel()
-        for w in self._writers.values():
+        for w, _ in self._writers.values():
             w.close()
         if self._server is not None:
             self._server.close()
@@ -158,9 +261,15 @@ class SocketTransport:
         self._direct = direct
 
     def gossip(self, data: bytes) -> None:
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+        metrics.counter("net.gossip_bytes").inc(len(data))
+        metrics.counter("net.gossip_msgs").inc()
         self._gossip.broadcast(data)
 
     def send_direct(self, ip: str, port: int, data: bytes) -> None:
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+        metrics.counter("net.direct_bytes").inc(len(data))
+        metrics.counter("net.direct_msgs").inc()
         self._direct.send(ip, port, data)
 
 

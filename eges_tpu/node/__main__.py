@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="batch-verify signatures on the JAX device")
     p.add_argument("--rpcPort", type=int, default=0,
                    help="JSON-RPC HTTP port (0 = disabled)")
+    p.add_argument("--netSecret", default="",
+                   help="hex gossip-plane auth secret (default: derived "
+                        "from the genesis hash)")
+    p.add_argument("--plaintextGossip", action="store_true",
+                   help="disable the gossip auth layer")
     return p
 
 
@@ -71,7 +76,8 @@ def main(argv=None) -> None:
         gossip_ip=args.gossipIP, gossip_port=args.gossipPort,
         peers=parse_peers(args.peers), node=node_cfg, mine=args.mine,
         verbosity=args.verbosity, use_tpu_verifier=args.tpuVerify,
-        rpc_port=args.rpcPort)
+        rpc_port=args.rpcPort, net_secret_hex=args.netSecret,
+        plaintext_gossip=args.plaintextGossip)
 
     loop = asyncio.new_event_loop()
     asyncio.set_event_loop(loop)

@@ -37,6 +37,9 @@ class ServiceConfig:
     verbosity: int = 3
     use_tpu_verifier: bool = False     # device batch verify on acceptors
     rpc_port: int = 0                  # 0 = RPC disabled
+    net_secret_hex: str = ""           # gossip-plane auth secret; ""
+    #                                    derives one from the genesis hash
+    plaintext_gossip: bool = False     # disable the auth layer entirely
 
 
 def load_genesis_config(path: str) -> tuple[ChainGeecConfig, dict]:
@@ -88,8 +91,19 @@ class NodeService:
 
         self.direct = DirectPlane(ncfg.consensus_ip, ncfg.consensus_port,
                                   self.node.on_direct)
+        # gossip-plane auth secret (the RLPx role): operator-provided, or
+        # derived from the genesis hash — isolating networks and blocking
+        # casual frame injection even without an explicit secret
+        if cfg.plaintext_gossip:
+            secret = None
+        elif cfg.net_secret_hex:
+            secret = bytes.fromhex(cfg.net_secret_hex)
+        else:
+            from eges_tpu.crypto.keccak import keccak256
+            secret = keccak256(b"geec/net-secret" + genesis.hash)
         self.gossip = GossipPlane(cfg.gossip_ip, cfg.gossip_port,
-                                  list(cfg.peers), self.node.on_gossip)
+                                  list(cfg.peers), self.node.on_gossip,
+                                  secret=secret)
         self.node.transport = SocketTransport(self.gossip, self.direct)
 
         self.txn_service = None
@@ -133,6 +147,7 @@ class NodeService:
 
     async def _height_loop(self) -> None:
         last = -1
+        last_metrics = 0.0
         while True:
             h = self.chain.height()
             if h != last:
@@ -142,6 +157,15 @@ class NodeService:
                               geec_txns=len(blk.geec_txns),
                               fake_txns=len(blk.fake_txns))
                 last = h
+            import time as _time
+            if _time.monotonic() - last_metrics > 30.0:
+                last_metrics = _time.monotonic()
+                from eges_tpu.utils.metrics import DEFAULT as metrics
+                snap = metrics.snapshot()
+                if snap:
+                    self.log.geec("metrics", **{
+                        k.replace(".", "_"): v for k, v in snap.items()
+                        if not isinstance(v, dict)})
             await asyncio.sleep(0.5)
 
     async def run_forever(self) -> None:

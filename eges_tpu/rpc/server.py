@@ -209,6 +209,15 @@ class RpcServer:
             if self.node is None:
                 raise RpcError(-32000, "no consensus node")
             return len(self.node.pending_geec_txns)
+        if method == "thw_metrics":
+            # process-wide observability snapshot (ref: the reference's
+            # metrics registry + --metrics flag, metrics/metrics.go:25)
+            from eges_tpu.utils.metrics import DEFAULT as metrics
+            out = metrics.snapshot()
+            if self.txpool is not None:
+                out["txpool"] = dict(self.txpool.stats,
+                                     pending=len(self.txpool))
+            return out
         raise RpcError(-32601, f"method {method} not found")
 
     # -- JSON-RPC plumbing ------------------------------------------------

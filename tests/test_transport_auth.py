@@ -1,0 +1,117 @@
+"""Authenticated gossip plane (the RLPx-parity layer) + metrics wiring."""
+
+import asyncio
+
+import pytest
+
+from eges_tpu.net.transports import AuthError, GossipPlane, _FrameAuth
+
+
+def _pair(sa=b"\x11" * 32, sb=b"\x11" * 32):
+    a, b = _FrameAuth(sa), _FrameAuth(sb)
+    ha, hb = a.hello(), b.hello()
+    a.on_hello(hb)
+    b.on_hello(ha)
+    return a, b
+
+
+def test_frame_auth_roundtrip_and_tamper():
+    # roundtrip over several frames
+    a, b = _pair()
+    for i in range(3):
+        msg = b"payload-%d" % i
+        assert b.open(a.seal(msg)) == msg
+    # tampered payload fails (connection would then drop)
+    a, b = _pair()
+    sealed = a.seal(b"x")
+    with pytest.raises(AuthError):
+        b.open(sealed[:-1] + bytes([sealed[-1] ^ 1]))
+    # replaying the same frame fails (sequence moved on)
+    a, b = _pair()
+    good = a.seal(b"y")
+    assert b.open(good) == b"y"
+    with pytest.raises(AuthError):
+        b.open(good)
+    # wrong secret never opens
+    a, b = _pair(sb=b"\x22" * 32)
+    with pytest.raises(AuthError):
+        b.open(a.seal(b"z"))
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_gossip_plane_auth_end_to_end():
+    """Two planes with the same secret talk; a wrong-secret dialer and a
+    plaintext injector are both rejected."""
+
+    async def run():
+        secret = b"\xAA" * 32
+        got_a, got_b = [], []
+        pa, pb = _free_port(), _free_port()
+        a = GossipPlane("127.0.0.1", pa, [("127.0.0.1", pb)], got_a.append,
+                        secret=secret)
+        b = GossipPlane("127.0.0.1", pb, [("127.0.0.1", pa)], got_b.append,
+                        secret=secret)
+        await a.start()
+        await b.start()
+        await asyncio.sleep(0.5)  # dials + handshakes
+        a.broadcast(b"hello-from-a")
+        b.broadcast(b"hello-from-b")
+        await asyncio.sleep(0.3)
+        assert got_b == [b"hello-from-a"]
+        assert got_a == [b"hello-from-b"]
+
+        # wrong-secret peer: handshake completes (nonces are public) but
+        # its frames never verify
+        evil = GossipPlane("127.0.0.1", _free_port(),
+                           [("127.0.0.1", pb)], lambda d: None,
+                           secret=b"\xBB" * 32)
+        await evil.start()
+        await asyncio.sleep(0.4)
+        evil.broadcast(b"forged")
+        await asyncio.sleep(0.3)
+        assert b"forged" not in got_b
+        assert b.auth_failures >= 1
+
+        # raw plaintext injection is rejected at the handshake/MAC layer
+        import struct
+
+        r, w = await asyncio.open_connection("127.0.0.1", pb)
+        w.write(struct.pack("<I", 5) + b"plain")
+        await w.drain()
+        await asyncio.sleep(0.3)
+        assert b"plain" not in got_b
+        for p in (a, b, evil):
+            p.close()
+        w.close()
+
+    asyncio.run(run())
+
+
+def test_metrics_are_wired():
+    """VERDICT item 7: the registry is fed by chain/verifier/net paths
+    and surfaces through thw_metrics."""
+    from eges_tpu.rpc.server import RpcServer
+    from eges_tpu.sim.cluster import SimCluster
+    from eges_tpu.utils.metrics import DEFAULT as metrics
+
+    before = metrics.counter("chain.blocks").value
+    c = SimCluster(3, txn_per_block=2, seed=2)
+    c.start()
+    c.run(60, stop_condition=lambda: c.min_height() >= 5)
+    snap = metrics.snapshot()
+    assert metrics.counter("chain.blocks").value - before >= 15  # 3 nodes x 5
+    assert snap["net.gossip_msgs"] > 0 and snap["net.gossip_bytes"] > 0
+    assert snap["consensus.sealed"] >= 5
+    assert "chain.insert" in snap
+    rpc = RpcServer(c.nodes[0].chain, node=c.nodes[0].node)
+    out = rpc.dispatch("thw_metrics", [])
+    assert out["chain.blocks"] >= 15

@@ -1,0 +1,81 @@
+"""Merkle-Patricia proof generation/verification
+(ref: trie/proof.go Prove/VerifyProof)."""
+
+import pytest
+
+from eges_tpu.core.trie import (
+    EMPTY_ROOT, secure_trie_prove, secure_trie_root, trie_prove, trie_root,
+    verify_proof, verify_secure_proof,
+)
+
+
+def _pairs(n=40):
+    return {bytes([i, i * 3 % 251]) + b"key-%d" % i: b"value-%d" % (i * i)
+            for i in range(n)}
+
+
+def test_inclusion_proofs():
+    pairs = _pairs()
+    root = trie_root(pairs)
+    for k, v in pairs.items():
+        proof = trie_prove(pairs, k)
+        assert verify_proof(root, k, proof) == v
+
+
+def test_exclusion_proofs():
+    pairs = _pairs()
+    root = trie_root(pairs)
+    for absent in (b"nope", b"key-99-missing", bytes(2) + b"key-41"):
+        proof = trie_prove(pairs, absent)
+        assert verify_proof(root, absent, proof) is None
+
+
+def test_forged_proof_rejected():
+    pairs = _pairs()
+    root = trie_root(pairs)
+    k = next(iter(pairs))
+    proof = trie_prove(pairs, k)
+    # tamper with a proof node
+    bad = list(proof)
+    bad[-1] = bad[-1][:-1] + bytes([bad[-1][-1] ^ 1])
+    with pytest.raises(ValueError):
+        verify_proof(root, k, bad)
+    # truncated proof
+    if len(proof) > 1:
+        with pytest.raises(ValueError):
+            verify_proof(root, k, proof[:-1])
+    # a proof for key A must not verify value under a different root
+    other_root = trie_root(dict(list(pairs.items())[:5]))
+    if other_root != root:
+        with pytest.raises(ValueError):
+            verify_proof(other_root, k, proof)
+
+
+def test_secure_variant_and_small_tries():
+    pairs = {b"alpha": b"1", b"beta": b"2"}
+    root = secure_trie_root(pairs)
+    assert verify_secure_proof(root, b"alpha",
+                               secure_trie_prove(pairs, b"alpha")) == b"1"
+    assert verify_secure_proof(root, b"gamma",
+                               secure_trie_prove(pairs, b"gamma")) is None
+    # single-entry and empty tries
+    one = {b"k": b"v"}
+    assert verify_proof(trie_root(one), b"k", trie_prove(one, b"k")) == b"v"
+    assert verify_proof(EMPTY_ROOT, b"k", []) is None
+
+
+def test_account_proof_against_state_root():
+    """End-to-end: prove an account's RLP against a block's state root —
+    the light-client use the reference trie serves."""
+    from eges_tpu.core import rlp
+    from eges_tpu.core.state import StateDB
+
+    s = StateDB.from_alloc({bytes([i]) * 20: 10**18 * (i + 1)
+                            for i in range(12)})
+    root = s.root()
+    addr = bytes([3]) * 20
+    pairs = {a: rlp.encode(acct.to_rlp())
+             for a, acct in s._accounts.items()}
+    proof = secure_trie_prove(pairs, addr)
+    got = verify_secure_proof(root, addr, proof)
+    assert got == rlp.encode(s.account(addr).to_rlp())
