@@ -74,6 +74,7 @@ def _build(items: list[tuple[list[int], bytes]], depth: int):
         return [_hp_encode(nib[depth:], True), val]
 
     # longest common prefix below depth
+    first = items[0][0]
     lcp = _lcp_below(items, depth)
     if lcp > depth:
         child = _build(items, lcp)
