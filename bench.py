@@ -2,142 +2,311 @@
 
 The BASELINE.json primary metric — secp256k1 verifies/sec/chip — measured
 on whatever accelerator JAX finds (the driver runs this on a real TPU).
-The CPU reference point is the single-threaded cgo ecrecover path the
-fork serializes every transaction through (~12-20k/s/core class,
-BASELINE.md), so ``vs_baseline`` is throughput / 16k.
 
-The workload is honest: real signatures (so the verifier does full work),
-plus a sprinkling of invalid rows (corrupted s, bad recovery id) so the
-masking path is part of the measured graph — and their rejection is
-asserted, as is address correctness vs the independent host model.
-Also reports p50/p99 latency at the 1024-row operating point
-(BASELINE.md: <50 ms p50 @ 1k validators).
+Flake-proof by construction (round-2 lesson: the driver bench timed out
+with zero output):
 
-Prints exactly ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+* The parent process never imports JAX.  It measures the CPU baseline
+  (native C++ single-call recover, the cgo-per-call analogue the
+  reference serializes through, crypto/secp256k1/secp256.go:105), then
+  races TWO child processes — one on the default (TPU) platform, one
+  forced onto the CPU backend — against a wall-clock budget
+  (``BENCH_BUDGET_S``, default 420 s).
+* Children report stage results line-by-line as they complete (256-row
+  graph first: the known-good compile; then 1024 with p50/p99 latency;
+  then 4096/16384 throughput).  The parent prints a complete, valid
+  bench JSON line after EVERY improvement, so a stall at any later
+  stage still leaves a parseable result on stdout.
+* On budget exhaustion the parent kills the children and the last line
+  already printed stands.  TPU results are preferred over CPU results
+  whenever both exist.
+
+The workload is honest: real signatures (so the verifier does full
+work) plus a sprinkling of invalid rows (corrupted s, bad recovery id)
+whose rejection is asserted against the independent host model.
+``vs_baseline`` divides by the *larger* of the measured native-C++
+baseline and the 16 k/s reference-class figure (BASELINE.md: the
+libsecp256k1 cgo path is ~12-20 k verifies/s/core), so the ratio is
+conservative even though our schoolbook C++ recover is slower.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 1)[0])
+_REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _REPO)
 
-CPU_BASELINE_VERIFIES_PER_S = 16_000.0  # mid of 12-20k/s/core (BASELINE.md)
-
-
-def _make_workload(batch: int):
-    """Signatures + hashes with a sprinkling of invalid rows — the
-    flagship model's shared workload builder."""
-    from eges_tpu.models.flagship import example_batch
-
-    return example_batch(batch, invalid_every=17)
+REF_CLASS_CPU_PER_S = 16_000.0  # mid of 12-20k/s/core (BASELINE.md)
+DEFAULT_BUDGET_S = 420.0
 
 
-def main() -> None:
-    # persistent compilation cache: the big recover graph compiles once
-    # per machine, not once per bench run
+# ---------------------------------------------------------------------------
+# child: runs on one backend, emits "RESULT {...}" lines per stage
+# ---------------------------------------------------------------------------
+
+def _child(deadline: float, max_batch: int) -> None:
+    def left() -> float:
+        return deadline - time.monotonic()
+
     import jax
 
-    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         ".jax_cache")
     try:
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(_REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
     except Exception:
         pass
+    import jax.numpy as jnp
     import numpy as np
 
     from eges_tpu.crypto.verifier import ecrecover_batch
+    from eges_tpu.models.flagship import example_batch
 
-    # default to the 1024-row operating point: its graph is the
-    # known-good compile; larger batches scale throughput further
-    # (pass e.g. 4096/16384 when the device session is stable)
-    args = [a for a in sys.argv[1:] if a != "--profile"]
-    profile = "--profile" in sys.argv[1:]
-    batch = int(args[0]) if args else 1024
-    lat_batch = 1024  # BASELINE.md p50 operating point
-
-    if profile:
-        # device trace for xprof/tensorboard (VERDICT item 7: the
-        # profiling hook the round-1 build lacked)
-        jax.profiler.start_trace("/tmp/eges_tpu_profile")
-
+    device = str(jax.devices()[0])
     fn = jax.jit(ecrecover_batch)
 
-    # -- correctness gate (includes invalid-row masking); same shape as the
-    # latency measurement so the bench compiles exactly two graphs --------
-    sigs, hashes, valid, expect = _make_workload(lat_batch)
-    js, jh = jax.numpy.asarray(sigs), jax.numpy.asarray(hashes)
-    addrs, _, ok = fn(js, jh)
-    addrs, ok = np.asarray(addrs), np.asarray(ok).astype(bool)
-    for i in range(len(sigs)):
-        if expect[i] is None:
-            continue  # corrupted-s rows recover some *other* address
-        if valid[i]:
-            assert ok[i], f"row {i}: valid signature rejected"
-            assert bytes(addrs[i]) == expect[i], f"row {i}: address mismatch"
-        else:
-            assert not ok[i], f"row {i}: invalid signature accepted"
+    base_s, base_h, valid, expect = example_batch(max_batch, invalid_every=17)
 
-    # -- throughput at the main batch size ----------------------------------
-    # Distinct pre-uploaded inputs per call: the runtime memoizes repeat
-    # dispatches of (executable, same input buffers), so timing a loop
-    # over one input set measures nothing (observed 478M "verifies"/s).
-    n_iters = 12
-    base_s, base_h, _, _ = _make_workload(batch)
-    sets = []
-    for i in range(n_iters + 1):
-        # distinct content + distinct device buffers per call (row roll is
-        # enough to defeat the dispatch memoization without re-signing)
-        sets.append((jax.numpy.asarray(np.roll(base_s, i, axis=0)),
-                     jax.numpy.asarray(np.roll(base_h, i, axis=0))))
-    jax.block_until_ready(sets)
-    jax.block_until_ready(fn(*sets[-1]))  # compile + warmup
-    t0 = time.perf_counter()
-    for i in range(n_iters):
-        out = fn(*sets[i])
+    def emit(obj: dict) -> None:
+        obj["device"] = device
+        print("RESULT " + json.dumps(obj), flush=True)
+
+    first = True
+    for batch in (256, 1024, 4096, 16384):
+        if batch > max_batch:
+            break
+        # After the first graph is proven, require slack for a fresh
+        # compile + measurement; the first attempt gets all the time.
+        if not first and left() < 90:
+            break
+        sigs, hashes = base_s[:batch], base_h[:batch]
+        t0 = time.monotonic()
+        js, jh = jnp.asarray(sigs), jnp.asarray(hashes)
+        out = fn(js, jh)
         jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
-    per_sec = batch * n_iters / dt
+        compile_s = time.monotonic() - t0
 
-    # -- p50/p99 latency at 1024 rows (distinct inputs each call) -----------
-    n_lat = 30
-    lbase_s, lbase_h, _, _ = _make_workload(lat_batch)
-    lsets = []
-    for i in range(n_lat + 1):
-        lsets.append((jax.numpy.asarray(np.roll(lbase_s, i, axis=0)),
-                      jax.numpy.asarray(np.roll(lbase_h, i, axis=0))))
-    jax.block_until_ready(lsets)
-    jax.block_until_ready(fn(*lsets[-1]))
-    lats = []
-    for i in range(n_lat):
-        a, b = lsets[i]
+        if first:
+            # correctness gate (includes invalid-row masking)
+            addrs = np.asarray(out[0])
+            ok = np.asarray(out[2]).astype(bool)
+            for i in range(batch):
+                if expect[i] is None:
+                    continue  # corrupted-s rows recover some other address
+                if valid[i]:
+                    assert ok[i], f"row {i}: valid signature rejected"
+                    assert bytes(addrs[i]) == expect[i], f"row {i}: addr mismatch"
+                else:
+                    assert not ok[i], f"row {i}: invalid signature accepted"
+            first = False
+
+        # Distinct pre-uploaded inputs per call: the runtime memoizes
+        # repeat dispatches of (executable, same buffers), so timing a
+        # loop over one input set measures nothing.
+        n_iters = 6
+        sets = [(jnp.asarray(np.roll(sigs, i + 1, axis=0)),
+                 jnp.asarray(np.roll(hashes, i + 1, axis=0)))
+                for i in range(n_iters)]
+        jax.block_until_ready(sets)
+        lats = []
+        t0 = time.monotonic()
+        for a, b in sets:
+            t1 = time.monotonic()
+            jax.block_until_ready(fn(a, b))
+            lats.append(time.monotonic() - t1)
+        dt = time.monotonic() - t0
+        res = {"batch": batch, "per_sec": batch * n_iters / dt,
+               "compile_s": round(compile_s, 1)}
+
+        if batch == 1024 and left() > 20:
+            # p50/p99 at the BASELINE.md 1k-validator operating point
+            extra = [(jnp.asarray(np.roll(sigs, i + 10, axis=0)),
+                      jnp.asarray(np.roll(hashes, i + 10, axis=0)))
+                     for i in range(24)]
+            jax.block_until_ready(extra)
+            for a, b in extra:
+                t1 = time.monotonic()
+                jax.block_until_ready(fn(a, b))
+                lats.append(time.monotonic() - t1)
+            lats.sort()
+            res["p50_ms"] = round(lats[len(lats) // 2] * 1e3, 3)
+            res["p99_ms"] = round(lats[min(len(lats) - 1,
+                                           int(len(lats) * 0.99))] * 1e3, 3)
+        emit(res)
+
+
+# ---------------------------------------------------------------------------
+# parent: baseline + race the backends, print progressive JSON lines
+# ---------------------------------------------------------------------------
+
+def _cpu_baseline() -> float | None:
+    """Single-threaded native C++ recover rate (the per-call hot path the
+    reference serializes through); None when the lib isn't built."""
+    try:
+        from eges_tpu.crypto import native
+
+        if not native.available():
+            return None
+        n = 192
+        hashes, sigs = [], []
+        for i in range(n):
+            msg = bytes([(i % 255) + 1]) * 32
+            priv = bytes([(i % 200) + 5]) * 32
+            sigs.append(native.ec_sign(msg, priv))
+            hashes.append(msg)
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(a, b))
-        lats.append(time.perf_counter() - t0)
-    lats.sort()
-    p50 = lats[len(lats) // 2] * 1e3
-    p99 = lats[int(len(lats) * 0.99)] * 1e3
+        for h, s in zip(hashes, sigs):
+            native.ec_recover(h, s)
+        return n / (time.perf_counter() - t0)
+    except Exception:
+        return None
 
-    if profile:
-        jax.profiler.stop_trace()
-        print("# profile trace: /tmp/eges_tpu_profile", file=sys.stderr)
 
-    print(json.dumps({
-        "metric": "secp256k1_ecrecover_verifies_per_sec_per_chip",
-        "value": round(per_sec, 1),
-        "unit": "verifies/s",
-        "vs_baseline": round(per_sec / CPU_BASELINE_VERIFIES_PER_S, 3),
-        "batch": batch,
-        "p50_latency_ms_at_1024": round(p50, 3),
-        "p99_latency_ms_at_1024": round(p99, 3),
-        "device": str(jax.devices()[0]),
-    }))
+def _spawn(kind: str, deadline: float, max_batch: int) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if kind == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        # the axon sitecustomize hook is gated on this var; dropping it
+        # keeps the child from registering the TPU-tunnel plugin at all
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child",
+         f"{deadline:.3f}", str(max_batch)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+
+
+def main() -> None:
+    args = [a for a in sys.argv[1:] if not a.startswith("-")]
+    max_batch = int(args[0]) if args else 16384
+    budget = float(os.environ.get("BENCH_BUDGET_S", DEFAULT_BUDGET_S))
+    t_start = time.monotonic()
+    deadline = t_start + budget
+
+    measured = _cpu_baseline()
+    denom = max(measured or 0.0, REF_CLASS_CPU_PER_S)
+
+    best: dict = {}      # kind -> best stage result for that backend
+    printed = [0]
+
+    def compose() -> dict | None:
+        res = best.get("tpu") or best.get("cpu")
+        if not res:
+            return None
+        out = {
+            "metric": "secp256k1_ecrecover_verifies_per_sec_per_chip",
+            "value": round(res["per_sec"], 1),
+            "unit": "verifies/s",
+            "vs_baseline": round(res["per_sec"] / denom, 3),
+            "batch": res["batch"],
+            "device": res.get("device", "?"),
+            "compile_s": res.get("compile_s"),
+            "cpu_baseline_measured_per_s":
+                round(measured, 1) if measured else None,
+            "cpu_baseline_ref_class_per_s": REF_CLASS_CPU_PER_S,
+            "elapsed_s": round(time.monotonic() - t_start, 1),
+        }
+        for k, name in (("p50_ms", "p50_latency_ms_at_1024"),
+                        ("p99_ms", "p99_latency_ms_at_1024")):
+            if k in res:
+                out[name] = res[k]
+        return out
+
+    def flush_line() -> None:
+        out = compose()
+        if out:
+            print(json.dumps(out), flush=True)
+            printed[0] += 1
+
+    # Sequential, not a race: the bench host has very few cores, and XLA
+    # compilation is the long pole — two compiling children would thrash.
+    # The TPU child gets the budget minus a reserve; the CPU child runs
+    # only if the TPU child dies or produces nothing in time.
+    bufs = {"tpu": b"", "cpu": b""}
+
+    def handle(kind: str, line: str) -> None:
+        if not line.startswith("RESULT "):
+            return
+        try:
+            res = json.loads(line[len("RESULT "):])
+        except ValueError:
+            return
+        cur = best.get(kind)
+        if cur is None or res["per_sec"] >= cur["per_sec"]:
+            merged = dict(cur or {})  # carry earlier p50/p99 forward
+            merged.update(res)
+            best[kind] = merged
+        else:
+            for k in ("p50_ms", "p99_ms"):
+                if k in res:
+                    cur[k] = res[k]
+        flush_line()
+
+    def drain(kind: str, fd: int) -> bool:
+        """Read what's available; returns False on EOF."""
+        try:
+            chunk = os.read(fd, 65536)
+        except BlockingIOError:
+            return True
+        if not chunk:
+            return False
+        bufs[kind] += chunk
+        while b"\n" in bufs[kind]:
+            raw, bufs[kind] = bufs[kind].split(b"\n", 1)
+            handle(kind, raw.decode(errors="replace"))
+        return True
+
+    def run_child(kind: str, child_deadline: float, batch_cap: int) -> None:
+        """Run one child to completion/deadline, streaming its results."""
+        import selectors
+
+        proc = _spawn(kind, child_deadline, batch_cap)
+        fd = proc.stdout.fileno()
+        os.set_blocking(fd, False)
+        sel = selectors.DefaultSelector()
+        sel.register(fd, selectors.EVENT_READ, kind)
+        try:
+            while time.monotonic() < child_deadline + 5:
+                if proc.poll() is not None:
+                    break
+                sel.select(timeout=2.0)
+                drain(kind, fd)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+            for _ in range(64):  # drain whatever the pipe still holds
+                if not drain(kind, fd):
+                    break
+
+    # reserve enough of the budget for a CPU fallback compile+measure
+    tpu_only = "--tpu-only" in sys.argv
+    reserve = 0.0 if tpu_only else min(240.0, budget * 0.55)
+    run_child("tpu", deadline - reserve, max_batch)
+    if "tpu" not in best and time.monotonic() < deadline - 20:
+        run_child("cpu", deadline, min(max_batch, 1024))
+
+    if printed[0] == 0:
+        # nothing measured anywhere: still print a parseable line so the
+        # driver records the failure mode instead of a timeout
+        print(json.dumps({
+            "metric": "secp256k1_ecrecover_verifies_per_sec_per_chip",
+            "value": 0.0, "unit": "verifies/s", "vs_baseline": 0.0,
+            "error": "no backend produced a result within budget",
+            "cpu_baseline_measured_per_s":
+                round(measured, 1) if measured else None,
+        }), flush=True)
+    else:
+        flush_line()
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        _child(float(sys.argv[2]), int(sys.argv[3]))
+        sys.exit(0)
     main()
