@@ -98,17 +98,13 @@ def _carry(cols: jnp.ndarray, n_out: int) -> jnp.ndarray:
 def big_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Full product of two limb vectors: ``[..., na] x [..., nb] -> [..., na+nb]``.
 
-    Schoolbook with lo/hi split so all accumulators stay far below 2^32.
+    Diagonal-gather column sums (no scatter ops — ``.at[].add`` lowered
+    to thousands of scatters across the recover graph and dominated its
+    compile time) followed by one carry chain; all accumulators stay far
+    below 2^32 (col sums < 2^21 for 16x16).
     """
     na, nb = a.shape[-1], b.shape[-1]
-    prod = a[..., :, None] * b[..., None, :]  # [..., na, nb], each < 2^32
-    lo = prod & MASK
-    hi = prod >> LIMB_BITS
-    cols = jnp.zeros((*prod.shape[:-2], na + nb + 1), jnp.uint32)
-    for i in range(na):
-        cols = cols.at[..., i : i + nb].add(lo[..., i, :])
-        cols = cols.at[..., i + 1 : i + nb + 1].add(hi[..., i, :])
-    return _carry(cols, na + nb)
+    return _carry(big_mul_cols(a, b), na + nb)
 
 
 def big_add(a: jnp.ndarray, b: jnp.ndarray, n_out: int | None = None) -> jnp.ndarray:
@@ -264,14 +260,18 @@ class Mod:
         """Montgomery batch inversion over the leading batch axis.
 
         A Fermat inverse costs ~512 field muls *per row*; the batch trick
-        replaces that with ~2 muls per row plus ONE Fermat inverse of the
-        whole batch's product.  Implemented as a product *tree* (log2(B)
-        levels of batched muls) rather than the classic sequential prefix
-        scan, so the batch axis stays parallel on the VPU.
+        replaces that with a handful of full-width muls plus ONE Fermat
+        inverse of the whole batch's product.  Implemented as rolled
+        Hillis-Steele prefix/suffix product scans (``fori_loop`` whose
+        body is a single batched mul — the earlier Python-unrolled
+        product tree traced ~80k HLO ops and dominated compile time):
+
+            P[i] = x[0] * ... * x[i]        (log2 B rolled steps)
+            S[i] = x[i] * ... * x[B-1]      (log2 B rolled steps)
+            inv[i] = P[i-1] * S[i+1] * (P[B-1])^-1
 
         Zero rows pass through as 0 (same contract as :meth:`inv`).
-        ``a`` must be ``[B, 16]``; any B >= 1 (odd level sizes carry the
-        tail element through).
+        ``a`` must be ``[B, 16]``; any B >= 1.
         """
         B = a.shape[0]
         if B == 1:
@@ -279,39 +279,26 @@ class Mod:
         one = jnp.broadcast_to(jnp.asarray(int_to_limbs(1)), a.shape)
         zero_mask = self.is_zero_mod(a)
         x = select(zero_mask, one, a)  # make every row invertible
+        idx = jnp.arange(B, dtype=jnp.uint32)
+        nlev = (B - 1).bit_length()
 
-        # upward pass: pairwise products, carrying odd tails through
-        levels = [x]
-        cur = x
-        while cur.shape[0] > 1:
-            n = cur.shape[0]
-            half = n // 2
-            prod = self.mul(cur[0 : 2 * half : 2], cur[1 : 2 * half : 2])
-            if n % 2:
-                prod = jnp.concatenate([prod, cur[-1:]], axis=0)
-            levels.append(prod)
-            cur = prod
+        def scan(v):
+            def step(k, p):
+                sh = (jnp.uint32(1) << k).astype(jnp.uint32)
+                rolled = jnp.roll(p, sh.astype(jnp.int32), axis=0)
+                contrib = select(idx >= sh, rolled, one)
+                return self.mul(p, contrib)
 
-        # invert the single root product
-        root_inv = self.inv(cur)
+            return jax.lax.fori_loop(0, nlev, step, v)
 
-        # downward pass: child inverses from the parent inverse
-        inv = root_inv
-        for lvl in levels[-2::-1]:
-            n = lvl.shape[0]
-            half = n // 2
-            parent_inv = inv  # [ceil(n/2), 16]
-            left = lvl[0 : 2 * half : 2]
-            right = lvl[1 : 2 * half : 2]
-            pi = parent_inv[:half]
-            inv_left = self.mul(pi, right)
-            inv_right = self.mul(pi, left)
-            pairs = jnp.stack([inv_left, inv_right], axis=1).reshape(
-                2 * half, NLIMBS)
-            if n % 2:
-                pairs = jnp.concatenate([pairs, parent_inv[half:]], axis=0)
-            inv = pairs
-
+        prefix = scan(x)
+        suffix = scan(x[::-1])[::-1]
+        total_inv = self.inv(prefix[-1:])  # [1, 16]
+        p_prev = select(idx >= 1, jnp.roll(prefix, 1, axis=0), one)
+        s_next = select(idx < B - 1, jnp.roll(suffix, -1, axis=0), one)
+        inv = self.mul(self.mul(p_prev, s_next),
+                       jnp.broadcast_to(total_inv, a.shape))
+        inv = self.canon(inv)
         return select(zero_mask, jnp.zeros_like(a), inv)
 
     def inv_batched(self, a: jnp.ndarray) -> jnp.ndarray:
@@ -411,20 +398,22 @@ class FieldP(Mod):
         (add/sub/mul_small); see the inline bounds.
         """
         # fold columns >= 16 into the low 16 via delta = 2^32 + 977
+        # (pad-and-add, NOT .at[].add — scatters are poison for both
+        # XLA compile time and TPU lowering)
         while cols.shape[-1] > 16:
             lo = cols[..., :16]
             hi = cols[..., 16:]
             h = hi.shape[-1]
-            ext = max(h + 2 - 16, 0)
-            if ext:
-                lo = jnp.concatenate(
-                    [lo, jnp.zeros((*lo.shape[:-1], ext), jnp.uint32)],
-                    axis=-1)
+            w = max(16, h + 2)
+            pad = [(0, 0)] * (cols.ndim - 1)
+            lo_w = jnp.concatenate(
+                [lo, jnp.zeros((*lo.shape[:-1], w - 16), jnp.uint32)],
+                axis=-1) if w > 16 else lo
             # col j   += 977 * hi_j   (j < h;    977*2^21 < 2^31)
+            t977 = jnp.pad(hi * jnp.uint32(977), pad + [(0, w - h)])
             # col j+2 += hi_j         (2^21)
-            lo = lo.at[..., :h].add(hi * jnp.uint32(977))
-            lo = lo.at[..., 2 : 2 + h].add(hi)
-            cols = lo
+            tsh = jnp.pad(hi, pad + [(2, w - h - 2)])
+            cols = lo_w + t977 + tsh
         # first full carry: 16 columns < 2^32 -> limbs + c_top < 2^16+eps
         out = []
         c = jnp.zeros(cols.shape[:-1], jnp.uint32)
@@ -499,10 +488,52 @@ class FieldP(Mod):
 
 
 class OrderN(Mod):
-    """The scalar field mod the group order N."""
+    """The scalar field mod the group order N, with a column-space fast
+    multiply: the generic ``big_mul + red`` path walks ~6 carry chains
+    per multiply; here each delta-fold carries the high part once and
+    accumulates the fold product as uncarried columns, so a full modular
+    multiply costs 3 short chains total (delta_N is 129 bits = 9 limbs,
+    so three folds shrink 512 -> <257 bits: 32 -> 26 -> 20 -> 16+eps)."""
 
     def __init__(self):
         super().__init__(N, n_folds=3)
+
+    def mul(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        return self._red_cols(big_mul_cols(a, b))
+
+    def sqr(self, a: jnp.ndarray) -> jnp.ndarray:
+        return self.mul(a, a)
+
+    def red(self, wide: jnp.ndarray) -> jnp.ndarray:
+        # carried limbs are valid (small) columns — same fast reducer
+        return self._red_cols(wide)
+
+    def _red_cols(self, cols: jnp.ndarray) -> jnp.ndarray:
+        """Uncarried columns (< 2^22 each) -> canonical [0, N)."""
+        delta = jnp.asarray(self.delta_limbs_np)  # 9 limbs
+        nd = delta.shape[-1]
+        pad = [(0, 0)] * (cols.ndim - 1)
+        while cols.shape[-1] > 16:
+            lo = cols[..., :16]
+            # carry the high columns into clean limbs before multiplying
+            # by delta (uncarried cols x delta limbs would overflow u32)
+            hi = _carry(cols[..., 16:], cols.shape[-1] - 16 + 1)
+            prod = big_mul_cols(hi, jnp.broadcast_to(
+                delta, (*hi.shape[:-1], nd)))  # uncarried, < 2^21
+            w = max(16, prod.shape[-1])
+            lo_w = jnp.pad(lo, pad + [(0, w - 16)])
+            pr_w = jnp.pad(prod, pad + [(0, w - prod.shape[-1])])
+            cols = lo_w + pr_w
+        a = _carry(cols, 17)
+        # fold the top limb twice: the first fold can still push the
+        # value past 2^256 (top < 2^7 here), the second cannot (top <= 1)
+        for _ in range(2):
+            top = a[..., 16:17]
+            fold = jnp.pad(top * delta, pad + [(0, 16 - nd)])
+            a = _carry(a[..., :16] + fold, 17)
+        a = a[..., :16]
+        a = self._cond_sub_m(a)
+        return self._cond_sub_m(a)
 
 
 FP = FieldP()

@@ -248,6 +248,25 @@ def _build_point_table(px: jnp.ndarray, py: jnp.ndarray):
     return tx, ty, tz
 
 
+def _build_affine_table(px: jnp.ndarray, py: jnp.ndarray):
+    """Affine variable-base table ``d * P``, d in 0..15: the Jacobian
+    table batch-normalized with ONE inversion scan over all 16*B entries.
+
+    Buying affine entries up front lets the Strauss loop use the cheap
+    mixed add for the R operand too (the full ``jac_add`` + its embedded
+    doubling path leave the loop body) — fewer field muls per iteration
+    AND a much smaller compiled graph.  Rows for d=0 are infinity; the
+    caller masks them by digit anyway (d*P is never infinity for d in
+    1..15 on a prime-order curve).
+    """
+    tx, ty, tz = _build_point_table(px, py)
+    zi = FP.inv_batched(tz)
+    zi2 = FP.sqr(zi)
+    ax = FP.mul(tx, zi2)
+    ay = FP.mul(ty, FP.mul(zi, zi2))
+    return ax, ay
+
+
 def _table_lookup(table, digit: jnp.ndarray):
     """Per-row gather from a ``[16, ..., 16]`` stacked Jacobian table."""
     idx = digit[None, ..., None]
@@ -273,7 +292,7 @@ def strauss_gR(u1: jnp.ndarray, u2: jnp.ndarray, rx: jnp.ndarray, ry: jnp.ndarra
     tgx_np, tgy_np = _g_table16()
     tgx = jnp.asarray(tgx_np)
     tgy = jnp.asarray(tgy_np)
-    tr = _build_point_table(rx, ry)
+    trx, try_ = _build_affine_table(rx, ry)
     acc = infinity(rx)
 
     def body(i, acc):
@@ -281,18 +300,24 @@ def strauss_gR(u1: jnp.ndarray, u2: jnp.ndarray, rx: jnp.ndarray, ry: jnp.ndarra
         acc = jax.lax.fori_loop(0, WINDOW, lambda _, a: jac_double(a), acc)
         dj1 = jax.lax.dynamic_index_in_dim(d1, j, axis=-1, keepdims=False)
         dj2 = jax.lax.dynamic_index_in_dim(d2, j, axis=-1, keepdims=False)
-        # fixed-base: constant affine table, per-row digit gather
+        # fixed-base gather (constant table) and variable-base gather
+        # (per-row affine table), stacked so the conditional mixed add
+        # below traces ONCE for both operands — the add body is by far
+        # the largest subgraph in the loop (graph size ~= compile time)
         gx = jnp.take(tgx, dj1, axis=0)
         gy = jnp.take(tgy, dj1, axis=0)
-        added_g = jac_add_mixed(acc, gx, gy)
-        nz1 = (dj1 != 0).astype(jnp.uint32)
-        acc = tuple(select(nz1, n, o) for n, o in zip(added_g, acc))
-        # variable-base: per-row Jacobian table
-        radd = _table_lookup(tr, dj2)
-        added_r = jac_add(acc, radd)
-        nz2 = (dj2 != 0).astype(jnp.uint32)
-        acc = tuple(select(nz2, n, o) for n, o in zip(added_r, acc))
-        return acc
+        rx_d, ry_d = _table_lookup((trx, try_), dj2)
+        xs = jnp.stack([gx, rx_d])
+        ys = jnp.stack([gy, ry_d])
+        nzs = jnp.stack([(dj1 != 0).astype(jnp.uint32),
+                         (dj2 != 0).astype(jnp.uint32)])
+
+        def add_step(t, a):
+            added = jac_add_mixed(a, xs[t], ys[t])
+            nz = nzs[t]
+            return tuple(select(nz, n, o) for n, o in zip(added, a))
+
+        return jax.lax.fori_loop(0, 2, add_step, acc)
 
     return jax.lax.fori_loop(0, N_WINDOWS, body, acc)
 
@@ -301,14 +326,15 @@ def scalar_mul(k: jnp.ndarray, px: jnp.ndarray, py: jnp.ndarray):
     """Windowed ``k * P`` for an affine per-row point (used by tests and
     the batched classic-verify path)."""
     digs = _scalar_digits(k)
-    tp = _build_point_table(px, py)
+    tpx, tpy = _build_affine_table(px, py)
     acc = infinity(px)
 
     def body(i, acc):
         j = N_WINDOWS - 1 - i
         acc = jax.lax.fori_loop(0, WINDOW, lambda _, a: jac_double(a), acc)
         dj = jax.lax.dynamic_index_in_dim(digs, j, axis=-1, keepdims=False)
-        added = jac_add(acc, _table_lookup(tp, dj))
+        px_d, py_d = _table_lookup((tpx, tpy), dj)
+        added = jac_add_mixed(acc, px_d, py_d)
         nz = (dj != 0).astype(jnp.uint32)
         return tuple(select(nz, n, o) for n, o in zip(added, acc))
 

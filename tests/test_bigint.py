@@ -97,6 +97,7 @@ def test_fp_relaxed_inputs():
     assert np.asarray(FP.is_zero_mod(z)).tolist() == [1, 1, 0]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("mod,extremes", [(FP, EXTREMES_P), (FN, EXTREMES_N)])
 def test_mod_inv(mod, extremes):
     vals, a = _rand_batch(mod.m, 8, [1, mod.m - 1])

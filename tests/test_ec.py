@@ -92,6 +92,7 @@ def test_add_exceptional_cases(rng):
     assert ok[0] == 0
 
 
+@pytest.mark.slow
 def test_scalar_mul_matches_host(rng):
     ks = _rand_scalars(3, rng)
     base_k = _rand_scalars(1, rng)[0]
@@ -108,6 +109,7 @@ def test_scalar_mul_matches_host(rng):
         assert limbs_to_int(y[i]) == expect[1]
 
 
+@pytest.mark.slow
 def test_strauss_matches_host(rng):
     n = 3
     u1s = _rand_scalars(n, rng)
@@ -129,6 +131,7 @@ def test_strauss_matches_host(rng):
         assert limbs_to_int(y[i]) == expect[1]
 
 
+@pytest.mark.slow
 def test_ecrecover_point_matches_host(rng):
     n = 4
     privs = [secrets.token_bytes(32) for _ in range(n)]
@@ -160,6 +163,7 @@ def test_ecrecover_point_matches_host(rng):
     )
 
 
+@pytest.mark.slow
 def test_ecdsa_verify_point(rng):
     n = 3
     privs = [secrets.token_bytes(32) for _ in range(n)]

@@ -24,6 +24,7 @@ def _make_sigs(n):
     return sigs, hashes, addrs, pubs
 
 
+@pytest.mark.slow
 def test_ecrecover_single_device():
     sigs, hashes, addrs, _ = _make_sigs(5)
     bv = BatchVerifier()
@@ -40,6 +41,7 @@ def test_ecrecover_single_device():
     assert not (ok2[2] and bytes(got2[2]) == addrs[2])
 
 
+@pytest.mark.slow
 def test_ecrecover_sharded_mesh():
     devs = jax.devices()
     assert len(devs) == 8, "conftest must provide 8 virtual devices"
@@ -52,6 +54,7 @@ def test_ecrecover_sharded_mesh():
         assert bytes(g) == a
 
 
+@pytest.mark.slow
 def test_classic_verify():
     sigs, hashes, _, pubs = _make_sigs(4)
     bv = BatchVerifier()
