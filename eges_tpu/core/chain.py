@@ -185,6 +185,11 @@ class BlockChain:
         self._states: dict[bytes, object] = {}
         self._state_height: dict[bytes, int] = {}
         self._receipts: dict[bytes, tuple] = {}
+        # txn-hash -> (block number, index): the LevelDB txn-lookup
+        # index role (ref: core/database_util.go WriteTxLookupEntries),
+        # pruned in step with the state snapshots
+        self._tx_index: dict[bytes, tuple[int, int]] = {}
+        self._txs_by_height: dict[int, list[bytes]] = {}
 
         head_hash = self.store.get_head()
         if head_hash is None:
@@ -212,6 +217,7 @@ class BlockChain:
             parent_state = self._states[blk.header.parent_hash]
             state, receipts, _ = self._process(blk, parent_state)
             self._remember_state(blk.hash, n, state, receipts)
+            self._index_txns(blk)
 
     # -- reads ------------------------------------------------------------
 
@@ -269,7 +275,8 @@ class BlockChain:
         )
         try:
             senders = recover_senders(block.transactions, self.verifier)
-            state, receipts, gas = process_block(parent_state, block, senders)
+            state, receipts, gas = process_block(parent_state, block,
+                                                 senders, self.verifier)
         except StateError as e:
             raise ChainError(str(e))
         if block.header.root != state.root():
@@ -297,6 +304,31 @@ class BlockChain:
                     self._states.pop(h, None)
                     self._state_height.pop(h, None)
                     self._receipts.pop(h, None)
+            for n in [k for k in self._txs_by_height if 0 < k < floor]:
+                for th in self._txs_by_height.pop(n):
+                    self._tx_index.pop(th, None)
+
+    def _index_txns(self, block: Block) -> None:
+        if not block.transactions:
+            return
+        hashes = []
+        for i, t in enumerate(block.transactions):
+            self._tx_index[t.hash] = (block.number, i)
+            hashes.append(t.hash)
+        self._txs_by_height[block.number] = hashes
+
+    def lookup_txn(self, txn_hash: bytes):
+        """``(block, index, receipt) | None`` via the txn index."""
+        loc = self._tx_index.get(txn_hash)
+        if loc is None:
+            return None
+        n, i = loc
+        blk = self.get_block_by_number(n)
+        if blk is None or i >= len(blk.transactions) \
+                or blk.transactions[i].hash != txn_hash:
+            return None  # displaced by a reorg
+        receipts = self.receipts_of(blk.hash)
+        return blk, i, receipts[i] if i < len(receipts) else None
 
     # -- state reads (L3 surface for RPC / txpool / acceptors) ------------
 
@@ -309,14 +341,19 @@ class BlockChain:
     def receipts_of(self, block_hash: bytes) -> tuple:
         return self._receipts.get(block_hash, ())
 
-    def execute_preview(self, txs, coinbase: bytes = bytes(20)) -> tuple:
+    def execute_preview(self, txs, coinbase: bytes = bytes(20),
+                        ctx=None) -> tuple:
         """Proposer-side dry run on top of the head state: greedily apply
         ``txs``, dropping any that cannot execute, and return
         ``(kept_txs, root, receipt_root, gas_used)`` for the new header
         (the role of the worker's commitTransactions loop,
         ref: miner/worker.go:463-467).  ``coinbase`` is the PROPOSED
-        block's fee recipient — it must match the header being built or
-        the state root will not."""
+        block's fee recipient and ``ctx`` MUST carry the exact
+        time/difficulty/number the sealed header will — validation
+        re-executes with ``block_ctx(header)``, so any divergence (a
+        contract reading TIMESTAMP, say) makes the committed state root
+        unreproducible."""
+        from eges_tpu.core.evm import BlockCtx
         from eges_tpu.core.state import (
             StateError, apply_txn, receipts_root, recover_senders,
         )
@@ -327,11 +364,16 @@ class BlockChain:
             except StateError:
                 senders = [None] * len(txs)
             kept, receipts, gas = [], [], 0
+            if ctx is None:
+                ctx = BlockCtx(coinbase=coinbase,
+                               number=self._head.number + 1,
+                               time=self._head.header.time + 1)
             for t, sender in zip(txs, senders):
                 if sender is None:
                     continue
                 try:
-                    r = apply_txn(state, t, sender, coinbase, gas)
+                    r = apply_txn(state, t, sender, coinbase, gas,
+                                  ctx=ctx, verifier=self.verifier)
                 except StateError:
                     continue
                 gas = r.cumulative_gas_used
@@ -475,6 +517,7 @@ class BlockChain:
         self.store.set_head(block.hash)
         self._head = block
         self._remember_state(block.hash, block.number, state, receipts)
+        self._index_txns(block)
         metrics.timer("chain.insert").update(time.monotonic() - t0)
         metrics.counter("chain.blocks").inc()
         metrics.counter("chain.txns").inc(len(block.transactions))

@@ -40,11 +40,34 @@ class StateError(Exception):
 
 @dataclass(frozen=True)
 class Account:
+    """Account with optional contract code and storage (ref:
+    core/state/state_object.go).  ``storage`` is an immutable-by-
+    convention mapping slot->word; the EVM mutates via a per-transaction
+    write cache flushed as one new dict per touched account, so plain
+    value-transfer accounts never pay for it."""
+
     nonce: int = 0
     balance: int = 0
+    code_hash: bytes = EMPTY_CODE_HASH
+    storage: tuple = ()  # sorted ((slot, value), ...) pairs
+
+    def storage_root(self) -> bytes:
+        if not self.storage:
+            return EMPTY_ROOT
+        return secure_trie_root({
+            slot.to_bytes(32, "big"): rlp.encode(value)
+            for slot, value in self.storage})
+
+    def storage_value(self, slot: int) -> int:
+        import bisect
+        i = bisect.bisect_left(self.storage, (slot,))
+        if i < len(self.storage) and self.storage[i][0] == slot:
+            return self.storage[i][1]
+        return 0
 
     def to_rlp(self) -> list:
-        return [self.nonce, self.balance, EMPTY_ROOT, EMPTY_CODE_HASH]
+        return [self.nonce, self.balance, self.storage_root(),
+                self.code_hash]
 
 
 @dataclass(frozen=True)
@@ -68,19 +91,44 @@ class Receipt:
         status, gas, _bloom, logs = item
         return cls(status=rlp.decode_uint(status),
                    cumulative_gas_used=rlp.decode_uint(gas),
-                   logs=tuple(logs))
+                   logs=tuple(
+                       (bytes(l[0]), tuple(bytes(t) for t in l[1]),
+                        bytes(l[2]))
+                       for l in logs))
 
 
 class StateDB:
-    """Flat account map with trie-root derivation.
+    """Account state with copy-on-write snapshots and an incremental
+    secure-trie root.
 
-    Immutable-by-convention: :meth:`copy` before applying a block, so
-    every canonical block keeps its own state snapshot and reorgs just
-    re-point (the journaled-revert machinery of the reference collapses
-    to copy-on-write under the single insert funnel)."""
+    Round-2 verdict item 10 redesign: :meth:`copy` no longer duplicates
+    the account map — a snapshot is an overlay whose reads fall through
+    to its parent, and the state root is maintained by a persistent
+    :class:`~eges_tpu.core.trie.SecureIncrementalTrie` (structure-shared
+    across snapshots), so per-block cost is O(touched accounts x trie
+    depth) in both time and memory, not O(total accounts).  The
+    journaled-revert machinery of the reference (core/state/journal.go)
+    collapses to "throw the overlay away" under the single insert funnel.
+    """
+
+    __slots__ = ("_base", "_local", "_trie", "_dirty", "_root_cache",
+                 "_codes")
+
+    # flatten overlay chains deeper than this so reads stay O(1)-ish
+    _MAX_DEPTH = 48
 
     def __init__(self, accounts: dict[bytes, Account] | None = None):
-        self._accounts: dict[bytes, Account] = dict(accounts or {})
+        self._base: StateDB | None = None
+        # addr -> Account (live) | None (deleted/empty)
+        self._local: dict[bytes, Account | None] = dict(accounts or {})
+        from eges_tpu.core.trie import SecureIncrementalTrie
+        self._trie = SecureIncrementalTrie()
+        self._dirty: set[bytes] = set(self._local)
+        self._root_cache: bytes | None = None
+        # code_hash -> bytecode: append-only, shared by reference across
+        # all snapshots (the reference stores code in the db by hash,
+        # core/state/database.go ContractCode)
+        self._codes: dict[bytes, bytes] = {}
 
     @classmethod
     def from_alloc(cls, alloc: dict[bytes, int]) -> "StateDB":
@@ -89,10 +137,49 @@ class StateDB:
         return cls({a: Account(balance=b) for a, b in alloc.items() if b})
 
     def copy(self) -> "StateDB":
-        return StateDB(self._accounts)
+        if self._depth() >= self._MAX_DEPTH:
+            # flatten SELF (not the child): reads stay O(1)-ish and the
+            # child keeps ``child._base is self``, which absorb() relies
+            # on (EVM frame commits)
+            self._local = dict(self.iter_accounts())
+            self._base = None
+        child = StateDB.__new__(StateDB)
+        child._base = self
+        child._local = {}
+        child._trie = self._trie
+        child._dirty = set(self._dirty)
+        child._root_cache = self._root_cache
+        child._codes = self._codes  # append-only, shared
+        return child
+
+    def _depth(self) -> int:
+        d, s = 0, self._base
+        while s is not None:
+            d += 1
+            s = s._base
+        return d
 
     def account(self, addr: bytes) -> Account:
-        return self._accounts.get(addr, Account())
+        s = self
+        while s is not None:
+            if addr in s._local:
+                a = s._local[addr]
+                return a if a is not None else Account()
+            s = s._base
+        return Account()
+
+    def iter_accounts(self):
+        """(addr, Account) pairs of the live state (overlay-merged)."""
+        seen: set[bytes] = set()
+        s = self
+        while s is not None:
+            for addr, a in s._local.items():
+                if addr in seen:
+                    continue
+                seen.add(addr)
+                if a is not None:
+                    yield addr, a
+            s = s._base
 
     def balance(self, addr: bytes) -> int:
         return self.account(addr).balance
@@ -100,11 +187,13 @@ class StateDB:
     def nonce(self, addr: bytes) -> int:
         return self.account(addr).nonce
 
+    def set_account(self, addr: bytes, acct: Account) -> None:
+        self._set(addr, acct)
+
     def _set(self, addr: bytes, acct: Account) -> None:
-        if acct == Account():
-            self._accounts.pop(addr, None)  # empty accounts are pruned
-        else:
-            self._accounts[addr] = acct
+        self._local[addr] = None if acct == Account() else acct
+        self._dirty.add(addr)
+        self._root_cache = None
 
     def add_balance(self, addr: bytes, amount: int) -> None:
         a = self.account(addr)
@@ -120,16 +209,74 @@ class StateDB:
         a = self.account(addr)
         self._set(addr, replace(a, nonce=a.nonce + 1))
 
+    # -- contract code & storage (EVM surface) ----------------------------
+
+    def code(self, addr: bytes) -> bytes:
+        ch = self.account(addr).code_hash
+        if ch == EMPTY_CODE_HASH:
+            return b""
+        s = self
+        while s is not None:
+            if ch in s._codes:
+                return s._codes[ch]
+            s = s._base
+        return b""
+
+    def set_code(self, addr: bytes, code: bytes) -> None:
+        ch = keccak256(code) if code else EMPTY_CODE_HASH
+        if code:
+            self._codes[ch] = code
+        a = self.account(addr)
+        self._set(addr, replace(a, code_hash=ch))
+
+    def storage_at(self, addr: bytes, slot: int) -> int:
+        return self.account(addr).storage_value(slot)
+
+    def set_storage_many(self, addr: bytes, writes: dict[int, int]) -> None:
+        """Merge a transaction's storage write-set into ``addr`` (one new
+        sorted tuple per touched account per txn)."""
+        if not writes:
+            return
+        a = self.account(addr)
+        merged = dict(a.storage)
+        for k, v in writes.items():
+            if v:
+                merged[k] = v
+            else:
+                merged.pop(k, None)
+        self._set(addr, replace(a, storage=tuple(sorted(merged.items()))))
+
+    def absorb(self, child: "StateDB") -> None:
+        """Merge a successful child overlay (``child._base is self``)
+        back into this state — the EVM's frame-commit: sub-calls run on
+        a copy and either absorb (success) or drop (revert), replacing
+        the reference's journal/revert machinery
+        (core/state/journal.go)."""
+        assert child._base is self, "absorb requires a direct child"
+        for addr, acct in child._local.items():
+            self._local[addr] = acct
+            self._dirty.add(addr)
+        if child._local:
+            self._root_cache = None
+
     def root(self) -> bytes:
-        """Secure-trie state root over geth-shaped account RLP."""
-        if not self._accounts:
-            return EMPTY_ROOT
-        return secure_trie_root({
-            addr: rlp.encode(acct.to_rlp())
-            for addr, acct in self._accounts.items()})
+        """Secure-trie state root over geth-shaped account RLP;
+        incremental — only accounts dirtied since the last call rehash."""
+        if self._root_cache is None:
+            t = self._trie
+            for addr in self._dirty:
+                a = self.account(addr)
+                if a == Account():
+                    t = t.delete(addr)
+                else:
+                    t = t.update(addr, rlp.encode(a.to_rlp()))
+            self._trie = t
+            self._dirty = set()
+            self._root_cache = t.root()
+        return self._root_cache
 
     def __len__(self) -> int:
-        return len(self._accounts)
+        return sum(1 for _ in self.iter_accounts())
 
 
 def contract_address(sender: bytes, nonce: int) -> bytes:
@@ -155,6 +302,8 @@ def recover_senders(txns, verifier) -> list:
     if not rows:
         return senders
     if verifier is None:
+        from eges_tpu.crypto.verify_host import _count_host_rows
+        _count_host_rows(len(rows))
         for i, _ in rows:
             try:
                 senders[i] = txns[i].sender()
@@ -175,28 +324,79 @@ def recover_senders(txns, verifier) -> list:
 
 
 def apply_txn(state: StateDB, txn, sender: bytes, coinbase: bytes,
-              gas_so_far: int) -> Receipt:
+              gas_so_far: int, *, ctx=None, verifier=None) -> Receipt:
     """Apply one signed transaction, mutating ``state``
     (ref: core/state_transition.go TransitionDb: nonce check, balance
-    check, value transfer, fee to coinbase)."""
+    check, value transfer / EVM execution, fee to coinbase).
+
+    Plain value transfers to code-less accounts keep the original fast
+    path (INTRINSIC_GAS, no interpreter); creates, calls into code, and
+    calls into the precompile addresses run the EVM subset
+    (:mod:`eges_tpu.core.evm`)."""
     acct = state.account(sender)
     if txn.nonce != acct.nonce:
         raise StateError(f"nonce mismatch: txn {txn.nonce} vs state {acct.nonce}")
-    fee = INTRINSIC_GAS * txn.gas_price
-    if txn.gas_limit and txn.gas_limit < INTRINSIC_GAS:
+
+    is_create = txn.to is None
+    to_int = int.from_bytes(txn.to, "big") if txn.to is not None else -1
+    runs_evm = is_create or (1 <= to_int <= 4) or bool(state.code(txn.to))
+    if not runs_evm:
+        fee = INTRINSIC_GAS * txn.gas_price
+        if txn.gas_limit and txn.gas_limit < INTRINSIC_GAS:
+            raise StateError("intrinsic gas too low")
+        if acct.balance < txn.value + fee:
+            raise StateError("insufficient balance for value + fee")
+        state.sub_balance(sender, txn.value + fee)
+        state.bump_nonce(sender)
+        state.add_balance(txn.to, txn.value)
+        if fee:
+            state.add_balance(coinbase, fee)
+        return Receipt(status=1, cumulative_gas_used=gas_so_far + INTRINSIC_GAS)
+
+    from eges_tpu.core import evm as _evm
+
+    data = txn.payload or b""
+    intrinsic = _evm.intrinsic_gas(data, is_create)
+    gas_limit = txn.gas_limit or intrinsic
+    if gas_limit < intrinsic:
         raise StateError("intrinsic gas too low")
-    if acct.balance < txn.value + fee:
+    upfront = gas_limit * txn.gas_price
+    if acct.balance < txn.value + upfront:
         raise StateError("insufficient balance for value + fee")
-    state.sub_balance(sender, txn.value + fee)
+    state.sub_balance(sender, upfront)
     state.bump_nonce(sender)
-    to = txn.to if txn.to is not None else contract_address(sender, txn.nonce)
-    state.add_balance(to, txn.value)
+
+    e = _evm.EVM(state, ctx if ctx is not None else _evm.BlockCtx(
+        coinbase=coinbase), verifier=verifier)
+    exec_gas = gas_limit - intrinsic
+    if is_create:
+        res = e.create(sender, txn.value, data, exec_gas, txn.nonce)
+    else:
+        res = e.call(sender, txn.to, txn.value, data, exec_gas)
+    gas_used = intrinsic + min(res.gas_used, exec_gas)
+    refund = (gas_limit - gas_used) * txn.gas_price
+    if refund:
+        state.add_balance(sender, refund)
+    fee = gas_used * txn.gas_price
     if fee:
         state.add_balance(coinbase, fee)
-    return Receipt(status=1, cumulative_gas_used=gas_so_far + INTRINSIC_GAS)
+    return Receipt(status=1 if res.success else 0,
+                   cumulative_gas_used=gas_so_far + gas_used,
+                   logs=tuple(e.logs) if res.success else ())
 
 
-def process_block(parent_state: StateDB, block, senders) -> tuple:
+def block_ctx(header, blockhash=None):
+    """EVM block context from a header (ref: core/evm.go NewEVMContext)."""
+    from eges_tpu.core.evm import BlockCtx
+
+    return BlockCtx(coinbase=header.coinbase, number=header.number,
+                    time=header.time, difficulty=header.difficulty,
+                    gas_limit=header.gas_limit or 30_000_000,
+                    blockhash=blockhash)
+
+
+def process_block(parent_state: StateDB, block, senders,
+                  verifier=None) -> tuple:
     """Apply a block's rooted transactions to a COPY of the parent state
     (ref: StateProcessor.Process, core/state_processor.go:60-100).
 
@@ -210,10 +410,12 @@ def process_block(parent_state: StateDB, block, senders) -> tuple:
     receipts = []
     gas = 0
     coinbase = block.header.coinbase
+    ctx = block_ctx(block.header)
     for t, sender in zip(block.transactions, senders):
         if sender is None:
             raise StateError("rooted transaction without a sender")
-        r = apply_txn(state, t, sender, coinbase, gas)
+        r = apply_txn(state, t, sender, coinbase, gas, ctx=ctx,
+                      verifier=verifier)
         gas = r.cumulative_gas_used
         receipts.append(r)
     return state, tuple(receipts), gas

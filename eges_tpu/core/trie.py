@@ -219,3 +219,226 @@ def secure_trie_prove(pairs: dict[bytes, bytes], key: bytes) -> list[bytes]:
 
 def verify_secure_proof(root: bytes, key: bytes, proof: list[bytes]):
     return verify_proof(root, keccak256(key), proof)
+
+
+# ---------------------------------------------------------------------------
+# persistent incremental trie (ref: trie/trie.go insert/delete — redesigned
+# as an immutable structure-sharing tree instead of geth's mutable nodes +
+# journal, so every chain snapshot holds a root pointer and per-block cost
+# is O(dirty keys x depth), round-2 verdict item 10)
+# ---------------------------------------------------------------------------
+
+class _Leaf:
+    __slots__ = ("path", "value", "_enc")
+
+    def __init__(self, path: tuple[int, ...], value: bytes):
+        self.path = path
+        self.value = value
+        self._enc = None
+
+
+class _Ext:
+    __slots__ = ("path", "child", "_enc")
+
+    def __init__(self, path: tuple[int, ...], child):
+        self.path = path
+        self.child = child
+        self._enc = None
+
+
+class _Branch:
+    __slots__ = ("children", "value", "_enc")
+
+    def __init__(self, children: tuple, value: bytes):
+        self.children = children  # 16-tuple of nodes | None
+        self.value = value
+        self._enc = None
+
+
+def _encode_node(node) -> bytes:
+    """RLP encoding of a node, memoized on the (immutable) node object."""
+    if node._enc is None:
+        if isinstance(node, _Leaf):
+            s = [_hp_encode(list(node.path), True), node.value]
+        elif isinstance(node, _Ext):
+            s = [_hp_encode(list(node.path), False),
+                 _node_ref(_encode_node(node.child))]
+        else:
+            s = [(b"" if c is None else _node_ref(_encode_node(c)))
+                 for c in node.children] + [node.value]
+        node._enc = rlp.encode(s)
+    return node._enc
+
+
+def _insert(node, nibs: tuple[int, ...], value: bytes):
+    """Insert/overwrite; returns the new node (shares unchanged subtrees)."""
+    if node is None:
+        return _Leaf(nibs, value)
+    if isinstance(node, _Leaf):
+        if node.path == nibs:
+            return _Leaf(nibs, value)
+        # branch at the divergence point, extension over the shared
+        # prefix (a chain of single-child branches would hash to a
+        # non-canonical root)
+        n = _common_len(node.path, nibs)
+        children: list = [None] * 16
+        bval = b""
+        for path, val in ((node.path, node.value), (nibs, value)):
+            if len(path) == n:
+                bval = val
+            else:
+                children[path[n]] = _Leaf(path[n + 1:], val)
+        return _make_ext(node.path[:n], _Branch(tuple(children), bval))
+    if isinstance(node, _Ext):
+        p = node.path
+        n = _common_len(p, nibs)
+        if n == len(p):
+            return _make_ext(p, _insert(node.child, nibs[n:], value))
+        # split the extension at n
+        below = node.child if len(p) == n + 1 else _Ext(p[n + 1:], node.child)
+        children: list = [None] * 16
+        children[p[n]] = below
+        branch = _Branch(tuple(children), b"")
+        branch = _insert(branch, nibs[n:], value)
+        return _make_ext(p[:n], branch) if n else branch
+    # branch
+    if not nibs:
+        return _Branch(node.children, value)
+    i = nibs[0]
+    new_child = _insert(node.children[i], nibs[1:], value)
+    ch = list(node.children)
+    ch[i] = new_child
+    return _Branch(tuple(ch), node.value)
+
+
+def _common_len(a, b) -> int:
+    n = 0
+    m = min(len(a), len(b))
+    while n < m and a[n] == b[n]:
+        n += 1
+    return n
+
+
+def _make_ext(path: tuple[int, ...], child):
+    """Extension constructor that collapses degenerate shapes."""
+    if not path:
+        return child
+    if isinstance(child, _Ext):
+        return _Ext(path + child.path, child.child)
+    if isinstance(child, _Leaf):
+        return _Leaf(path + child.path, child.value)
+    return _Ext(path, child)
+
+
+def _delete(node, nibs: tuple[int, ...]):
+    """Delete; returns the new node or None.  Missing keys are a no-op."""
+    if node is None:
+        return None
+    if isinstance(node, _Leaf):
+        return None if node.path == nibs else node
+    if isinstance(node, _Ext):
+        n = _common_len(node.path, nibs)
+        if n != len(node.path):
+            return node  # key not present
+        child = _delete(node.child, nibs[n:])
+        if child is node.child:
+            return node
+        if child is None:
+            return None
+        return _make_ext(node.path, child)
+    # branch
+    if not nibs:
+        if not node.value:
+            return node
+        new = _Branch(node.children, b"")
+    else:
+        i = nibs[0]
+        child = _delete(node.children[i], nibs[1:])
+        if child is node.children[i]:
+            return node
+        ch = list(node.children)
+        ch[i] = child
+        new = _Branch(tuple(ch), node.value)
+    # collapse if degenerate
+    live = [(i, c) for i, c in enumerate(new.children) if c is not None]
+    if new.value and not live:
+        return _Leaf((), new.value)
+    if not new.value and len(live) == 1:
+        i, c = live[0]
+        return _make_ext((i,), c)
+    if not new.value and not live:
+        return None
+    return new
+
+
+def _get(node, nibs: tuple[int, ...]):
+    while node is not None:
+        if isinstance(node, _Leaf):
+            return node.value if node.path == nibs else None
+        if isinstance(node, _Ext):
+            n = _common_len(node.path, nibs)
+            if n != len(node.path):
+                return None
+            node, nibs = node.child, nibs[n:]
+            continue
+        if not nibs:
+            return node.value or None
+        node, nibs = node.children[nibs[0]], nibs[1:]
+    return None
+
+
+class IncrementalTrie:
+    """Immutable MPT handle: ``update``/``delete`` return NEW handles that
+    share structure with the old one, so chain snapshots are cheap and a
+    block's root costs O(dirty keys x depth) rehashing (node encodings
+    memoize on the shared immutable nodes)."""
+
+    __slots__ = ("_root",)
+
+    def __init__(self, _root=None):
+        self._root = _root
+
+    @classmethod
+    def from_pairs(cls, pairs: dict[bytes, bytes]) -> "IncrementalTrie":
+        t = cls()
+        for k, v in pairs.items():
+            t = t.update(k, v)
+        return t
+
+    def update(self, key: bytes, value: bytes) -> "IncrementalTrie":
+        if not value:
+            return self.delete(key)
+        return IncrementalTrie(
+            _insert(self._root, tuple(_nibbles(key)), value))
+
+    def delete(self, key: bytes) -> "IncrementalTrie":
+        return IncrementalTrie(_delete(self._root, tuple(_nibbles(key))))
+
+    def get(self, key: bytes):
+        return _get(self._root, tuple(_nibbles(key)))
+
+    def root(self) -> bytes:
+        if self._root is None:
+            return EMPTY_ROOT
+        return keccak256(_encode_node(self._root))
+
+
+class SecureIncrementalTrie:
+    """Secure-keyed wrapper (keys pre-hashed, ref: trie/secure_trie.go)."""
+
+    __slots__ = ("_t",)
+
+    def __init__(self, _t: IncrementalTrie | None = None):
+        self._t = _t if _t is not None else IncrementalTrie()
+
+    def update(self, key: bytes, value: bytes) -> "SecureIncrementalTrie":
+        return SecureIncrementalTrie(self._t.update(keccak256(key), value))
+
+    def delete(self, key: bytes) -> "SecureIncrementalTrie":
+        return SecureIncrementalTrie(self._t.delete(keccak256(key)))
+
+    def get(self, key: bytes):
+        return self._t.get(keccak256(key))
+
+    def root(self) -> bytes:
+        return self._t.root()

@@ -35,6 +35,8 @@ class TxPool:
         # as (sender, txn) so selection never rescans the whole pool
         self.pending: dict[bytes, dict[int, Transaction]] = {}
         self._order: list[tuple[bytes, Transaction]] = []
+        self._by_hash: dict[bytes, tuple[bytes, int]] = {}  # hash -> (sender, nonce)
+        self._dead: set[bytes] = set()
         self._known: set[bytes] = set()
         self._queue: list[Transaction] = []
         self._timer = None
@@ -86,6 +88,8 @@ class TxPool:
                 if ok[k]:
                     senders[i] = bytes(addrs[k])
         elif rows:
+            from eges_tpu.crypto.verify_host import _count_host_rows
+            _count_host_rows(len(rows))
             for i, _ in rows:
                 try:
                     senders[i] = batch[i].sender()
@@ -106,7 +110,7 @@ class TxPool:
     def _admit(self, t: Transaction, sender: bytes) -> None:
         by_nonce = self.pending.setdefault(sender, {})
         old = by_nonce.get(t.nonce)
-        if old is None and len(self._order) >= self.max_pending:
+        if old is None and len(self._by_hash) >= self.max_pending:
             # capacity only limits NEW slots: a price-bump replacement
             # keeps the pool size constant and must stay possible even
             # when full (ref: core/tx_pool.go admits replacements)
@@ -119,14 +123,25 @@ class TxPool:
             if t.gas_price * 100 < old.gas_price * (100 + self.PRICE_BUMP_PCT):
                 self.stats["duplicate"] += 1
                 return
-            self._order = [(s, x) for s, x in self._order
-                           if x.hash != old.hash]
+            self._by_hash.pop(old.hash, None)
+            self._dead.add(old.hash)
             self.stats["replaced"] = self.stats.get("replaced", 0) + 1
         by_nonce[t.nonce] = t
         self._order.append((sender, t))
+        self._by_hash[t.hash] = (sender, t.nonce)
+        self._maybe_compact()
         self.stats["admitted"] += 1
         if self.on_admitted is not None:
             self.on_admitted(t, sender)
+
+    def _maybe_compact(self) -> None:
+        """Compact ``_order`` when mostly tombstones — reachable from
+        both eviction AND replacement-heavy ingest (a replacement storm
+        with no block inclusions must not grow memory unboundedly)."""
+        if len(self._dead) * 2 > max(len(self._order), 64):
+            self._order = [(s, t) for s, t in self._order
+                           if t.hash not in self._dead]
+            self._dead.clear()
 
     # -- drain ------------------------------------------------------------
 
@@ -181,19 +196,28 @@ class TxPool:
         return out[:limit] if limit else out
 
     def _evict(self, txns) -> None:
-        hashes = {t.hash for t in txns}
-        self._order = [(s, t) for s, t in self._order
-                       if t.hash not in hashes]
-        for sender in list(self.pending):
-            self.pending[sender] = {
-                n: t for n, t in self.pending[sender].items()
-                if t.hash not in hashes}
-            if not self.pending[sender]:
-                del self.pending[sender]
+        """O(evicted) eviction: the ``_by_hash`` index locates each txn's
+        (sender, nonce) slot directly, and ``_order`` compacts lazily via
+        a tombstone set only when mostly dead (round-2 verdict weak #8:
+        the old path rebuilt the whole order list per block)."""
+        for t in txns:
+            loc = self._by_hash.pop(t.hash, None)
+            if loc is None:
+                continue
+            sender, nonce = loc
+            by_nonce = self.pending.get(sender)
+            if by_nonce is not None:
+                cur = by_nonce.get(nonce)
+                if cur is not None and cur.hash == t.hash:
+                    del by_nonce[nonce]
+                    if not by_nonce:
+                        del self.pending[sender]
+            self._dead.add(t.hash)
+        self._maybe_compact()
 
     def remove_included(self, txns) -> None:
         """Drop txns included in a canonical block."""
         self._evict(txns)
 
     def __len__(self) -> int:
-        return len(self._order)
+        return len(self._by_hash)

@@ -113,32 +113,30 @@ class RpcServer:
         return st
 
     def _receipt_json(self, txn_hash: bytes):
-        """Linear scan over recent blocks' receipts (the reference keeps
-        a txn-hash index in LevelDB, core/database_util.go; recency scan
-        is adequate at Geec's operating point)."""
-        chain = self.chain
-        for n in range(chain.height(), max(0, chain.height() - 1024), -1):
-            blk = chain.get_block_by_number(n)
-            if blk is None:
-                continue
-            receipts = chain.receipts_of(blk.hash)
-            for i, t in enumerate(blk.transactions):
-                if t.hash == txn_hash and i < len(receipts):
-                    r = receipts[i]
-                    return {
-                        "transactionHash": "0x" + txn_hash.hex(),
-                        "blockNumber": _hex(n),
-                        "blockHash": "0x" + blk.hash.hex(),
-                        "transactionIndex": _hex(i),
-                        "status": _hex(r.status),
-                        "cumulativeGasUsed": _hex(r.cumulative_gas_used),
-                        "gasUsed": _hex(
-                            r.cumulative_gas_used
-                            - (receipts[i - 1].cumulative_gas_used
-                               if i else 0)),
-                        "logs": [],
-                    }
-        return None
+        """O(1) via the chain's txn-hash index (the LevelDB lookup-entry
+        role, ref: core/database_util.go GetTxLookupEntry)."""
+        hit = self.chain.lookup_txn(txn_hash)
+        if hit is None:
+            return None
+        blk, i, r = hit
+        if r is None:
+            return None
+        receipts = self.chain.receipts_of(blk.hash)
+        return {
+            "transactionHash": "0x" + txn_hash.hex(),
+            "blockNumber": _hex(blk.number),
+            "blockHash": "0x" + blk.hash.hex(),
+            "transactionIndex": _hex(i),
+            "status": _hex(r.status),
+            "cumulativeGasUsed": _hex(r.cumulative_gas_used),
+            "gasUsed": _hex(
+                r.cumulative_gas_used
+                - (receipts[i - 1].cumulative_gas_used if i else 0)),
+            "logs": [{"address": "0x" + a.hex(),
+                      "topics": ["0x" + t.hex() for t in ts],
+                      "data": "0x" + d.hex()}
+                     for (a, ts, d) in getattr(r, "logs", ())],
+        }
 
     def dispatch(self, method: str, params: list):
         if method == "eth_blockNumber":
@@ -184,7 +182,7 @@ class RpcServer:
             # (ref: consensus/geec/api.go Register)
             if self.node is None:
                 raise RpcError(-32000, "no consensus node")
-            self.node._start_registration(renew=0)
+            self.node.request_registration()
             return True
         if method == "thw_membership":
             if self.node is None:
@@ -214,10 +212,38 @@ class RpcServer:
             # metrics registry + --metrics flag, metrics/metrics.go:25)
             from eges_tpu.utils.metrics import DEFAULT as metrics
             out = metrics.snapshot()
+            # on-device verify share (BASELINE.md north star: > 95% of
+            # secp256k1 verifies on TPU): device rows vs host fallbacks
+            dev = out.get("verifier.rows", {})
+            dev = dev.get("count", 0) if isinstance(dev, dict) else dev
+            host = out.get("verifier.host_rows", 0)
+            total = dev + host
+            out["verifier.device_share"] = (
+                round(dev / total, 4) if total else None)
             if self.txpool is not None:
                 out["txpool"] = dict(self.txpool.stats,
                                      pending=len(self.txpool))
             return out
+        if method.startswith("debug_"):
+            return self._debug(method, params)
+        raise RpcError(-32601, f"method {method} not found")
+
+    def _debug(self, method: str, params: list):
+        """Runtime debug namespace (ref: internal/debug/api.go —
+        StartCPUProfile/StopCPUProfile/Stacks/MemStats roles)."""
+        from eges_tpu.utils.debug import DebugController
+
+        if not hasattr(self, "_debug_ctl"):
+            self._debug_ctl = DebugController()
+        if method == "debug_startProfile":
+            return self._debug_ctl.start_profile()
+        if method == "debug_stopProfile":
+            return self._debug_ctl.stop_profile(
+                int(params[0]) if params else 30)
+        if method == "debug_stacks":
+            return self._debug_ctl.stacks()
+        if method == "debug_stats":
+            return self._debug_ctl.stats()
         raise RpcError(-32601, f"method {method} not found")
 
     # -- JSON-RPC plumbing ------------------------------------------------

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 
 
 class Counter:
@@ -42,7 +42,7 @@ class Meter:
         self._clock = clock
         self.count = 0
         self._start = clock()
-        self._window: list[tuple[float, int]] = []
+        self._window: deque[tuple[float, int]] = deque()
 
     def mark(self, n: int = 1) -> None:
         self.count += n
@@ -50,7 +50,7 @@ class Meter:
         self._window.append((now, n))
         cutoff = now - 60.0
         while self._window and self._window[0][0] < cutoff:
-            self._window.pop(0)
+            self._window.popleft()
 
     @property
     def rate_mean(self) -> float:

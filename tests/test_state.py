@@ -206,7 +206,7 @@ def test_pool_gap_sender_does_not_starve_others():
     assert rich_spend.hash not in {t.hash for t in got}
     # stale (already-mined) nonces are evicted on selection
     state2 = StateDB.from_alloc({ADDR_A: ETH})
-    state2._accounts[ADDR_A] = Account(nonce=3, balance=ETH)
+    state2.set_account(ADDR_A, Account(nonce=3, balance=ETH))
     got = pool.pending_txns(8, state=state2)
     assert {t.nonce for t in got if t.hash in {x.hash for x in a_txns}} == {3, 4}
     assert 1 not in pool.pending.get(ADDR_A, {})

@@ -75,7 +75,7 @@ def test_account_proof_against_state_root():
     root = s.root()
     addr = bytes([3]) * 20
     pairs = {a: rlp.encode(acct.to_rlp())
-             for a, acct in s._accounts.items()}
+             for a, acct in s.iter_accounts()}
     proof = secure_trie_prove(pairs, addr)
     got = verify_secure_proof(root, addr, proof)
     assert got == rlp.encode(s.account(addr).to_rlp())
