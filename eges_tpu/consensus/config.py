@@ -48,11 +48,20 @@ class ChainGeecConfig:
     # (unsigned ValidateReply, core/geec_state.go:528-591): when True,
     # election votes / ACKs / query replies / confirms must carry valid
     # secp256k1 signatures, tallied through the device batch verifier.
-    # Consensus-critical: must agree across the chain.
-    signed_votes: bool = False
+    # Consensus-critical: must agree across the chain.  ON by default;
+    # set "signed_votes": false in genesis for reference-parity
+    # trustedHW-style deployments.
+    signed_votes: bool = True
 
     @classmethod
     def from_json(cls, obj: dict) -> "ChainGeecConfig":
+        if "bootstrap" in obj and "signed_votes" not in obj:
+            # consensus-critical default: a genesis that omits the key is
+            # ambiguous across build generations — pin it explicitly
+            import sys
+            print("WARNING: genesis thw section omits 'signed_votes'; "
+                  "defaulting to true — pin it explicitly so every node "
+                  "generation agrees", file=sys.stderr)
         return cls(
             bootstrap=tuple(BootstrapNode.from_json(n)
                             for n in obj.get("bootstrap", [])),
@@ -61,7 +70,7 @@ class ChainGeecConfig:
             validate_timeout_ms=float(obj.get("validate_timeout", 500)),
             election_timeout_ms=float(obj.get("election_timeout", 100)),
             backoff_time_ms=float(obj.get("backoff_time", 0)),
-            signed_votes=bool(obj.get("signed_votes", False)),
+            signed_votes=bool(obj.get("signed_votes", True)),
         )
 
     def to_json(self) -> dict:

@@ -14,6 +14,78 @@ from __future__ import annotations
 import numpy as np
 
 
+def _count_host_rows(n: int) -> None:
+    """Count host-fallback recoveries so ``thw_metrics`` can report the
+    on-device verify share (BASELINE.md north star: > 95% of verifies on
+    TPU; the device side counts ``verifier.rows``)."""
+    from eges_tpu.utils.metrics import DEFAULT as metrics
+
+    metrics.counter("verifier.host_rows").inc(n)
+
+
+class NativeBatchVerifier:
+    """Batch verifier with the :class:`~eges_tpu.crypto.verifier.
+    BatchVerifier` interface but NO JAX dependency: rows go through the
+    native C++ batch recover (``geec_ec_recover_batch`` — the cgo-batch
+    analogue) or, failing that, the pure-Python model.
+
+    For nodes that cannot attach an accelerator; marks the same
+    ``verifier.rows``/``verifier.batches`` metrics so the batched-path
+    share is measured identically (the *device* is the host here — real
+    TPU deployments construct :func:`~eges_tpu.crypto.verifier.
+    default_verifier` instead)."""
+
+    def recover_addresses(self, sigs, hashes):
+        import time
+
+        from eges_tpu.crypto import native
+        from eges_tpu.crypto.keccak import keccak256
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+
+        n = sigs.shape[0]
+        addrs = np.zeros((n, 20), np.uint8)
+        ok = np.zeros((n,), bool)
+        if n == 0:
+            return addrs, ok
+        t0 = time.monotonic()
+        if native.available():
+            pubs, okb = native.ec_recover_batch(
+                hashes.tobytes(), sigs.tobytes(), n)
+            for i in range(n):
+                if okb[i]:
+                    addrs[i] = np.frombuffer(
+                        keccak256(pubs[64 * i : 64 * i + 64])[12:], np.uint8)
+                    ok[i] = True
+        else:
+            from eges_tpu.crypto import secp256k1 as host
+
+            for i in range(n):
+                try:
+                    addrs[i] = np.frombuffer(
+                        host.recover_address(bytes(hashes[i]),
+                                             bytes(sigs[i])), np.uint8)
+                    ok[i] = True
+                except Exception:
+                    pass
+        metrics.timer("verifier.device").update(time.monotonic() - t0)
+        metrics.meter("verifier.rows").mark(n)
+        metrics.counter("verifier.batches").inc()
+        return addrs, ok
+
+    def ecrecover(self, sigs, hashes):
+        addrs, ok = self.recover_addresses(sigs, hashes)
+        return addrs, np.zeros((sigs.shape[0], 64), np.uint8), ok
+
+    def verify(self, sigs, hashes, pubs):
+        from eges_tpu.crypto import secp256k1 as host
+
+        addrs, ok = self.recover_addresses(sigs, hashes)
+        want = np.stack([
+            np.frombuffer(host.pubkey_to_address(bytes(p)), np.uint8)
+            for p in pubs]) if len(pubs) else addrs
+        return ok & (addrs == want).all(axis=1)
+
+
 def batch_verify_txns(txns, verifier) -> bool:
     """Verify the signed (non-Geec) transactions of a block as one device
     batch; the single shared implementation behind both the acceptor ACK
@@ -31,6 +103,7 @@ def batch_verify_txns(txns, verifier) -> bool:
     if any(p is None for p in parts):
         return False
     if verifier is None:
+        _count_host_rows(len(signed))
         try:
             for t in signed:
                 t.sender()
@@ -60,6 +133,7 @@ def recover_signers(entries, verifier) -> list:
     if verifier is None:
         from eges_tpu.crypto import secp256k1 as host
 
+        _count_host_rows(len(entries))
         for h, sig in entries:
             try:
                 out.append(host.recover_address(h, sig))

@@ -34,7 +34,7 @@ class SimCluster:
                  block_timeout_s: float = 20.0, validate_timeout_ms: float = 500,
                  backoff_time_ms: float = 0.0, reg_timeout_s: float = 10.0,
                  drop_rate: float = 0.0, failure_test: bool = False,
-                 verifier=None, mine=None, signed: bool = False,
+                 verifier=None, mine=None, signed: bool = True,
                  alloc: dict | None = None, txpool: bool = False):
         self.clock = SimClock()
         self.net = SimNet(self.clock, seed=seed, drop_rate=drop_rate)

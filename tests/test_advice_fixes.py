@@ -40,7 +40,10 @@ def mk_node(n_members=8, n_candidates=3, n_acceptors=4, mine=False):
     addrs = [bytes([i + 1]) * 20 for i in range(n_members)]
     boot = tuple(BootstrapNode(account=a, ip=f"10.0.0.{i+1}", port=8100 + i)
                  for i, a in enumerate(addrs))
-    ccfg = ChainGeecConfig(bootstrap=boot)
+    # unsigned parity mode: these tests exercise ordering/funnel logic
+    # with hand-built unsigned messages (signed mode would rightly drop
+    # them before the logic under test runs)
+    ccfg = ChainGeecConfig(bootstrap=boot, signed_votes=False)
     ncfg = NodeConfig(coinbase=addrs[0], consensus_ip="10.0.0.1",
                       consensus_port=8100, n_candidates=n_candidates,
                       n_acceptors=n_acceptors, txn_per_block=4,

@@ -54,6 +54,7 @@ def test_64_node_cluster_liveness():
     assert len({sn.chain.get_block_by_number(h).hash for sn in c.nodes}) == 1
 
 
+@pytest.mark.slow
 def test_64_node_signed_soak():
     """Soak at 64 validators with signed votes + native host crypto:
     the test-sep-2.sh criterion (chain keeps advancing) at config-2
@@ -63,6 +64,80 @@ def test_64_node_signed_soak():
     c.start()
     c.run(300, stop_condition=lambda: c.min_height() >= 12)
     assert c.min_height() >= 12, sorted(set(c.heights()))
+    h = c.min_height()
+    assert len({sn.chain.get_block_by_number(h).hash for sn in c.nodes}) == 1
+
+
+def test_window_semantics_at_1024():
+    """BASELINE config 4 membership scale: windows over 1024 members
+    stay exact, disjoint from non-members, and version-mobile."""
+    m = Membership(n_candidates=16, n_acceptors=64)
+    addrs = [i.to_bytes(2, "big") * 10 for i in range(1, 1025)]
+    for a in addrs:
+        m.add(Member(addr=a, ip="10.0.0.1", port=1, ttl=200))
+    assert len(m) == 1024
+    for seed in (0, 1023, 1024, 123456789, 1 << 52):
+        com = m.committee(seed)
+        acc = m.acceptors(seed)
+        assert len(com) == 16 and len({c.addr for c in com}) == 16
+        assert len(acc) == 64 and len({a.addr for a in acc}) == 64
+        for mem in com:
+            assert m.is_committee(mem.addr, seed)
+    # committee is a narrow slice of the membership
+    hits = sum(m.is_committee(a, 777) for a in addrs)
+    assert hits == 16
+    assert m.validate_threshold() == (64 + 1 + 1) // 2
+
+
+def test_mixed_batch_1024_validators_device_share():
+    """BASELINE config 3/4 shape: ONE mixed batch carrying the proposer
+    header signature, 1024 validator ACK votes and a block's txn
+    senders, routed through a batch verifier; the thw_metrics
+    device-share must exceed 95% (north star: >95% of verifies batched).
+
+    Uses the JAX-free NativeBatchVerifier so the fast suite measures the
+    ROUTING share without a device compile; the device execution itself
+    is covered by the (slow) BatchVerifier golden tests."""
+    import numpy as np
+
+    from eges_tpu.crypto import secp256k1 as secp
+    from eges_tpu.crypto.verify_host import (
+        NativeBatchVerifier, recover_signers,
+    )
+    from eges_tpu.utils.metrics import DEFAULT as metrics
+
+    rows0 = metrics.meter("verifier.rows").count
+    host0 = metrics.counter("verifier.host_rows").value
+
+    n_votes, n_txns = 1024, 1000
+    entries = []
+    expected = []
+    for i in range(1 + n_votes + n_txns):
+        priv = (i + 11).to_bytes(32, "big")
+        h = secp.pubkey_to_address(secp.privkey_to_pubkey(priv)) + b"\0" * 12
+        sig = secp.ecdsa_sign(h, priv)
+        entries.append((h, sig))
+        expected.append(secp.pubkey_to_address(secp.privkey_to_pubkey(priv)))
+    bv = NativeBatchVerifier()
+    got = recover_signers(entries, bv)
+    assert got == expected
+
+    dev_rows = metrics.meter("verifier.rows").count - rows0
+    host_rows = metrics.counter("verifier.host_rows").value - host0
+    assert dev_rows == len(entries)
+    share = dev_rows / (dev_rows + host_rows)
+    assert share > 0.95, f"batched verify share {share:.3f}"
+
+
+@pytest.mark.slow
+def test_256_node_cluster_liveness():
+    """BASELINE config 3 scale: 256 live validators, committee 16,
+    acceptors 64 — blocks confirm in lockstep."""
+    c = SimCluster(256, n_candidates=16, n_acceptors=64, txn_per_block=1,
+                   seed=11, signed=False)
+    c.start()
+    c.run(120, stop_condition=lambda: c.min_height() >= 3)
+    assert c.min_height() >= 3, sorted(set(c.heights()))
     h = c.min_height()
     assert len({sn.chain.get_block_by_number(h).hash for sn in c.nodes}) == 1
 

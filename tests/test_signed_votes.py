@@ -8,6 +8,8 @@ ref: core/geec_state.go:528-591); this is the build's upgrade over it.
 
 import dataclasses
 
+import pytest
+
 from eges_tpu.consensus import messages as M
 from eges_tpu.consensus.config import BootstrapNode, ChainGeecConfig, NodeConfig
 from eges_tpu.consensus.node import GeecNode, ELECTING, VALIDATING
@@ -190,6 +192,7 @@ def test_signed_cluster_liveness():
     assert len({sn.chain.get_block_by_number(h).hash for sn in c.nodes}) == 1
 
 
+@pytest.mark.slow
 def test_signed_cluster_with_device_verifier():
     """TPU-in-the-loop: the same signed cluster with a real BatchVerifier
     — every quorum tally's signature batch runs through the device path
