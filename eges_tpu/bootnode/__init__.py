@@ -1,0 +1,1 @@
+"""Standalone bootnode package (ref: cmd/bootnode/main.go)."""

@@ -66,6 +66,7 @@ class Membership:
         self.ttl_interval = ttl_interval
         self._members: dict[bytes, Member] = {}
         self._sorted_addrs: list[bytes] = []
+        self._flat: bytes | None = None  # packed sorted addrs (native path)
 
     # -- registry ---------------------------------------------------------
 
@@ -93,11 +94,13 @@ class Membership:
             return
         self._members[member.addr] = member
         bisect.insort(self._sorted_addrs, member.addr)
+        self._flat = None
 
     def remove(self, addr: bytes) -> None:
         if addr in self._members:
             del self._members[addr]
             self._sorted_addrs.remove(addr)
+            self._flat = None
 
     # -- windows ----------------------------------------------------------
 
@@ -119,12 +122,29 @@ class Membership:
         addrs = self._window(derive_seed(seed, version), self.n_candidates)
         return [self._members[a] for a in addrs]
 
+    def _window_check(self, addr: bytes, seed: int, n: int) -> bool:
+        """Membership-in-window check; native binary search when the
+        C++ election component is built (native/election.cpp — the
+        reference's own measured hot spot, its --breakdown logs
+        "ChecMembership Time", core/geec_state.go:1092)."""
+        from eges_tpu.crypto import native
+
+        size = len(self._sorted_addrs)
+        if size == 0:
+            return False
+        if native.has_election():
+            if self._flat is None:
+                self._flat = b"".join(self._sorted_addrs)
+            return native.window_check(self._flat, size, seed % size, n,
+                                       addr)
+        return addr in self._window(seed, n)
+
     def is_committee(self, addr: bytes, seed: int, version: int = 0) -> bool:
         """(ref: IsCommittee geec_state.go:770-861)"""
         if addr not in self._members:
             return False
-        return addr in self._window(derive_seed(seed, version),
-                                    self.n_candidates)
+        return self._window_check(addr, derive_seed(seed, version),
+                                  self.n_candidates)
 
     def acceptors(self, seed: int) -> list[Member]:
         addrs = self._window(seed, self.n_acceptors)
@@ -134,7 +154,7 @@ class Membership:
         """(ref: IsValidator geec_state.go:439-521)"""
         if addr not in self._members:
             return False
-        return addr in self._window(seed, self.n_acceptors)
+        return self._window_check(addr, seed, self.n_acceptors)
 
     def acceptor_count(self) -> int:
         """(ref: getAcceptorCount geec_state.go:421-428)"""

@@ -43,6 +43,10 @@ GOSSIP_CONFIRM_BLOCK = 0x15
 GOSSIP_GET_BLOCKS = 0x16  # backfill request (broadcast fallback of the
 #                           sync protocol; cf. the reference's downloader
 #                           body sync, eth/downloader/queue.go:65-67)
+GOSSIP_BLOCKS_REPLY = 0x18  # bulk backfill reply over TCP — block
+#   batches exceed a UDP datagram at the 1000-txn operating point, so
+#   sync replies ride the reliable plane (the reference ships blocks
+#   over devp2p TCP too, eth/handler.go:562-590 body exchange)
 GOSSIP_TXNS = 0x17  # transaction gossip (ref: TxMsg, eth/protocol.go:38 +
 #                     eth/handler.go:742-759 -> TxPool.AddRemotes)
 
@@ -303,6 +307,7 @@ _GOSSIP_BODY = {
     GOSSIP_REGISTER_REQ: Registration,
     GOSSIP_CONFIRM_BLOCK: ConfirmBlockMsg,
     GOSSIP_GET_BLOCKS: BlockFetchReq,
+    GOSSIP_BLOCKS_REPLY: BlocksReply,
     GOSSIP_TXNS: TxnsMsg,
 }
 

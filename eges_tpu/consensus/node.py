@@ -230,6 +230,8 @@ class GeecNode:
             self._handle_confirm(msg)
         elif code == M.GOSSIP_GET_BLOCKS:
             self._serve_block_fetch(msg)
+        elif code == M.GOSSIP_BLOCKS_REPLY:
+            self._handle_blocks_reply(msg)
         elif code == M.GOSSIP_TXNS:
             self._handle_txns(msg)
 
@@ -402,16 +404,25 @@ class GeecNode:
         txs = (tuple(self.txpool.pending_txns(
             self.cfg.txn_per_block, state=self.chain.head_state()))
                if self.txpool is not None else ())
+        # the header's time/difficulty are fixed BEFORE the preview so
+        # the dry-run executes with the exact BlockCtx validation will
+        # re-derive from the sealed header (TIMESTAMP/DIFFICULTY reads
+        # must see the same values, or the state root won't reproduce)
+        difficulty = 100
+        blk_time = max(int(self.clock.now()), parent.header.time + 1)
         if txs:
+            from eges_tpu.core.evm import BlockCtx
+            ctx = BlockCtx(coinbase=self.coinbase, number=blk_num,
+                           time=blk_time, difficulty=difficulty)
             txs, root, receipt_hash, gas_used = \
-                self.chain.execute_preview(txs, self.coinbase)
+                self.chain.execute_preview(txs, self.coinbase, ctx=ctx)
         else:
             from eges_tpu.core.trie import EMPTY_ROOT
             root, receipt_hash, gas_used = (parent.header.root, EMPTY_ROOT, 0)
         header = Header(
             parent_hash=parent.hash, number=blk_num,
-            coinbase=self.coinbase, difficulty=100,
-            time=max(int(self.clock.now()), parent.header.time + 1),
+            coinbase=self.coinbase, difficulty=difficulty,
+            time=blk_time,
             root=root, receipt_hash=receipt_hash, gas_used=gas_used,
             regs=regs,
             trust_rand=self.wb._rng.getrandbits(64),  # seed for NEXT block
@@ -988,10 +999,36 @@ class GeecNode:
             blocks.append(b)
         if not blocks:
             return
-        reply = M.BlocksReply(blocks=tuple(blocks))
-        self.transport.send_direct(
-            req.ip, req.port,
-            M.pack_direct(M.UDP_BLOCKS, self.coinbase, reply))
+        # UDP datagrams cap near 64 KB; a batch of blocks at the
+        # 1000-txn operating point is far larger (the in-process sim
+        # has no MTU, which hid this — a real-socket joiner stalled at
+        # height 0 while its peers' replies were silently dropped).
+        # Small chunks go direct; anything bigger rides the TCP gossip
+        # plane (receivers that are not syncing dedupe via chain.offer).
+        UDP_BUDGET = 40_000
+        chunk: list = []
+        size = 0
+        for b in blocks + [None]:
+            enc = len(b.encode()) if b is not None else 0
+            if chunk and (b is None or size + enc > UDP_BUDGET
+                          or len(chunk) >= 32):
+                reply = M.BlocksReply(blocks=tuple(chunk))
+                packed = M.pack_direct(M.UDP_BLOCKS, self.coinbase, reply)
+                if len(packed) <= UDP_BUDGET + 1024:
+                    self.transport.send_direct(req.ip, req.port, packed)
+                else:
+                    self.transport.gossip(
+                        M.pack_gossip(M.GOSSIP_BLOCKS_REPLY, reply))
+                chunk, size = [], 0
+            if b is not None:
+                if enc > UDP_BUDGET:
+                    # a single oversized block: TCP, alone
+                    self.transport.gossip(M.pack_gossip(
+                        M.GOSSIP_BLOCKS_REPLY,
+                        M.BlocksReply(blocks=(b,))))
+                else:
+                    chunk.append(b)
+                    size += enc
 
     def _filter_certified(self, blocks) -> list:
         """Drop backfilled blocks whose quorum confirm doesn't verify —
@@ -1154,6 +1191,11 @@ class GeecNode:
     # ------------------------------------------------------------------
     # registration (ref: Register geec_state.go:706-757)
     # ------------------------------------------------------------------
+
+    def request_registration(self) -> None:
+        """Public join-request trigger (the thw RPC namespace's Register,
+        ref: consensus/geec/api.go)."""
+        self._start_registration(renew=0)
 
     def _start_registration(self, renew: int) -> None:
         me = self.membership.get(self.coinbase)

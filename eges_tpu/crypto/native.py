@@ -44,6 +44,15 @@ def _load():
     lib.geec_ec_recover_batch.argtypes = [
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
         ctypes.c_char_p, ctypes.c_char_p]
+    try:  # election component (native/election.cpp); absent in old builds
+        lib.geec_window_check.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_char_p]
+        lib.geec_window_check.restype = ctypes.c_int
+        lib.geec_elect_winner.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.geec_elect_winner.restype = ctypes.c_int64
+    except AttributeError:
+        pass
     _LIB = lib
     return _LIB
 
@@ -99,6 +108,25 @@ def ec_recover_batch(hashes: bytes, sigs: bytes, n: int) -> tuple[bytes, bytes]:
     ok = ctypes.create_string_buffer(n)
     lib.geec_ec_recover_batch(hashes, sigs, n, pubs, ok)
     return pubs.raw, ok.raw
+
+
+def window_check(flat_sorted_addrs: bytes, size: int, start: int, n: int,
+                 addr: bytes) -> bool:
+    """Native committee/acceptor window membership (election.cpp)."""
+    lib = _load()
+    return bool(lib.geec_window_check(flat_sorted_addrs, size, start, n,
+                                      addr))
+
+
+def elect_winner(records: bytes, m: int) -> int:
+    """Winner index among ``m`` 28-byte (addr20 || rand8be) records."""
+    lib = _load()
+    return int(lib.geec_elect_winner(records, m))
+
+
+def has_election() -> bool:
+    lib = _load()
+    return lib is not None and hasattr(lib, "geec_window_check")
 
 
 def self_check() -> None:

@@ -1,0 +1,240 @@
+"""Peer discovery: bootnode registry + announce/lookup client.
+
+The permissioned-network replacement for the reference's Kademlia UDP
+discovery (ref: p2p/discover/udp.go, p2p/discover/table.go) and its
+``bootnode`` binary (ref: cmd/bootnode/main.go).  A full DHT is the
+wrong tool for a committee-scale permissioned chain, so this is
+Kademlia-lite: nodes ANNOUNCE themselves to one or more bootnodes
+(signed, TTL'd) and poll GET_PEERS for a sample of live endpoints,
+which feeds :meth:`GossipPlane.add_peer` — making ``--peers`` optional
+(a node joins knowing only a bootnode).
+
+Wire format (UDP, RLP):
+    [code, payload...] with
+    ANNOUNCE  = [0x01, pubkey64, gossip_ip, gossip_port,
+                 consensus_ip, consensus_port, expiry_be, sig65]
+                 sig over keccak(rlp([pubkey, gip, gport, cip, cport,
+                 expiry])) — identity = address(pubkey)
+    GET_PEERS = [0x02, nonce8]
+    PEERS     = [0x03, nonce8, [[addr20, gip, gport, cip, cport], ...]]
+
+Bootnodes verify announce signatures and expiry, evict stale entries,
+and never relay more than ``SAMPLE`` peers per query.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from eges_tpu.core import rlp
+from eges_tpu.crypto.keccak import keccak256
+
+ANNOUNCE = 1
+GET_PEERS = 2
+PEERS = 3
+
+ANNOUNCE_TTL_S = 60.0
+SAMPLE = 16
+
+
+def _sign_announce(priv: bytes, pub: bytes, gip: str, gport: int,
+                   cip: str, cport: int, expiry: int) -> bytes:
+    from eges_tpu.crypto import secp256k1 as secp
+
+    h = keccak256(rlp.encode([pub, gip.encode(), gport, cip.encode(),
+                              cport, expiry]))
+    return secp.ecdsa_sign(h, priv)
+
+
+def encode_announce(priv: bytes, pub: bytes, gip: str, gport: int,
+                    cip: str, cport: int,
+                    now: float | None = None) -> bytes:
+    expiry = int((now if now is not None else time.time()) + ANNOUNCE_TTL_S)
+    sig = _sign_announce(priv, pub, gip, gport, cip, cport, expiry)
+    return rlp.encode([ANNOUNCE, pub, gip.encode(), gport, cip.encode(),
+                       cport, expiry, sig])
+
+
+class BootnodeService:
+    """UDP peer registry (the cmd/bootnode role).
+
+    ``python -m eges_tpu.bootnode --port 30301`` runs one standalone.
+    """
+
+    def __init__(self, bind_ip: str, port: int, *,
+                 authorize=None, clock=time.time):
+        self.bind_ip = bind_ip
+        self.port = port
+        self.authorize = authorize  # callable(addr20) -> bool
+        self.clock = clock
+        # addr -> (gip, gport, cip, cport, expires_at)
+        self.registry: dict[bytes, tuple] = {}
+        self._transport = None
+
+    # -- message handling (transport-independent, sim-testable) ----------
+
+    def handle(self, data: bytes, reply) -> None:
+        """``reply(bytes)`` sends back to the datagram source."""
+        try:
+            item = rlp.decode(data)
+            code = rlp.decode_uint(item[0])
+        except Exception:
+            return
+        now = self.clock()
+        if code == ANNOUNCE:
+            self._on_announce(item, now)
+        elif code == GET_PEERS and len(item) >= 2:
+            import random
+
+            self._evict(now)
+            entries = list(self.registry.items())
+            if len(entries) > SAMPLE:
+                # a RANDOM sample, not the first insertion-ordered slice:
+                # otherwise members past the first SAMPLE are never
+                # advertised and late joiners only ever learn one subset
+                entries = random.sample(entries, SAMPLE)
+            peers = [[a, gip.encode(), gp, cip.encode(), cp]
+                     for a, (gip, gp, cip, cp, _) in entries]
+            reply(rlp.encode([PEERS, bytes(item[1]), peers]))
+
+    def _on_announce(self, item: list, now: float) -> None:
+        from eges_tpu.crypto import secp256k1 as secp
+
+        try:
+            _, pub, gip, gport, cip, cport, expiry, sig = item
+            pub, sig = bytes(pub), bytes(sig)
+            gip, cip = bytes(gip).decode(), bytes(cip).decode()
+            gport, cport = rlp.decode_uint(gport), rlp.decode_uint(cport)
+            expiry = rlp.decode_uint(expiry)
+        except Exception:
+            return
+        if expiry < now:
+            return  # stale/replayed announce
+        h = keccak256(rlp.encode([pub, gip.encode(), gport, cip.encode(),
+                                  cport, expiry]))
+        try:
+            signer = secp.recover_address(h, sig)
+        except Exception:
+            return
+        if signer != secp.pubkey_to_address(pub):
+            return
+        if self.authorize is not None and not self.authorize(signer):
+            return
+        self.registry[signer] = (gip, gport, cip, cport,
+                                 now + ANNOUNCE_TTL_S)
+
+    def _evict(self, now: float) -> None:
+        for a, rec in list(self.registry.items()):
+            if rec[4] < now:
+                del self.registry[a]
+
+    # -- asyncio UDP server ----------------------------------------------
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+
+        service = self
+
+        class _Proto(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                self.transport = transport
+
+            def datagram_received(self, data, addr):
+                try:
+                    service.handle(
+                        data, lambda out: self.transport.sendto(out, addr))
+                except Exception:
+                    pass
+
+        self._transport, _ = await loop.create_datagram_endpoint(
+            _Proto, local_addr=(self.bind_ip, self.port))
+
+    def close(self) -> None:
+        if self._transport is not None:
+            self._transport.close()
+
+
+class DiscoveryClient:
+    """Announce/lookup loop against one or more bootnodes.
+
+    Re-announces every ``interval_s`` (half the TTL), queries for peers,
+    and calls ``on_peer(addr20, gossip_endpoint, consensus_endpoint)``
+    for every newly-learned member — the NodeService wires this into
+    ``GossipPlane.add_peer``.
+    """
+
+    def __init__(self, bootnodes: list[tuple[str, int]], priv: bytes,
+                 gip: str, gport: int, cip: str, cport: int, *,
+                 on_peer=None, interval_s: float = ANNOUNCE_TTL_S / 2):
+        from eges_tpu.crypto import secp256k1 as secp
+
+        self.bootnodes = list(bootnodes)
+        self.priv = priv
+        self.pub = secp.privkey_to_pubkey(priv)
+        self.me = secp.pubkey_to_address(self.pub)
+        self.endpoint = (gip, gport, cip, cport)
+        self.on_peer = on_peer
+        self.interval_s = interval_s
+        self.known: dict[bytes, tuple] = {}
+        self._transport = None
+        self._task = None
+
+    def _on_datagram(self, data: bytes) -> None:
+        try:
+            item = rlp.decode(data)
+            if rlp.decode_uint(item[0]) != PEERS:
+                return
+            peers = item[2]
+        except Exception:
+            return
+        for p in peers:
+            try:
+                addr = bytes(p[0])
+                gip, gport = bytes(p[1]).decode(), rlp.decode_uint(p[2])
+                cip, cport = bytes(p[3]).decode(), rlp.decode_uint(p[4])
+            except Exception:
+                continue
+            if addr == self.me or addr in self.known:
+                continue
+            self.known[addr] = (gip, gport, cip, cport)
+            if self.on_peer is not None:
+                self.on_peer(addr, (gip, gport), (cip, cport))
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: _ClientProto(self._on_datagram), local_addr=("0.0.0.0", 0))
+        self._task = asyncio.create_task(self._loop())
+
+    async def _loop(self) -> None:
+        import secrets as _secrets
+
+        while True:
+            gip, gport, cip, cport = self.endpoint
+            ann = encode_announce(self.priv, self.pub, gip, gport, cip, cport)
+            query = rlp.encode([GET_PEERS, _secrets.token_bytes(8)])
+            for bn in self.bootnodes:
+                try:
+                    self._transport.sendto(ann, bn)
+                    self._transport.sendto(query, bn)
+                except Exception:
+                    pass
+            await asyncio.sleep(self.interval_s)
+
+    def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        if self._transport is not None:
+            self._transport.close()
+
+
+class _ClientProto(asyncio.DatagramProtocol):
+    def __init__(self, on_datagram):
+        self._on = on_datagram
+
+    def datagram_received(self, data, addr):
+        try:
+            self._on(data)
+        except Exception:
+            pass

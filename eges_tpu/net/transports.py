@@ -83,36 +83,99 @@ class AuthError(Exception):
 class _FrameAuth:
     """Per-connection frame authentication (the RLPx-parity layer).
 
-    Handshake: each side sends ``MAGIC || nonce16``; both derive
-    per-direction session keys ``keccak(secret || sender_nonce ||
-    receiver_nonce)``.  Every frame then carries
-    ``keccak(key || seq_be8 || payload)[:16]`` with a per-direction
-    monotonically increasing sequence — a wrong network secret, a
-    tampered payload, or a replayed/reordered frame all fail the check.
-    (A keccak prefix-MAC is sound: sponge constructions are not subject
-    to the length-extension attacks that force HMAC on SHA-2.)"""
+    Two handshake generations:
+
+    * **v2 (ECDH, default when a node key is present)** — each side
+      sends ``MAGIC2 || pubkey64 || nonce16 || sig65`` where ``sig``
+      signs ``keccak(MAGIC2 || pubkey || nonce)`` with the node key.
+      Session keys derive from the ECDH shared secret (keccak of the
+      shared x-coordinate) mixed with both nonces, so every connection
+      has fresh keys no other member can compute — closing the round-2
+      hole where any member holding the one symmetric network secret
+      could impersonate the plane to any other.  The peer's recovered
+      address is exposed as :attr:`peer_addr` for membership gating.
+    * **v1 (symmetric)** — ``MAGIC || nonce16`` with keys
+      ``keccak(secret || nonces)``; kept for keyless tooling.
+
+    Every frame then carries ``keccak(key || seq_be8 || payload)[:16]``
+    with a per-direction monotonic sequence — tampered, replayed or
+    reordered frames fail.  (A keccak prefix-MAC is sound: sponges have
+    no length-extension weakness.)"""
 
     MAGIC = b"geec-gossip-v1\x00\x00"
+    MAGIC2 = b"geec-gossip-v2\x00\x00"
 
-    def __init__(self, secret: bytes):
+    def __init__(self, secret: bytes, keypair: tuple[bytes, bytes] | None = None):
         import secrets as _secrets
 
         self.secret = secret
+        self.keypair = keypair  # (priv32, pub64) -> v2 handshake
         self.my_nonce = _secrets.token_bytes(16)
         self.send_key = b""
         self.recv_key = b""
         self.send_seq = 0
         self.recv_seq = 0
+        self.peer_addr: bytes | None = None  # v2: authenticated identity
 
     def hello(self) -> bytes:
-        return self.MAGIC + self.my_nonce
-
-    def on_hello(self, data: bytes) -> None:
+        if self.keypair is None:
+            return self.MAGIC + self.my_nonce
+        from eges_tpu.crypto import secp256k1 as secp
         from eges_tpu.crypto.keccak import keccak256
 
-        if len(data) != len(self.MAGIC) + 16 or not data.startswith(self.MAGIC):
+        priv, pub = self.keypair
+        body = self.MAGIC2 + pub + self.my_nonce
+        sig = secp.ecdsa_sign(keccak256(body), priv)
+        return body + sig
+
+    def on_hello(self, data: bytes) -> None:
+        """Derive session keys from the peer's hello.
+
+        Version negotiation: the connection runs v2 only when BOTH
+        hellos are v2 (each side knows what it sent and what it
+        received).  A keyed endpoint receiving a v1 hello falls back to
+        v1 symmetric keys, and a keyless endpoint can parse a v2 hello's
+        nonce and derive the same v1 keys — so mixed generations and
+        keyless tooling interop instead of mutually AuthError-ing.  A
+        downgrade by an outsider is not possible: v1 still requires the
+        network secret."""
+        from eges_tpu.crypto.keccak import keccak256
+
+        m2 = len(self.MAGIC2)
+        if data.startswith(self.MAGIC2) and len(data) == m2 + 64 + 16 + 65:
+            peer_pub = data[m2 : m2 + 64]
+            peer_nonce = data[m2 + 64 : m2 + 80]
+            if self.keypair is not None:
+                from eges_tpu.crypto import secp256k1 as secp
+
+                sig = data[m2 + 80 :]
+                body = data[: m2 + 80]
+                try:
+                    signer = secp.recover_address(keccak256(body), sig)
+                except Exception:
+                    raise AuthError("bad hello signature")
+                if signer != secp.pubkey_to_address(peer_pub):
+                    raise AuthError("hello signature/pubkey mismatch")
+                self.peer_addr = signer
+                try:
+                    shared = secp.ecdh_shared(self.keypair[0], peer_pub)
+                except ValueError:
+                    raise AuthError("bad peer pubkey")
+                # mix the network secret in as a domain separator
+                self.send_key = keccak256(shared + self.secret
+                                          + self.my_nonce + peer_nonce)
+                self.recv_key = keccak256(shared + self.secret
+                                          + peer_nonce + self.my_nonce)
+                return
+            # keyless side of a mixed pair: v1 keys from the v2 nonce
+            # (the keyed peer sees our v1 hello and derives the same)
+        elif data.startswith(self.MAGIC) and len(data) == len(self.MAGIC) + 16:
+            peer_nonce = data[len(self.MAGIC):]
+            if self.keypair is not None:
+                # keyed side of a mixed pair: fall back to v1
+                self.keypair = None
+        else:
             raise AuthError("bad hello")
-        peer_nonce = data[len(self.MAGIC):]
         self.send_key = keccak256(self.secret + self.my_nonce + peer_nonce)
         self.recv_key = keccak256(self.secret + peer_nonce + self.my_nonce)
 
@@ -153,12 +216,16 @@ class GossipPlane:
     MAX_FRAME = 64 * 1024 * 1024
 
     def __init__(self, bind_ip: str, bind_port: int, peers: list[tuple[str, int]],
-                 on_gossip, secret: bytes | None = None):
+                 on_gossip, secret: bytes | None = None,
+                 keypair: tuple[bytes, bytes] | None = None,
+                 authorize=None):
         self.bind_ip = bind_ip
         self.bind_port = bind_port
         self.peers = [p for p in peers if p != (bind_ip, bind_port)]
         self._on_gossip = on_gossip
         self.secret = secret
+        self.keypair = keypair if secret is not None else None
+        self.authorize = authorize  # callable(addr20) -> bool, v2 only
         self._server: asyncio.AbstractServer | None = None
         self._writers: dict[tuple[str, int], tuple] = {}  # peer -> (writer, auth)
         self._tasks: list[asyncio.Task] = []
@@ -170,6 +237,16 @@ class GossipPlane:
             self._handle_conn, self.bind_ip, self.bind_port)
         for peer in self.peers:
             self._tasks.append(asyncio.create_task(self._dial_loop(peer)))
+
+    def add_peer(self, peer: tuple[str, int]) -> None:
+        """Dial a newly-discovered peer (the discovery plane feeds this);
+        no-op for self or already-known peers."""
+        if self._closed or peer == (self.bind_ip, self.bind_port):
+            return
+        if peer in self.peers:
+            return
+        self.peers.append(peer)
+        self._tasks.append(asyncio.create_task(self._dial_loop(peer)))
 
     @staticmethod
     async def _read_frame(reader) -> bytes:
@@ -187,11 +264,14 @@ class GossipPlane:
         """Returns a ready _FrameAuth, or None in plaintext mode."""
         if self.secret is None:
             return None
-        auth = _FrameAuth(self.secret)
+        auth = _FrameAuth(self.secret, keypair=self.keypair)
         writer.write(self._frame(auth.hello()))
         await writer.drain()
         auth.on_hello(await asyncio.wait_for(self._read_frame(reader),
                                              timeout=5.0))
+        if (auth.peer_addr is not None and self.authorize is not None
+                and not self.authorize(auth.peer_addr)):
+            raise AuthError("peer not authorized")
         return auth
 
     async def _handle_conn(self, reader: asyncio.StreamReader,

@@ -50,8 +50,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gossipIP", default="127.0.0.1")
     p.add_argument("--gossipPort", type=int, default=6190)
     p.add_argument("--peers", default="", help="ip:port,ip:port gossip peers")
-    p.add_argument("--tpuVerify", action="store_true",
-                   help="batch-verify signatures on the JAX device")
+    p.add_argument("--bootnodes", default="",
+                   help="ip:port,... discovery bootnodes (makes --peers "
+                        "optional)")
+    p.add_argument("--tpuVerify", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="batch-verify signatures on the JAX device "
+                        "(--no-tpuVerify to run host-only)")
+    p.add_argument("--verifier", default="", choices=["", "jax", "native",
+                                                      "none"],
+                   help="verifier backend override: jax device batches "
+                        "(default), native C++ batches, or none")
     p.add_argument("--rpcPort", type=int, default=0,
                    help="JSON-RPC HTTP port (0 = disabled)")
     p.add_argument("--netSecret", default="",
@@ -77,7 +86,9 @@ def main(argv=None) -> None:
         peers=parse_peers(args.peers), node=node_cfg, mine=args.mine,
         verbosity=args.verbosity, use_tpu_verifier=args.tpuVerify,
         rpc_port=args.rpcPort, net_secret_hex=args.netSecret,
-        plaintext_gossip=args.plaintextGossip)
+        plaintext_gossip=args.plaintextGossip,
+        bootnodes=parse_peers(args.bootnodes),
+        verifier_mode=args.verifier)
 
     loop = asyncio.new_event_loop()
     asyncio.set_event_loop(loop)

@@ -35,11 +35,18 @@ class ServiceConfig:
     node: NodeConfig = None            # Geec knobs (coinbase filled in)
     mine: bool = True
     verbosity: int = 3
-    use_tpu_verifier: bool = False     # device batch verify on acceptors
+    use_tpu_verifier: bool = True      # device batch verify on acceptors
+    verifier_mode: str = ""            # "" -> "jax" if use_tpu_verifier
+    #                                    else "none"; "native" = C++ batch
+    #                                    verifier (no JAX import — for
+    #                                    hosts without an accelerator)
     rpc_port: int = 0                  # 0 = RPC disabled
     net_secret_hex: str = ""           # gossip-plane auth secret; ""
     #                                    derives one from the genesis hash
     plaintext_gossip: bool = False     # disable the auth layer entirely
+    bootnodes: tuple[tuple[str, int], ...] = ()  # discovery; makes
+    #                                    --peers optional (ref:
+    #                                    p2p/discover + cmd/bootnode)
 
 
 def load_genesis_config(path: str) -> tuple[ChainGeecConfig, dict]:
@@ -69,10 +76,29 @@ class NodeService:
             if isinstance(genesis_doc.get("timestamp"), str)
             else int(genesis_doc.get("timestamp", 0)))
 
+        mode = cfg.verifier_mode or ("jax" if cfg.use_tpu_verifier
+                                     else "none")
         verifier = None
-        if cfg.use_tpu_verifier:
+        if mode == "jax":
+            # share compiled verifier graphs across node processes and
+            # restarts (the recover graph is the expensive compile)
+            import jax
+            try:
+                jax.config.update(
+                    "jax_compilation_cache_dir",
+                    os.path.join(os.path.dirname(os.path.dirname(
+                        os.path.dirname(os.path.abspath(__file__)))),
+                        ".jax_cache"))
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 2.0)
+            except Exception:
+                pass
             from eges_tpu.crypto.verifier import default_verifier
             verifier = default_verifier()
+        elif mode == "native":
+            from eges_tpu.crypto.verify_host import NativeBatchVerifier
+            verifier = NativeBatchVerifier()
+        self._verifier_mode = mode
 
         os.makedirs(cfg.datadir, exist_ok=True)
         store = FileStore(os.path.join(cfg.datadir, "chaindata"))
@@ -101,10 +127,22 @@ class NodeService:
         else:
             from eges_tpu.crypto.keccak import keccak256
             secret = keccak256(b"geec/net-secret" + genesis.hash)
+        # ECDH per-connection keys (v2 handshake) whenever auth is on:
+        # session keys no other member can compute, identity = node key
         self.gossip = GossipPlane(cfg.gossip_ip, cfg.gossip_port,
                                   list(cfg.peers), self.node.on_gossip,
-                                  secret=secret)
+                                  secret=secret,
+                                  keypair=(priv, secp.privkey_to_pubkey(priv)))
         self.node.transport = SocketTransport(self.gossip, self.direct)
+
+        self.discovery = None
+        if cfg.bootnodes:
+            from eges_tpu.net.discovery import DiscoveryClient
+            self.discovery = DiscoveryClient(
+                list(cfg.bootnodes), priv,
+                cfg.gossip_ip, cfg.gossip_port,
+                ncfg.consensus_ip, ncfg.consensus_port,
+                on_peer=lambda addr, gep, cep: self.gossip.add_peer(gep))
 
         self.txn_service = None
         if ncfg.geec_txn_port:
@@ -131,8 +169,26 @@ class NodeService:
             self.log.geec(kind, **kw)
 
     async def start(self) -> None:
+        from eges_tpu.utils.debug import install_sigusr1
+        install_sigusr1()  # kill -USR1 dumps stacks (pprof-dump parity)
+        if self._verifier_mode == "jax" and self.chain.verifier is not None:
+            # warm the smallest verify graph NOW: the first jit compile
+            # can take minutes on a small host, and letting it happen
+            # lazily inside a consensus message handler wedges the event
+            # loop mid-election (diagnosed via the SIGUSR1 dump); the
+            # persistent cache makes later runs instant
+            import time as _t
+
+            import numpy as _np
+            t0 = _t.monotonic()
+            self.chain.verifier.ecrecover(_np.zeros((1, 65), _np.uint8),
+                                          _np.zeros((1, 32), _np.uint8))
+            self.log.geec("verifier warmup",
+                          dt=round(_t.monotonic() - t0, 1))
         await self.direct.start()
         await self.gossip.start()
+        if self.discovery is not None:
+            await self.discovery.start()
         if self.txn_service is not None:
             await self.txn_service.start()
         if self.rpc is not None:
@@ -176,6 +232,8 @@ class NodeService:
     def close(self) -> None:
         if self._height_task is not None:
             self._height_task.cancel()
+        if self.discovery is not None:
+            self.discovery.close()
         if self.rpc is not None:
             self.rpc.close()
         self.node.stop()

@@ -33,10 +33,85 @@ from eges_tpu.crypto import secp256k1 as secp  # noqa: E402
 GOSSIP_BASE = 6190   # ref test.py port scheme
 CONSENSUS_BASE = 8100
 TXN_BASE = 10000
+RPC_BASE = 9100
 
 
 def node_key(i: int) -> bytes:
     return bytes([i + 1]) * 32
+
+
+class Runner:
+    """Process runner abstraction: localhost or ssh fan-out
+    (ref: start.py:103-106 — ssh per cluster host)."""
+
+    def __init__(self, host: str | None = None, ssh_opts: tuple = ()):
+        self.host = host  # None/"" = local
+        self.ssh_opts = tuple(ssh_opts)
+
+    @property
+    def remote(self) -> bool:
+        return bool(self.host) and self.host not in ("localhost", "local")
+
+    def ip(self, default: str = "127.0.0.1") -> str:
+        return self.host if self.remote else default
+
+    def spawn(self, cmd: list[str], log_path: str, env: dict) -> int:
+        if not self.remote:
+            with open(log_path, "wb") as logf:
+                proc = subprocess.Popen(cmd, stdout=logf,
+                                        stderr=subprocess.STDOUT,
+                                        env=env, cwd=REPO)
+            return proc.pid
+        # ssh fan-out: run detached on the host, pid echoed back
+        envs = " ".join(f"{k}={v}" for k, v in env.items()
+                        if k in ("PYTHONPATH", "JAX_PLATFORMS"))
+        quoted = " ".join(f"'{c}'" for c in cmd)
+        shell = (f"cd {REPO} && nohup env {envs} {quoted} "
+                 f"> {log_path} 2>&1 & echo $!")
+        out = subprocess.check_output(
+            ["ssh", *self.ssh_opts, self.host, shell], text=True)
+        return int(out.strip().splitlines()[-1])
+
+    def push(self, path: str) -> None:
+        """scp a file to the same path on the host (ref: start.py scp)."""
+        if self.remote:
+            subprocess.check_call(
+                ["ssh", *self.ssh_opts, self.host,
+                 f"mkdir -p {os.path.dirname(path)}"])
+            subprocess.check_call(
+                ["scp", *self.ssh_opts, path, f"{self.host}:{path}"])
+
+    def kill(self, pid: int) -> None:
+        if not self.remote:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        else:
+            subprocess.call(["ssh", *self.ssh_opts, self.host,
+                             f"kill {pid} 2>/dev/null || true"])
+
+    def read_log(self, path: str) -> bytes:
+        if not self.remote:
+            try:
+                with open(path, "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                return b""
+        try:
+            return subprocess.check_output(
+                ["ssh", *self.ssh_opts, self.host, f"cat {path}"],
+                stderr=subprocess.DEVNULL)
+        except subprocess.CalledProcessError:
+            return b""
+
+
+def parse_hosts(spec: str, n: int) -> list[Runner]:
+    """``host1,host2`` round-robined over n nodes; empty = all local."""
+    hosts = [h.strip() for h in spec.split(",") if h.strip()] if spec else []
+    if not hosts:
+        return [Runner() for _ in range(n)]
+    return [Runner(hosts[i % len(hosts)]) for i in range(n)]
 
 
 def write_genesis(path: str, n: int, *, validate_timeout_ms=500,
@@ -57,6 +132,9 @@ def write_genesis(path: str, n: int, *, validate_timeout_ms=500,
                 "validate_timeout": validate_timeout_ms,
                 "election_timeout": election_timeout_ms,
                 "backoff_time": backoff_ms,
+                # consensus-critical: pinned explicitly so every build
+                # generation parses this genesis identically
+                "signed_votes": True,
             },
         },
         "timestamp": "0x0",
@@ -66,53 +144,191 @@ def write_genesis(path: str, n: int, *, validate_timeout_ms=500,
         json.dump(doc, f, indent=2)
 
 
+def _node_cmd(i: int, n: int, dirpath: str, genesis: str, runners,
+              *, txn_per_block, txn_size, block_timeout, mine,
+              bootnodes: str = "", extra_args=()) -> list[str]:
+    datadir = os.path.join(dirpath, f"node{i}")
+    cmd = [
+        sys.executable, "-m", "eges_tpu.node",
+        "--datadir", datadir, "--genesis", genesis,
+        "--keyhex", node_key(i).hex(),
+        "--consensusIP", runners[i].ip(),
+        "--consensusPort", str(CONSENSUS_BASE + i),
+        "--gossipIP", runners[i].ip() if runners[i].remote else "127.0.0.1",
+        "--gossipPort", str(GOSSIP_BASE + i),
+        "--geecTxnPort", str(TXN_BASE + i),
+        "--rpcPort", str(RPC_BASE + i),
+        "--txnPerBlock", str(txn_per_block),
+        "--txnSize", str(txn_size),
+        "--blockTimeout", str(block_timeout),
+        "--totalNodes", str(n),
+        "--breakdown",
+        # C++ batch verifier by default: a many-node localhost rig gets
+        # batched signature verification without N JAX imports + graph
+        # compiles serializing on a small host's cores; real TPU hosts
+        # pass extra_args=["--verifier", "jax"] (the service default)
+        "--verifier", "native",
+    ]
+    if bootnodes:
+        cmd += ["--bootnodes", bootnodes]
+    else:
+        peers = ",".join(f"{runners[j].ip()}:{GOSSIP_BASE + j}"
+                         for j in range(n))
+        cmd += ["--peers", peers]
+    return cmd + (["--mine"] if mine else []) + list(extra_args)
+
+
+def _node_env(ambient_jax: bool) -> dict:
+    env = dict(os.environ, PYTHONPATH=REPO)
+    if not ambient_jax:
+        # N node processes sharing one TPU tunnel would thrash; the
+        # batch verifier runs on the local CPU backend by default
+        # (same graphs, same code path — pass ambient_jax=True on a
+        # host with a dedicated chip per node)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def _save_meta(dirpath: str, meta: dict) -> None:
+    with open(os.path.join(dirpath, "cluster.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+
+
+def load_meta(dirpath: str) -> dict | None:
+    p = os.path.join(dirpath, "cluster.json")
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return json.load(f)
+
+
 def start_cluster(dirpath: str, n: int, *, txn_per_block=100, txn_size=100,
-                  block_timeout=20.0, mine=True, extra_args=()) -> list[int]:
+                  block_timeout=20.0, mine=True, extra_args=(),
+                  ambient_jax=False, hosts: str = "",
+                  use_bootnode: bool = False, skip: set | None = None) -> list[int]:
+    """Launch an n-node cluster — localhost or ssh fan-out over
+    ``hosts`` (ref: start.py; test.py for the localhost triple-port
+    scheme).  ``skip`` holds node indices to NOT start (sync tests)."""
     os.makedirs(dirpath, exist_ok=True)
+    runners = parse_hosts(hosts, n)
     genesis = os.path.join(dirpath, "genesis.json")
     write_genesis(genesis, n)
-    peers = ",".join(f"127.0.0.1:{GOSSIP_BASE + i}" for i in range(n))
-    pids = []
+    for r in {id(r): r for r in runners}.values():
+        r.push(genesis)
+
+    bootnodes = ""
+    pids: list[int | None] = []
+    boot_pid = None
+    if use_bootnode:
+        # discovery instead of a static peer list: nodes join knowing
+        # only the bootnode (ref: cmd/bootnode + p2p/discover role)
+        bootnodes = f"{runners[0].ip()}:30301"
+        boot_cmd = [sys.executable, "-m", "eges_tpu.bootnode",
+                    "--addr", "0.0.0.0" if runners[0].remote else "127.0.0.1",
+                    "--port", "30301"]
+        boot_pid = runners[0].spawn(boot_cmd,
+                                    os.path.join(dirpath, "bootnode.log"),
+                                    _node_env(ambient_jax))
+        time.sleep(0.5)
+
     for i in range(n):
-        datadir = os.path.join(dirpath, f"node{i}")
-        log_path = os.path.join(dirpath, f"node{i}.log")
-        cmd = [
-            sys.executable, "-m", "eges_tpu.node",
-            "--datadir", datadir, "--genesis", genesis,
-            "--keyhex", node_key(i).hex(),
-            "--consensusIP", "127.0.0.1",
-            "--consensusPort", str(CONSENSUS_BASE + i),
-            "--gossipPort", str(GOSSIP_BASE + i),
-            "--geecTxnPort", str(TXN_BASE + i),
-            "--peers", peers,
-            "--txnPerBlock", str(txn_per_block),
-            "--txnSize", str(txn_size),
-            "--blockTimeout", str(block_timeout),
-            "--totalNodes", str(n),
-            "--breakdown",
-        ] + (["--mine"] if mine else []) + list(extra_args)
-        env = dict(os.environ, PYTHONPATH=REPO)
-        with open(log_path, "wb") as logf:
-            proc = subprocess.Popen(cmd, stdout=logf, stderr=subprocess.STDOUT,
-                                    env=env, cwd=REPO)
-        pids.append(proc.pid)
-    with open(os.path.join(dirpath, "pids"), "w") as f:
-        f.write("\n".join(map(str, pids)))
-    return pids
+        if skip and i in skip:
+            pids.append(None)
+            continue
+        cmd = _node_cmd(i, n, dirpath, genesis, runners,
+                        txn_per_block=txn_per_block, txn_size=txn_size,
+                        block_timeout=block_timeout, mine=mine,
+                        bootnodes=bootnodes, extra_args=extra_args)
+        pids.append(runners[i].spawn(
+            cmd, os.path.join(dirpath, f"node{i}.log"),
+            _node_env(ambient_jax)))
+    _save_meta(dirpath, {
+        "n": n, "hosts": hosts, "pids": pids, "boot_pid": boot_pid,
+        "txn_per_block": txn_per_block, "txn_size": txn_size,
+        "block_timeout": block_timeout, "mine": mine,
+        "use_bootnode": use_bootnode, "ambient_jax": ambient_jax,
+    })
+    return [p for p in pids if p is not None]
+
+
+def start_node(dirpath: str, i: int, *, mine=True) -> int:
+    """Start one (previously skipped or killed) node of a saved cluster
+    — the join leg of the sync scenario (ref: test-sync.py)."""
+    meta = load_meta(dirpath)
+    assert meta is not None, "no cluster.json; start the cluster first"
+    runners = parse_hosts(meta["hosts"], meta["n"])
+    genesis = os.path.join(dirpath, "genesis.json")
+    cmd = _node_cmd(i, meta["n"], dirpath, genesis, runners,
+                    txn_per_block=meta["txn_per_block"],
+                    txn_size=meta["txn_size"],
+                    block_timeout=meta["block_timeout"], mine=mine,
+                    bootnodes=(f"{runners[0].ip()}:30301"
+                               if meta.get("use_bootnode") else ""))
+    pid = runners[i].spawn(cmd, os.path.join(dirpath, f"node{i}.log"),
+                           _node_env(meta.get("ambient_jax", False)))
+    meta["pids"][i] = pid
+    _save_meta(dirpath, meta)
+    return pid
 
 
 def kill_cluster(dirpath: str) -> None:
     """(ref: kill.py)"""
+    meta = load_meta(dirpath)
+    if meta is not None:
+        runners = parse_hosts(meta["hosts"], meta["n"])
+        for i, pid in enumerate(meta["pids"]):
+            if pid is not None:
+                runners[i].kill(pid)
+        if meta.get("boot_pid"):
+            runners[0].kill(meta["boot_pid"])
+        meta["pids"] = [None] * meta["n"]
+        meta["boot_pid"] = None
+        _save_meta(dirpath, meta)
+    # legacy pid file support
     pid_file = os.path.join(dirpath, "pids")
-    if not os.path.exists(pid_file):
-        return
-    with open(pid_file) as f:
-        for line in f:
-            try:
-                os.kill(int(line.strip()), signal.SIGTERM)
-            except (ProcessLookupError, ValueError):
-                pass
-    os.remove(pid_file)
+    if os.path.exists(pid_file):
+        with open(pid_file) as f:
+            for line in f:
+                try:
+                    os.kill(int(line.strip()), signal.SIGTERM)
+                except (ProcessLookupError, ValueError):
+                    pass
+        os.remove(pid_file)
+
+
+def restart_cluster(dirpath: str) -> list[int]:
+    """Relaunch a stopped cluster PRESERVING datadirs and keys — chains
+    resume from their FileStores (ref: re-start.py: restart without
+    wiping keystores/genesis)."""
+    meta = load_meta(dirpath)
+    assert meta is not None, "no cluster.json to restart from"
+    kill_cluster(dirpath)
+    time.sleep(0.5)
+    meta = load_meta(dirpath)
+    runners = parse_hosts(meta["hosts"], meta["n"])
+    genesis = os.path.join(dirpath, "genesis.json")
+    if meta.get("use_bootnode"):
+        boot_cmd = [sys.executable, "-m", "eges_tpu.bootnode",
+                    "--addr", "127.0.0.1", "--port", "30301"]
+        meta["boot_pid"] = runners[0].spawn(
+            boot_cmd, os.path.join(dirpath, "bootnode.log"),
+            _node_env(meta.get("ambient_jax", False)))
+    pids = []
+    for i in range(meta["n"]):
+        cmd = _node_cmd(i, meta["n"], dirpath, genesis, runners,
+                        txn_per_block=meta["txn_per_block"],
+                        txn_size=meta["txn_size"],
+                        block_timeout=meta["block_timeout"],
+                        mine=meta["mine"],
+                        bootnodes=(f"{runners[0].ip()}:30301"
+                                   if meta.get("use_bootnode") else ""))
+        pids.append(runners[i].spawn(
+            cmd, os.path.join(dirpath, f"node{i}.log"),
+            _node_env(meta.get("ambient_jax", False))))
+    meta["pids"] = pids
+    _save_meta(dirpath, meta)
+    return pids
 
 
 _HEAD_RE = re.compile(r"head height=(\d+)")
@@ -151,30 +367,67 @@ def soak(dirpath: str, n: int, seconds: float, **kw) -> bool:
         kill_cluster(dirpath)
 
 
+def synctest(dirpath: str, n: int, seconds: float, **kw) -> bool:
+    """Join/sync scenario (ref: test-sync.py): start n-1 nodes, let the
+    chain grow, then start the last node and assert it catches up."""
+    start_cluster(dirpath, n, skip={n - 1}, **kw)
+    try:
+        deadline = time.time() + seconds * 0.6
+        while time.time() < deadline:
+            time.sleep(3)
+            hs = node_heights(dirpath)
+            print(f"[synctest] pre-join heights={hs}")
+            live = [h for h in hs if h >= 0]
+            if len(live) >= n - 1 and min(live) >= 3:
+                break
+        start_node(dirpath, n - 1)
+        deadline = time.time() + seconds
+        while time.time() < deadline:
+            time.sleep(3)
+            hs = node_heights(dirpath)
+            print(f"[synctest] heights={hs}")
+            if len(hs) == n and hs[-1] >= 3 and hs[-1] >= max(hs) - 2:
+                return True
+        return False
+    finally:
+        kill_cluster(dirpath)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("cmd", choices=["start", "kill", "status", "soak"])
+    ap.add_argument("cmd", choices=["start", "kill", "status", "soak",
+                                    "restart", "synctest"])
     ap.add_argument("--dir", required=True)
     ap.add_argument("--nodes", type=int, default=3)
     ap.add_argument("--seconds", type=float, default=60)
     ap.add_argument("--txnPerBlock", type=int, default=100)
     ap.add_argument("--blockTimeout", type=float, default=20.0)
+    ap.add_argument("--hosts", default="",
+                    help="comma-separated ssh hosts for fan-out "
+                         "(empty = localhost; ref: start.py config.json)")
+    ap.add_argument("--bootnode", action="store_true",
+                    help="use discovery via a bootnode instead of a "
+                         "static peer list")
     args = ap.parse_args()
+    kw = dict(txn_per_block=args.txnPerBlock, block_timeout=args.blockTimeout,
+              hosts=args.hosts, use_bootnode=args.bootnode)
     if args.cmd == "start":
-        pids = start_cluster(args.dir, args.nodes,
-                             txn_per_block=args.txnPerBlock,
-                             block_timeout=args.blockTimeout)
+        pids = start_cluster(args.dir, args.nodes, **kw)
         print("started pids:", pids)
     elif args.cmd == "kill":
         kill_cluster(args.dir)
         print("killed")
+    elif args.cmd == "restart":
+        print("restarted pids:", restart_cluster(args.dir))
     elif args.cmd == "status":
         print("heights:", node_heights(args.dir))
     elif args.cmd == "soak":
-        ok = soak(args.dir, args.nodes, args.seconds,
-                  txn_per_block=args.txnPerBlock,
-                  block_timeout=args.blockTimeout)
+        ok = soak(args.dir, args.nodes, args.seconds, **kw)
         print("SOAK", "PASS" if ok else "FAIL")
+        sys.exit(0 if ok else 1)
+    elif args.cmd == "synctest":
+        ok = synctest(args.dir, args.nodes, args.seconds, **kw)
+        print("SYNCTEST", "PASS" if ok else "FAIL")
         sys.exit(0 if ok else 1)
 
 

@@ -1,0 +1,85 @@
+// Native election/membership hot path.
+//
+// Role parity with the reference's native election library
+// (README.md:103-107 points at a cmake lib under
+// consensus/trustedHW/election/lib that the Go port replaced with
+// election_go.go): the operations that run per received consensus
+// message — committee/acceptor window membership checks against the
+// sorted member list, and the bully-election winner compare — in C++
+// behind a plain C ABI (ctypes on the Python side, the cgo analogue).
+//
+// The membership scan is the reference's own measured hot spot (its
+// --breakdown logs "ChecMembership Time", core/geec_state.go:1092);
+// at 1024 members the Python window check costs a list slice + set
+// lookup per message, this is a branch-free binary search.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Compare two 20-byte addresses (big-endian lexicographic, the sort
+// order of the membership registry).
+static int addr_cmp(const uint8_t* a, const uint8_t* b) {
+    return std::memcmp(a, b, 20);
+}
+
+// Is `addr` inside the window [start, start+n) (wrapping) of the
+// sorted flat address array `flat` (size entries of 20 bytes)?
+// Mirrors eges_tpu.consensus.membership.Membership._window: when
+// size < n the window is everything.
+int geec_window_check(const uint8_t* flat, uint64_t size, uint64_t start,
+                      uint64_t n, const uint8_t* addr) {
+    if (size == 0) return 0;
+    // binary search for addr's index
+    uint64_t lo = 0, hi = size;
+    while (lo < hi) {
+        uint64_t mid = (lo + hi) / 2;
+        int c = addr_cmp(flat + 20 * mid, addr);
+        if (c == 0) { lo = mid; break; }
+        if (c < 0) lo = mid + 1; else hi = mid;
+    }
+    if (lo >= size || addr_cmp(flat + 20 * lo, addr) != 0) return 0;
+    if (size < n) return 1;  // everyone is in the window
+    start %= size;
+    uint64_t end = start + n;  // may exceed size: wrapping window
+    if (end <= size) return (lo >= start && lo < end) ? 1 : 0;
+    return (lo >= start || lo < end - size) ? 1 : 0;
+}
+
+// Election tie-break key (ref: election/server.go:122-125 AddrToInt):
+// sum of the address interpreted as 8+8+4 big-endian words, mod 2^64.
+static uint64_t addr_to_int(const uint8_t* a) {
+    uint64_t x = 0, y = 0, z = 0;
+    for (int i = 0; i < 8; i++) x = (x << 8) | a[i];
+    for (int i = 8; i < 16; i++) y = (y << 8) | a[i];
+    for (int i = 16; i < 20; i++) z = (z << 8) | a[i];
+    return x + y + z;  // natural u64 wrap == mod 2^64
+}
+
+// Winner among m records of (addr20 || rand8be): the bully rule —
+// highest rand wins, ties broken by larger addr_to_int
+// (ref: election_go.go:227 handleElectMessage compare).
+// Returns the record index, or -1 for m == 0.
+int64_t geec_elect_winner(const uint8_t* recs, uint64_t m) {
+    if (m == 0) return -1;
+    int64_t best = 0;
+    uint64_t best_rand = 0, best_key = 0;
+    for (int i = 0; i < 8; i++)
+        best_rand = (best_rand << 8) | recs[20 + i];
+    best_key = addr_to_int(recs);
+    for (uint64_t j = 1; j < m; j++) {
+        const uint8_t* r = recs + 28 * j;
+        uint64_t rnd = 0;
+        for (int i = 0; i < 8; i++) rnd = (rnd << 8) | r[20 + i];
+        uint64_t key = addr_to_int(r);
+        if (rnd > best_rand || (rnd == best_rand && key > best_key)) {
+            best = (int64_t)j;
+            best_rand = rnd;
+            best_key = key;
+        }
+    }
+    return best;
+}
+
+}  // extern "C"

@@ -1,0 +1,195 @@
+"""Discovery (bootnode) + ECDH per-connection handshake tests
+(ref roles: p2p/discover/udp.go, cmd/bootnode/main.go, p2p/rlpx.go)."""
+
+import asyncio
+
+import pytest
+
+from eges_tpu.core import rlp
+from eges_tpu.crypto import secp256k1 as secp
+from eges_tpu.net.discovery import (
+    ANNOUNCE_TTL_S, BootnodeService, DiscoveryClient, GET_PEERS, PEERS,
+    encode_announce,
+)
+from eges_tpu.net.transports import AuthError, _FrameAuth
+
+
+def kp(i: int):
+    priv = bytes([i]) * 32
+    pub = secp.privkey_to_pubkey(priv)
+    return priv, pub, secp.pubkey_to_address(pub)
+
+
+# -- bootnode registry (transport-independent) ----------------------------
+
+def test_bootnode_announce_and_query():
+    now = [1000.0]
+    bn = BootnodeService("0.0.0.0", 0, clock=lambda: now[0])
+    priv, pub, addr = kp(1)
+    bn.handle(encode_announce(priv, pub, "10.0.0.1", 6190, "10.0.0.1",
+                              8100, now=now[0]), lambda d: None)
+    assert addr in bn.registry
+
+    replies = []
+    bn.handle(rlp.encode([GET_PEERS, b"12345678"]), replies.append)
+    assert len(replies) == 1
+    item = rlp.decode(replies[0])
+    assert rlp.decode_uint(item[0]) == PEERS
+    assert bytes(item[1]) == b"12345678"
+    peers = item[2]
+    assert len(peers) == 1 and bytes(peers[0][0]) == addr
+    assert rlp.decode_uint(peers[0][2]) == 6190
+
+    # expiry evicts
+    now[0] += ANNOUNCE_TTL_S + 1
+    replies.clear()
+    bn.handle(rlp.encode([GET_PEERS, b"abcdefgh"]), replies.append)
+    assert rlp.decode(replies[0])[2] == []
+
+
+def test_bootnode_rejects_forged_and_stale_announces():
+    now = [500.0]
+    bn = BootnodeService("0.0.0.0", 0, clock=lambda: now[0])
+    priv, pub, addr = kp(2)
+    good = encode_announce(priv, pub, "1.2.3.4", 1, "1.2.3.4", 2, now=now[0])
+
+    # tamper with the port after signing
+    item = rlp.decode(good)
+    item[3] = rlp.encode_uint(9999)
+    bn.handle(rlp.encode(item), lambda d: None)
+    assert addr not in bn.registry
+
+    # announce signed by a different key than the embedded pubkey
+    other_priv, _, _ = kp(3)
+    forged = encode_announce(other_priv, pub, "1.2.3.4", 1, "1.2.3.4", 2,
+                             now=now[0])
+    bn.handle(forged, lambda d: None)
+    assert addr not in bn.registry
+
+    # stale (expired) announce is a replay: rejected
+    old = encode_announce(priv, pub, "1.2.3.4", 1, "1.2.3.4", 2,
+                          now=now[0] - 2 * ANNOUNCE_TTL_S)
+    bn.handle(old, lambda d: None)
+    assert addr not in bn.registry
+
+    # the honest one lands
+    bn.handle(good, lambda d: None)
+    assert addr in bn.registry
+
+
+def test_bootnode_authorize_gate():
+    now = [10.0]
+    allowed = set()
+    bn = BootnodeService("0.0.0.0", 0, clock=lambda: now[0],
+                         authorize=lambda a: a in allowed)
+    priv, pub, addr = kp(4)
+    ann = encode_announce(priv, pub, "9.9.9.9", 7, "9.9.9.9", 8, now=now[0])
+    bn.handle(ann, lambda d: None)
+    assert addr not in bn.registry
+    allowed.add(addr)
+    bn.handle(ann, lambda d: None)
+    assert addr in bn.registry
+
+
+# -- ECDH v2 handshake ----------------------------------------------------
+
+def test_v2_handshake_derives_matching_keys_and_identity():
+    net = b"\x11" * 32
+    pa, puba, aa = kp(5)
+    pb, pubb, ab = kp(6)
+    A = _FrameAuth(net, keypair=(pa, puba))
+    B = _FrameAuth(net, keypair=(pb, pubb))
+    A.on_hello(B.hello())
+    B.on_hello(A.hello())
+    assert A.peer_addr == ab and B.peer_addr == aa
+    assert A.send_key == B.recv_key and A.recv_key == B.send_key
+    # frames round-trip and replay fails
+    f = A.seal(b"payload")
+    assert B.open(f) == b"payload"
+    with pytest.raises(AuthError):
+        B.open(f)  # replay: sequence advanced
+
+
+def test_v2_handshake_rejects_wrong_key_signature():
+    net = b"\x11" * 32
+    pa, puba, _ = kp(7)
+    pb, pubb, _ = kp(8)
+    evil, _, _ = kp(9)
+    B = _FrameAuth(net, keypair=(pb, pubb))
+    # hello claiming A's pubkey but signed by evil's key
+    from eges_tpu.crypto.keccak import keccak256
+    body = _FrameAuth.MAGIC2 + puba + b"\x00" * 16
+    sig = secp.ecdsa_sign(keccak256(body), evil)
+    with pytest.raises(AuthError):
+        B.on_hello(body + sig)
+
+
+def test_v2_sessions_have_distinct_keys_per_connection():
+    """The round-2 hole: one symmetric secret let any member impersonate
+    the plane.  v2 keys depend on fresh nonces + ECDH — two handshakes
+    between the same parties never share keys."""
+    net = b"\x22" * 32
+    pa, puba, _ = kp(10)
+    pb, pubb, _ = kp(11)
+    A1 = _FrameAuth(net, keypair=(pa, puba))
+    B1 = _FrameAuth(net, keypair=(pb, pubb))
+    A1.on_hello(B1.hello()); B1.on_hello(A1.hello())
+    A2 = _FrameAuth(net, keypair=(pa, puba))
+    B2 = _FrameAuth(net, keypair=(pb, pubb))
+    A2.on_hello(B2.hello()); B2.on_hello(A2.hello())
+    assert A1.send_key != A2.send_key
+    # a third member knowing the network secret but not the parties'
+    # private keys cannot compute the session keys (no shared point)
+    pc, pubc, _ = kp(12)
+    C = _FrameAuth(net, keypair=(pc, pubc))
+    C.on_hello(A1.hello())  # C can read A's public hello...
+    assert C.send_key != B1.recv_key  # ...but derives different keys
+
+
+def test_mixed_v1_v2_handshake_interops():
+    """A keyed (v2) endpoint and a keyless (v1) endpoint must still
+    derive matching session keys — mixed generations/tooling interop."""
+    net = b"\x33" * 32
+    pa, puba, _ = kp(15)
+    keyed = _FrameAuth(net, keypair=(pa, puba))
+    keyless = _FrameAuth(net)
+    keyed_hello = keyed.hello()      # v2
+    keyless_hello = keyless.hello()  # v1
+    keyed.on_hello(keyless_hello)    # falls back to v1
+    keyless.on_hello(keyed_hello)    # parses the v2 nonce, derives v1
+    assert keyed.send_key == keyless.recv_key
+    assert keyed.recv_key == keyless.send_key
+    f = keyed.seal(b"mixed")
+    assert keyless.open(f) == b"mixed"
+    f2 = keyless.seal(b"back")
+    assert keyed.open(f2) == b"back"
+
+
+# -- end-to-end over real sockets ----------------------------------------
+
+def test_discovery_client_learns_peers_via_bootnode():
+    async def scenario():
+        bn = BootnodeService("127.0.0.1", 0)
+        await bn.start()
+        bport = bn._transport.get_extra_info("sockname")[1]
+
+        learned = []
+        p1, _, a1 = kp(13)
+        p2, _, a2 = kp(14)
+        c1 = DiscoveryClient([("127.0.0.1", bport)], p1, "127.0.0.1", 7001,
+                             "127.0.0.1", 8001, interval_s=0.1)
+        c2 = DiscoveryClient(
+            [("127.0.0.1", bport)], p2, "127.0.0.1", 7002, "127.0.0.1",
+            8002, interval_s=0.1,
+            on_peer=lambda addr, gep, cep: learned.append((addr, gep)))
+        await c1.start()
+        await asyncio.sleep(0.25)
+        await c2.start()
+        for _ in range(40):
+            await asyncio.sleep(0.1)
+            if learned:
+                break
+        c1.close(); c2.close(); bn.close()
+        assert (a1, ("127.0.0.1", 7001)) in learned
+
+    asyncio.run(scenario())

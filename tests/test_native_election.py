@@ -1,0 +1,61 @@
+"""Native election component differential tests (native/election.cpp vs
+the pure-Python membership/election semantics; ref role: the cmake
+election lib the reference's README points at, README.md:103-107)."""
+
+import random
+
+import pytest
+
+from eges_tpu.consensus.membership import Member, Membership, derive_seed
+from eges_tpu.crypto import native
+
+pytestmark = pytest.mark.skipif(
+    not (native.available() and native.has_election()),
+    reason="native election lib not built")
+
+rnd = random.Random(3)
+
+
+def _membership(n):
+    m = Membership(n_candidates=16, n_acceptors=64)
+    addrs = [rnd.randbytes(20) for _ in range(n)]
+    for a in addrs:
+        m.add(Member(addr=a, ip="x", port=1, ttl=9))
+    return m, addrs
+
+
+def test_window_check_matches_python_at_1024():
+    m, addrs = _membership(1024)
+    for _ in range(200):
+        seed = rnd.randrange(1 << 62)
+        a = rnd.choice(addrs) if rnd.random() < 0.7 else rnd.randbytes(20)
+        py_c = a in m._members and a in m._window(derive_seed(seed, 0), 16)
+        assert m.is_committee(a, seed) == py_c
+        py_a = a in m._members and a in m._window(seed, 64)
+        assert m.is_acceptor(a, seed) == py_a
+
+
+def test_window_check_small_and_wrapping():
+    m, addrs = _membership(5)  # size < n: everyone is in the window
+    for a in addrs:
+        assert m.is_acceptor(a, 12345)
+    m2, addrs2 = _membership(100)
+    # wrap-around windows (start near the end)
+    for seed in (99, 95, 199):
+        for a in addrs2:
+            py = a in m2._window(seed, 64)
+            assert m2.is_acceptor(a, seed) == py
+
+
+def test_elect_winner_matches_bully_rule():
+    from eges_tpu.consensus.node import addr_to_int
+
+    for _ in range(100):
+        n = rnd.randrange(1, 24)
+        recs = [(rnd.randbytes(20), rnd.randrange(1 << 64))
+                for _ in range(n)]
+        blob = b"".join(a + r.to_bytes(8, "big") for a, r in recs)
+        want = max(range(n),
+                   key=lambda i: (recs[i][1], addr_to_int(recs[i][0])))
+        assert native.elect_winner(blob, n) == want
+    assert native.elect_winner(b"", 0) == -1
