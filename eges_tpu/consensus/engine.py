@@ -1,0 +1,124 @@
+"""Pluggable consensus-engine seam.
+
+Role parity with the reference's ``consensus.Engine`` interface
+(ref: consensus/consensus.go:57 — VerifyHeader/Prepare/Finalize/Seal,
+implemented by ethash, clique and geec): the chain layer calls the
+engine for header verification and block assembly, so the Geec state
+machine is ONE engine rather than a hardwired assumption.
+
+Engines here:
+
+* :class:`GeecEngine` — the production engine: header verification is
+  intentionally near-no-op (ancestry only, ref: consensus/geec/
+  geec.go:186-210 verifyHeader); sealing is driven by the event-loop
+  consensus node (:mod:`eges_tpu.consensus.node`), not a Seal() call.
+* :class:`DevEngine` — single-authority instant-seal PoA (the clique
+  role, ref: consensus/clique/clique.go's signed-extra scheme,
+  re-designed: one signer, no epoch/voting): every sealed header
+  carries the authority's signature over the header's signing hash in
+  ``extra``; verification recovers and checks the signer.  This is the
+  dev-chain mode (geth --dev analogue) and proves the seam carries a
+  second, structurally different engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from eges_tpu.core.types import Block, Header, new_block
+
+
+class EngineError(Exception):
+    """Header/seal verification failure."""
+
+
+class Engine:
+    """The minimal engine surface the chain layer consumes."""
+
+    name = "base"
+
+    def verify_header(self, chain, header: Header) -> None:
+        """Raise :class:`EngineError` on a bad header.  Ancestry/number
+        checks are the chain layer's; engines add their own rules."""
+
+    def prepare(self, chain, header: Header) -> Header:
+        """Fill engine-owned header fields before execution."""
+        return header
+
+    def seal(self, chain, block: Block) -> Block:
+        """Produce the sealed block (synchronous engines only)."""
+        return block
+
+
+class GeecEngine(Engine):
+    """Geec: verification rides the quorum certificates, not the header
+    (ref: geec.go:186-210 — the header check is deliberately minimal;
+    VerifySeal is a stub, geec.go:223-226).  Sealing happens in the
+    consensus node's phase machine, so :meth:`seal` is unused."""
+
+    name = "geec"
+
+    def verify_header(self, chain, header: Header) -> None:
+        if header.number > 0 and header.time == 0:
+            raise EngineError("missing timestamp")
+
+
+class DevEngine(Engine):
+    """Single-authority instant seal.  ``extra`` carries the 65-byte
+    authority signature over the unsigned header hash."""
+
+    name = "dev"
+
+    def __init__(self, authority: bytes, priv: bytes | None = None):
+        self.authority = authority  # 20-byte address
+        self.priv = priv            # present on the sealing node only
+
+    @staticmethod
+    def _signing_hash(header: Header) -> bytes:
+        from eges_tpu.core import rlp
+        from eges_tpu.crypto.keccak import keccak256
+
+        bare = dataclasses.replace(header, extra=b"")
+        return keccak256(rlp.encode(bare.to_rlp()))
+
+    def verify_header(self, chain, header: Header) -> None:
+        from eges_tpu.crypto import secp256k1 as secp
+
+        if header.number == 0:
+            return
+        if len(header.extra) != 65:
+            raise EngineError("dev seal missing")
+        try:
+            signer = secp.recover_address(self._signing_hash(header),
+                                          header.extra)
+        except Exception:
+            raise EngineError("unrecoverable dev seal")
+        if signer != self.authority:
+            raise EngineError("dev seal from a non-authority signer")
+
+    def seal(self, chain, block: Block) -> Block:
+        from eges_tpu.crypto import secp256k1 as secp
+
+        if self.priv is None:
+            raise EngineError("not the authority (no key)")
+        sig = secp.ecdsa_sign(self._signing_hash(block.header), self.priv)
+        header = dataclasses.replace(block.header, extra=sig)
+        return dataclasses.replace(block, header=header)
+
+    def seal_next(self, chain, txs=(), coinbase: bytes | None = None) -> Block:
+        """Convenience dev-chain block producer: preview ``txs`` on the
+        head state, assemble, seal, and offer — the geth --dev
+        instant-mining loop collapsed to one call."""
+        coinbase = coinbase if coinbase is not None else self.authority
+        parent = chain.head()
+        kept, root, receipt_hash, gas, bloom = chain.execute_preview(
+            list(txs), coinbase)
+        header = Header(parent_hash=parent.hash, number=parent.number + 1,
+                        coinbase=coinbase, time=parent.header.time + 1,
+                        root=root, receipt_hash=receipt_hash, gas_used=gas,
+                        bloom=bloom)
+        block = self.seal(chain, new_block(header, txs=kept))
+        inserted = chain.offer(block)
+        if not inserted:
+            raise EngineError(f"dev block rejected: {chain.last_error}")
+        return block

@@ -414,17 +414,18 @@ class GeecNode:
             from eges_tpu.core.evm import BlockCtx
             ctx = BlockCtx(coinbase=self.coinbase, number=blk_num,
                            time=blk_time, difficulty=difficulty)
-            txs, root, receipt_hash, gas_used = \
+            txs, root, receipt_hash, gas_used, bloom = \
                 self.chain.execute_preview(txs, self.coinbase, ctx=ctx)
         else:
             from eges_tpu.core.trie import EMPTY_ROOT
             root, receipt_hash, gas_used = (parent.header.root, EMPTY_ROOT, 0)
+            bloom = bytes(256)
         header = Header(
             parent_hash=parent.hash, number=blk_num,
             coinbase=self.coinbase, difficulty=difficulty,
             time=blk_time,
             root=root, receipt_hash=receipt_hash, gas_used=gas_used,
-            regs=regs,
+            bloom=bloom, regs=regs,
             trust_rand=self.wb._rng.getrandbits(64),  # seed for NEXT block
         )
         return new_block(header, txs=txs, geec_txns=geec_txns,

@@ -1,0 +1,1 @@
+"""Interactive console package (ref role: console/ + internal/jsre)."""

@@ -1,0 +1,95 @@
+"""``python -m eges_tpu.console --rpc http://127.0.0.1:9100`` — the
+attach console (ref role: console/console.go + the geth ``attach``
+command; a Python REPL over JSON-RPC instead of a JS VM).
+
+Inside the REPL:
+    rpc("eth_blockNumber")               # raw JSON-RPC
+    eth.block_number()                   # namespaced helpers
+    eth.balance("0x...")
+    eth.get_block(3)
+    thw.status() / thw.membership() / thw.metrics()
+    debug.stacks() / debug.stats()
+"""
+
+from __future__ import annotations
+
+import argparse
+import code
+import json
+import urllib.request
+
+
+class RpcClient:
+    def __init__(self, url: str):
+        self.url = url
+        self._id = 0
+
+    def __call__(self, method: str, *params):
+        self._id += 1
+        body = json.dumps({"jsonrpc": "2.0", "id": self._id,
+                           "method": method, "params": list(params)})
+        req = urllib.request.Request(
+            self.url, data=body.encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            out = json.loads(resp.read())
+        if "error" in out and out["error"]:
+            raise RuntimeError(f"RPC error {out['error']}")
+        return out.get("result")
+
+
+class _Namespace:
+    def __init__(self, rpc: RpcClient, prefix: str):
+        self._rpc = rpc
+        self._prefix = prefix
+
+    def __getattr__(self, name: str):
+        # snake_case helper -> camelCase RPC method (block_number ->
+        # eth_blockNumber)
+        parts = name.split("_")
+        camel = parts[0] + "".join(p.title() for p in parts[1:])
+        method = f"{self._prefix}_{camel}"
+        return lambda *params: self._rpc(method, *params)
+
+
+class Eth(_Namespace):
+    """Sugar over the eth_* namespace."""
+
+    def block_number(self) -> int:
+        return int(self._rpc("eth_blockNumber"), 16)
+
+    def balance(self, addr: str, tag: str = "latest") -> int:
+        return int(self._rpc("eth_getBalance", addr, tag), 16)
+
+    def get_block(self, n, full: bool = False):
+        if isinstance(n, int):
+            n = hex(n)
+        return self._rpc("eth_getBlockByNumber", n, full)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="eges-tpu-console")
+    p.add_argument("--rpc", default="http://127.0.0.1:8545")
+    p.add_argument("--exec", default="",
+                   help="evaluate one expression and exit (the geth "
+                        "--exec attach mode)")
+    args = p.parse_args(argv)
+
+    rpc = RpcClient(args.rpc)
+    ns = {
+        "rpc": rpc,
+        "eth": Eth(rpc, "eth"),
+        "thw": _Namespace(rpc, "thw"),
+        "net": _Namespace(rpc, "net"),
+        "debug": _Namespace(rpc, "debug"),
+    }
+    if args.exec:
+        print(eval(args.exec, ns))  # noqa: S307 - operator-driven REPL
+        return
+    banner = (f"eges-tpu console — attached to {args.rpc}\n"
+              "namespaces: rpc(method, *params), eth, thw, net, debug")
+    code.interact(banner=banner, local=ns)
+
+
+if __name__ == "__main__":
+    main()

@@ -166,11 +166,15 @@ class BlockChain:
     _STATE_KEEP = 1024
 
     def __init__(self, store=None, genesis: Block | None = None,
-                 verifier=None, listeners=(), alloc=None):
+                 verifier=None, listeners=(), alloc=None, engine=None):
         from eges_tpu.core.state import StateDB
 
         self.store = store if store is not None else MemoryStore()
         self.verifier = verifier
+        if engine is None:
+            from eges_tpu.consensus.engine import GeecEngine
+            engine = GeecEngine()
+        self.engine = engine
         self._listeners = list(listeners)
         self._lock = threading.RLock()
         # out-of-order buffer: up to _MAX_CANDIDATES first-seen distinct
@@ -247,13 +251,19 @@ class BlockChain:
     # -- verification -----------------------------------------------------
 
     def _verify_header(self, header: Header) -> None:
-        """Geec header verification is intentionally minimal: ancestry
-        only (ref: consensus/geec/geec.go:186-210 verifyHeader)."""
+        """Ancestry checks plus the engine's own rules (the
+        consensus.Engine seam — ref: consensus/consensus.go:57; Geec's
+        check is intentionally minimal, geec.go:186-210)."""
         if header.number != self._head.number + 1:
             raise ChainError(
                 f"non-sequential insert: {header.number} onto {self._head.number}")
         if header.parent_hash != self._head.hash:
             raise ChainError("unknown ancestor")
+        from eges_tpu.consensus.engine import EngineError
+        try:
+            self.engine.verify_header(self, header)
+        except EngineError as e:
+            raise ChainError(f"engine: {e}")
 
     def _verify_body(self, block: Block) -> None:
         """Uncle/tx-root checks (ref: core/block_validator.go:51-76;
@@ -285,6 +295,9 @@ class BlockChain:
             raise ChainError("receipt root mismatch")
         if block.header.gas_used != gas:
             raise ChainError("gas used mismatch")
+        from eges_tpu.core.state import receipts_bloom
+        if block.header.bloom != receipts_bloom(receipts):
+            raise ChainError("log bloom mismatch")
         return state, receipts, gas
 
     def _remember_state(self, block_hash: bytes, height: int, state,
@@ -379,7 +392,9 @@ class BlockChain:
                 gas = r.cumulative_gas_used
                 receipts.append(r)
                 kept.append(t)
-            return kept, state.root(), receipts_root(receipts), gas
+            from eges_tpu.core.state import receipts_bloom
+            return (kept, state.root(), receipts_root(receipts), gas,
+                    receipts_bloom(receipts))
 
     def validate_candidate(self, block: Block) -> bool:
         """Full acceptor-side validation of a proposed block WITHOUT

@@ -180,7 +180,115 @@ class EVM:
             if gas < cost:
                 raise EvmError("oog:precompile")
             return data, gas - cost
+        if addr_int == 5:
+            return self._modexp(data, gas)
+        if addr_int in (6, 7, 8):
+            return self._bn256(addr_int, data, gas)
         return None
+
+    @staticmethod
+    def _modexp(data: bytes, gas: int):
+        """0x05 bigModExp (EIP-198; ref: core/vm/contracts.go bigModExp)."""
+        d = data.ljust(96, b"\0")
+        bl = int.from_bytes(d[:32], "big")
+        el = int.from_bytes(d[32:64], "big")
+        ml = int.from_bytes(d[64:96], "big")
+        if max(bl, el, ml) > 1 << 20:  # 1 MiB operand cap
+            raise EvmError("modexp: operand too large")
+        body = data[96:].ljust(bl + el + ml, b"\0")
+        base = int.from_bytes(body[:bl], "big")
+        exp = int.from_bytes(body[bl : bl + el], "big")
+        mod = int.from_bytes(body[bl + el : bl + el + ml], "big")
+        # EIP-198 gas: mult_complexity(max(bl, ml)) * max(adj_exp_len, 1) / 20
+        w = max(bl, ml)
+        if w <= 64:
+            mult = w * w
+        elif w <= 1024:
+            mult = w * w // 4 + 96 * w - 3072
+        else:
+            mult = w * w // 16 + 480 * w - 199_680
+        if el <= 32:
+            adj = max(exp.bit_length() - 1, 0)
+        else:
+            head = int.from_bytes(body[bl : bl + 32], "big")
+            adj = 8 * (el - 32) + max(head.bit_length() - 1, 0)
+        cost = max(mult * max(adj, 1) // 20, 200)
+        if gas < cost:
+            raise EvmError("oog:precompile")
+        out = (b"" if ml == 0
+               else (0 if mod == 0 else pow(base, exp, mod)
+                     ).to_bytes(ml, "big"))
+        return out, gas - cost
+
+    # -- alt_bn128 precompiles (EIP-196/197; ref: core/vm/contracts.go
+    # bn256Add/bn256ScalarMul/bn256Pairing over crypto/bn256) ------------
+
+    @staticmethod
+    def _bn_g1(data: bytes):
+        from eges_tpu.crypto import bn254 as bn
+
+        x = int.from_bytes(data[:32], "big")
+        y = int.from_bytes(data[32:64], "big")
+        if x == 0 and y == 0:
+            return None
+        pt = (x, y)
+        if not bn.g1_is_on_curve(pt):
+            raise EvmError("bn256: point not on curve")
+        return pt
+
+    @staticmethod
+    def _bn_g2(data: bytes):
+        from eges_tpu.crypto import bn254 as bn
+
+        # EIP-197 encodes F_p2 elements imaginary-part first
+        xi = int.from_bytes(data[:32], "big")
+        xr = int.from_bytes(data[32:64], "big")
+        yi = int.from_bytes(data[64:96], "big")
+        yr = int.from_bytes(data[96:128], "big")
+        if xi == xr == yi == yr == 0:
+            return None
+        if max(xi, xr, yi, yr) >= bn.P:
+            raise EvmError("bn256: coordinate out of field")
+        pt = ((xr, xi), (yr, yi))
+        if not bn.g2_in_subgroup(pt):
+            raise EvmError("bn256: G2 point not in subgroup")
+        return pt
+
+    def _bn256(self, addr_int: int, data: bytes, gas: int):
+        from eges_tpu.crypto import bn254 as bn
+
+        if addr_int == 6:  # ECADD
+            cost = 500
+            if gas < cost:
+                raise EvmError("oog:precompile")
+            d = data.ljust(128, b"\0")[:128]
+            s = bn.g1_add(self._bn_g1(d[:64]), self._bn_g1(d[64:128]))
+            out = (bytes(64) if s is None
+                   else s[0].to_bytes(32, "big") + s[1].to_bytes(32, "big"))
+            return out, gas - cost
+        if addr_int == 7:  # ECMUL
+            cost = 40_000
+            if gas < cost:
+                raise EvmError("oog:precompile")
+            d = data.ljust(96, b"\0")[:96]
+            k = int.from_bytes(d[64:96], "big")
+            s = bn.g1_mul(k, self._bn_g1(d[:64]))
+            out = (bytes(64) if s is None
+                   else s[0].to_bytes(32, "big") + s[1].to_bytes(32, "big"))
+            return out, gas - cost
+        # ECPAIRING
+        if len(data) % 192 != 0:
+            raise EvmError("bn256: pairing input not a multiple of 192")
+        k = len(data) // 192
+        cost = 100_000 + 80_000 * k
+        if gas < cost:
+            raise EvmError("oog:precompile")
+        pairs = []
+        for i in range(k):
+            chunk = data[192 * i : 192 * (i + 1)]
+            pairs.append((self._bn_g1(chunk[:64]), self._bn_g2(chunk[64:])))
+        ok = bn.pairing_check(pairs)
+        return (1 if ok else 0).to_bytes(32, "big"), gas - cost
 
     def _ecrecover(self, data: bytes) -> bytes:
         """The 0x01 precompile, routed through the device batch verifier
@@ -227,7 +335,7 @@ class EVM:
         log_mark = len(self.logs)
         try:
             pre = self._precompile(int.from_bytes(to, "big"), data, gas) \
-                if 1 <= int.from_bytes(to, "big") <= 4 else None
+                if 1 <= int.from_bytes(to, "big") <= 8 else None
             if value:
                 if static:
                     raise EvmError("static value transfer")
@@ -610,7 +718,7 @@ class EVM:
                         and self.state.account(to).balance == 0
                         and self.state.nonce(to) == 0
                         and not self.state.code(to)
-                        and not (1 <= to_int <= 4)):
+                        and not (1 <= to_int <= 8)):
                     base += G_NEW_ACCOUNT
                 use(base)
                 data = mload(in_off, in_n)
@@ -695,7 +803,7 @@ class EVM:
             code = frame_state.code(code_addr)
             pre = self._precompile(int.from_bytes(code_addr, "big"), data,
                                    gas) \
-                if 1 <= int.from_bytes(code_addr, "big") <= 4 else None
+                if 1 <= int.from_bytes(code_addr, "big") <= 8 else None
             if pre is not None:
                 out, gas_left = pre
                 snapshot.absorb(frame_state)

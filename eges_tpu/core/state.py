@@ -70,6 +70,31 @@ class Account:
                 self.code_hash]
 
 
+def logs_bloom(logs) -> bytes:
+    """2048-bit log bloom (ref: core/types/bloom9.go): for each log
+    address and topic, set 3 bits chosen by the first three 11-bit
+    big-endian pairs of the value's keccak."""
+    bits = 0
+    for addr, topics, _data in logs:
+        for value in (addr, *topics):
+            h = keccak256(value)
+            for i in (0, 2, 4):
+                bit = ((h[i] << 8) | h[i + 1]) & 2047
+                bits |= 1 << bit
+    return bits.to_bytes(256, "big")
+
+
+def bloom_may_contain(bloom: bytes, value: bytes) -> bool:
+    """Bloom membership probe (false positives possible, negatives not)."""
+    bits = int.from_bytes(bloom, "big")
+    h = keccak256(value)
+    for i in (0, 2, 4):
+        bit = ((h[i] << 8) | h[i + 1]) & 2047
+        if not (bits >> bit) & 1:
+            return False
+    return True
+
+
 @dataclass(frozen=True)
 class Receipt:
     """(ref: core/types/receipt.go — status-era encoding
@@ -80,8 +105,8 @@ class Receipt:
     logs: tuple = ()
 
     def to_rlp(self) -> list:
-        return [self.status, self.cumulative_gas_used, bytes(256),
-                list(self.logs)]
+        return [self.status, self.cumulative_gas_used,
+                logs_bloom(self.logs), list(self.logs)]
 
     def encode(self) -> bytes:
         return rlp.encode(self.to_rlp())
@@ -339,7 +364,7 @@ def apply_txn(state: StateDB, txn, sender: bytes, coinbase: bytes,
 
     is_create = txn.to is None
     to_int = int.from_bytes(txn.to, "big") if txn.to is not None else -1
-    runs_evm = is_create or (1 <= to_int <= 4) or bool(state.code(txn.to))
+    runs_evm = is_create or (1 <= to_int <= 8) or bool(state.code(txn.to))
     if not runs_evm:
         fee = INTRINSIC_GAS * txn.gas_price
         if txn.gas_limit and txn.gas_limit < INTRINSIC_GAS:
@@ -425,3 +450,12 @@ def receipts_root(receipts) -> bytes:
     if not receipts:
         return EMPTY_ROOT
     return derive_sha([r.encode() for r in receipts])
+
+
+def receipts_bloom(receipts) -> bytes:
+    """Block-level bloom: OR of the receipts' log blooms (the
+    Header.Bloom commitment, ref: core/types/bloom9.go CreateBloom)."""
+    bits = 0
+    for r in receipts:
+        bits |= int.from_bytes(logs_bloom(r.logs), "big")
+    return bits.to_bytes(256, "big")

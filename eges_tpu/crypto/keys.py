@@ -55,3 +55,10 @@ def load_or_create(path: str, seed: bytes | None = None) -> KeyPair:
     with os.fdopen(fd, "wb") as fh:
         fh.write(kp.priv)
     return kp
+
+
+def deterministic_node_key(i: int) -> bytes:
+    """Deterministic 32-byte dev/test key for node index ``i`` — the ONE
+    scheme shared by the simulator and the real-socket harness, valid
+    for any cluster size (a single-byte pattern overflows at index 255)."""
+    return (i + 1).to_bytes(4, "big") * 8

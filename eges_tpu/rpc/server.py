@@ -93,6 +93,8 @@ class RpcServer:
         self.port = port
         self.chain_id = chain_id
         self._server = None
+        self._filters: dict = {}
+        self._filter_seq = 0
 
     # -- method handlers --------------------------------------------------
 
@@ -172,6 +174,31 @@ class RpcServer:
         if method == "eth_getTransactionCount":
             st = self._state_for(params[1] if len(params) > 1 else "latest")
             return _hex(st.nonce(bytes.fromhex(params[0][2:])))
+        if method == "eth_getCode":
+            st = self._state_for(params[1] if len(params) > 1 else "latest")
+            return "0x" + st.code(bytes.fromhex(params[0][2:])).hex()
+        if method == "eth_getStorageAt":
+            st = self._state_for(params[2] if len(params) > 2 else "latest")
+            v = st.storage_at(bytes.fromhex(params[0][2:]),
+                              int(params[1], 16))
+            return "0x" + v.to_bytes(32, "big").hex()
+        if method == "eth_call":
+            return self._eth_call(params[0],
+                                  params[1] if len(params) > 1 else "latest")
+        if method == "eth_estimateGas":
+            return _hex(self._estimate_gas(
+                params[0], params[1] if len(params) > 1 else "latest"))
+        if method == "eth_gasPrice":
+            return _hex(self._gas_price())
+        if method == "eth_getLogs":
+            return self._get_logs(params[0] if params else {})
+        if method in ("eth_newFilter", "eth_newBlockFilter"):
+            return self._new_filter(method,
+                                    params[0] if params else {})
+        if method == "eth_getFilterChanges":
+            return self._filter_changes(params[0])
+        if method == "eth_uninstallFilter":
+            return self._filters.pop(params[0], None) is not None
         if method == "eth_getTransactionReceipt":
             return self._receipt_json(bytes.fromhex(params[0][2:]))
         if method == "net_version":
@@ -227,6 +254,212 @@ class RpcServer:
         if method.startswith("debug_"):
             return self._debug(method, params)
         raise RpcError(-32601, f"method {method} not found")
+
+    # -- read-only EVM execution (ref: internal/ethapi/api.go Call) -------
+
+    def _call_raw(self, obj: dict, tag) -> tuple[bytes, int]:
+        from eges_tpu.core.evm import EVM
+        from eges_tpu.core.state import block_ctx
+
+        st = self._state_for(tag)
+        blk = self._resolve_block(tag)
+        sender = (bytes.fromhex(obj["from"][2:]) if obj.get("from")
+                  else bytes(20))
+        to = bytes.fromhex(obj["to"][2:]) if obj.get("to") else None
+        data = bytes.fromhex(obj.get("data", "0x")[2:])
+        value = int(obj.get("value", "0x0"), 16)
+        gas = int(obj.get("gas", "0x1c9c380"), 16)  # default 30M
+        e = EVM(st.copy(), block_ctx(blk.header),
+                verifier=self.chain.verifier)
+        if to is None:
+            res = e.create(sender, value, data, gas, st.nonce(sender))
+        else:
+            res = e.call(sender, to, value, data, gas)
+        if not res.success and res.output:
+            raise RpcError(-32000, "execution reverted: 0x"
+                           + res.output.hex())
+        if not res.success:
+            raise RpcError(-32000, "execution failed (out of gas?)")
+        from eges_tpu.core.evm import intrinsic_gas
+        return res.output, intrinsic_gas(data, to is None) + res.gas_used
+
+    def _eth_call(self, obj: dict, tag) -> str:
+        out, _ = self._call_raw(obj, tag)
+        return "0x" + out.hex()
+
+    def _estimate_gas(self, obj: dict, tag) -> int:
+        """Binary-search the smallest sufficient gas limit (the 63/64
+        call-gas rule means measured usage at a high limit can be too
+        little to actually run — ref: internal/ethapi/api.go
+        DoEstimateGas's binary search)."""
+        from eges_tpu.core.evm import intrinsic_gas
+
+        _, used = self._call_raw(obj, tag)  # raises if it cannot run at cap
+        lo, hi = used, max(used, int(obj.get("gas", "0x1c9c380"), 16))
+        intr = intrinsic_gas(bytes.fromhex(obj.get("data", "0x")[2:]),
+                             not obj.get("to"))
+
+        def runs(limit: int) -> bool:
+            # a txn with gas_limit=limit gives the EVM (limit - intrinsic)
+            trial = dict(obj, gas=hex(max(limit - intr, 0)))
+            try:
+                self._call_raw(trial, tag)
+                return True
+            except RpcError:
+                return False
+
+        if runs(lo):
+            return lo
+        while lo + 1 < hi:
+            mid = (lo + hi) // 2
+            if runs(mid):
+                hi = mid
+            else:
+                lo = mid
+        return hi
+
+    # -- gas oracle (ref: eth/gasprice/gasprice.go SuggestPrice) ----------
+
+    def _gas_price(self) -> int:
+        prices = []
+        h = self.chain.height()
+        for n in range(h, max(0, h - 20), -1):
+            blk = self.chain.get_block_by_number(n)
+            if blk is None:
+                continue
+            prices.extend(t.gas_price for t in blk.transactions
+                          if not t.is_geec)
+        if not prices:
+            return 1
+        prices.sort()
+        return max(1, prices[len(prices) // 2])
+
+    # -- log filters (ref: eth/filters/filter.go + filter_system.go) ------
+
+    def _match_log(self, log, addresses, topics) -> bool:
+        """``topics`` entries are pre-parsed byte-sets (or None)."""
+        addr, ltopics, _ = log
+        if addresses and addr not in addresses:
+            return False
+        for i, want in enumerate(topics):
+            if want is None:
+                continue
+            if i >= len(ltopics) or ltopics[i] not in want:
+                return False
+        return True
+
+    def _bloom_skip(self, header, addresses, topics) -> bool:
+        """True when the header bloom PROVES no log can match (the
+        bloombits-index role, ref: core/bloombits/ + eth/filters
+        bloomFilter); false positives fall through to the receipt scan."""
+        from eges_tpu.core.state import bloom_may_contain
+
+        if header.bloom == bytes(256):
+            return bool(addresses or any(t is not None for t in topics))
+        if addresses and not any(bloom_may_contain(header.bloom, a)
+                                 for a in addresses):
+            return True
+        for want in topics:
+            if want is not None and not any(
+                    bloom_may_contain(header.bloom, t) for t in want):
+                return True
+        return False
+
+    def _logs_in_range(self, from_n: int, to_n: int, addresses,
+                       topics) -> list:
+        out = []
+        for n in range(max(0, from_n), to_n + 1):
+            blk = self.chain.get_block_by_number(n)
+            if blk is None:
+                continue
+            if self._bloom_skip(blk.header, addresses, topics):
+                continue
+            receipts = self.chain.receipts_of(blk.hash)
+            log_index = 0
+            for ti, r in enumerate(receipts):
+                for log in getattr(r, "logs", ()):
+                    if self._match_log(log, addresses, topics):
+                        addr, ltopics, data = log
+                        out.append({
+                            "address": "0x" + addr.hex(),
+                            "topics": ["0x" + t.hex() for t in ltopics],
+                            "data": "0x" + data.hex(),
+                            "blockNumber": _hex(n),
+                            "blockHash": "0x" + blk.hash.hex(),
+                            "transactionHash":
+                                "0x" + blk.transactions[ti].hash.hex(),
+                            "transactionIndex": _hex(ti),
+                            "logIndex": _hex(log_index),
+                        })
+                    log_index += 1
+        return out
+
+    def _parse_filter(self, obj: dict):
+        def block_num(tag, default):
+            if tag in (None, "latest", "pending"):
+                return default
+            if tag == "earliest":
+                return 0
+            return int(tag, 16)
+
+        h = self.chain.height()
+        from_n = block_num(obj.get("fromBlock"), h)
+        to_n = block_num(obj.get("toBlock"), h)
+        addrs = obj.get("address")
+        if isinstance(addrs, str):
+            addrs = [addrs]
+        addresses = {bytes.fromhex(a[2:]) for a in (addrs or [])}
+        # pre-parse topic filters once (hex -> byte-sets); each position
+        # is None (wildcard) or a set of acceptable topics
+        topics = []
+        for want in obj.get("topics", []):
+            if want is None:
+                topics.append(None)
+            else:
+                alts = want if isinstance(want, list) else [want]
+                topics.append({bytes.fromhex(a[2:]) for a in alts})
+        return from_n, to_n, addresses, topics
+
+    def _get_logs(self, obj: dict) -> list:
+        from_n, to_n, addresses, topics = self._parse_filter(obj)
+        return self._logs_in_range(from_n, to_n, addresses, topics)
+
+    def _new_filter(self, method: str, obj: dict) -> str:
+        self._filter_seq += 1
+        fid = _hex(self._filter_seq)
+        self._filters[fid] = {
+            "kind": "logs" if method == "eth_newFilter" else "blocks",
+            "obj": obj,
+            "last": self.chain.height(),
+        }
+        return fid
+
+    def _filter_changes(self, fid: str):
+        f = self._filters.get(fid)
+        if f is None:
+            raise RpcError(-32000, "filter not found")
+        h = self.chain.height()
+        start, f["last"] = f["last"] + 1, h
+        if start > h:
+            return []
+        if f["kind"] == "blocks":
+            out = []
+            for n in range(start, h + 1):
+                blk = self.chain.get_block_by_number(n)
+                if blk is not None:
+                    out.append("0x" + blk.hash.hex())
+            return out
+        from_n, to_n, addresses, topics = self._parse_filter(f["obj"])
+        # honor the filter's own explicit block bounds (absent/"latest"
+        # bounds mean "everything new since install"); a toBlock in the
+        # past means no new logs can ever match
+        explicit = lambda tag: tag not in (None, "latest", "pending")
+        lo = max(start, from_n) if explicit(f["obj"].get("fromBlock")) \
+            else start
+        hi = min(h, to_n) if explicit(f["obj"].get("toBlock")) else h
+        if lo > hi:
+            return []
+        return self._logs_in_range(lo, hi, addresses, topics)
 
     def _debug(self, method: str, params: list):
         """Runtime debug namespace (ref: internal/debug/api.go —

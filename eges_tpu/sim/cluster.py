@@ -42,7 +42,8 @@ class SimCluster:
 
         if n_bootstrap is None:
             n_bootstrap = n_nodes
-        privs = [bytes([i + 1]) * 32 for i in range(n_nodes)]
+        from eges_tpu.crypto.keys import deterministic_node_key
+        privs = [deterministic_node_key(i) for i in range(n_nodes)]
         addrs = [secp.pubkey_to_address(secp.privkey_to_pubkey(p))
                  for p in privs]
         boot = tuple(

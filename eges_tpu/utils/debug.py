@@ -32,7 +32,7 @@ def dump_stacks() -> str:
     try:
         import asyncio
 
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         tasks = [t for t in asyncio.all_tasks(loop) if not t.done()]
         out.append(f"--- {len(tasks)} pending asyncio tasks ---")
         for t in tasks:

@@ -37,7 +37,8 @@ RPC_BASE = 9100
 
 
 def node_key(i: int) -> bytes:
-    return bytes([i + 1]) * 32
+    from eges_tpu.crypto.keys import deterministic_node_key
+    return deterministic_node_key(i)
 
 
 class Runner:

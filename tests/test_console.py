@@ -1,0 +1,46 @@
+"""Attach-console test: RpcClient + namespace sugar against a live
+RpcServer (ref role: console/console.go attach + --exec)."""
+
+import asyncio
+import threading
+
+from eges_tpu.console.__main__ import Eth, RpcClient, _Namespace
+from eges_tpu.core.chain import BlockChain, make_genesis
+from eges_tpu.rpc.server import RpcServer
+
+
+def test_console_attaches_and_queries():
+    chain = BlockChain(genesis=make_genesis())
+    ready = threading.Event()
+    port_box = {}
+    loop_box = {}
+
+    def serve():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        loop_box["loop"] = loop
+        rpc = RpcServer(chain, port=0)
+
+        async def boot():
+            await rpc.start()
+            port_box["port"] = rpc._server.sockets[0].getsockname()[1]
+            ready.set()
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    assert ready.wait(10)
+
+    client = RpcClient(f"http://127.0.0.1:{port_box['port']}")
+    eth = Eth(client, "eth")
+    assert eth.block_number() == 0
+    blk = eth.get_block(0)
+    assert blk["number"] == "0x0"
+    assert client("web3_clientVersion").startswith("eges-tpu")
+    # generic namespace camel-casing: debug_stats via attribute access
+    debug = _Namespace(client, "debug")
+    assert debug.stats()["threads"] >= 1
+
+    loop_box["loop"].call_soon_threadsafe(loop_box["loop"].stop)

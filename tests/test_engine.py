@@ -1,0 +1,70 @@
+"""Engine-seam tests: the chain consults a pluggable engine, and the
+dev PoA engine seals/verifies single-authority blocks
+(ref roles: consensus/consensus.go:57 Engine; consensus/clique/ —
+signed-extra authority scheme)."""
+
+import dataclasses
+
+import pytest
+
+from eges_tpu.consensus.engine import DevEngine, EngineError, GeecEngine
+from eges_tpu.core.chain import BlockChain, make_genesis
+from eges_tpu.core.types import Header, Transaction, new_block
+from eges_tpu.crypto import secp256k1 as secp
+
+PRIV = bytes([9]) * 32
+AUTH = secp.pubkey_to_address(secp.privkey_to_pubkey(PRIV))
+ETH = 10**18
+
+
+def test_dev_engine_seals_and_chain_verifies():
+    eng = DevEngine(AUTH, PRIV)
+    chain = BlockChain(genesis=make_genesis(alloc={AUTH: ETH}),
+                       alloc={AUTH: ETH}, engine=eng)
+    b1 = eng.seal_next(chain)
+    assert chain.height() == 1 and chain.head().hash == b1.hash
+    # a signed value transfer flows through the dev chain
+    t = Transaction(nonce=0, gas_price=0, to=bytes(20), value=5).signed(PRIV)
+    b2 = eng.seal_next(chain, [t])
+    assert chain.height() == 2
+    assert chain.head_state().balance(bytes(20)) == 5
+    assert len(b2.transactions) == 1
+
+
+def test_dev_engine_rejects_foreign_seal():
+    eng = DevEngine(AUTH, PRIV)
+    chain = BlockChain(genesis=make_genesis(), engine=eng)
+    evil_priv = bytes([10]) * 32
+    evil_eng = DevEngine(AUTH, evil_priv)  # claims AUTH, wrong key
+    parent = chain.head()
+    header = Header(parent_hash=parent.hash, number=1,
+                    time=parent.header.time + 1, root=parent.header.root)
+    bad = evil_eng.seal(chain, new_block(header))
+    assert chain.offer(bad) == []
+    assert "non-authority" in (chain.last_error or "")
+    # unsigned header fails too
+    bare = new_block(header)
+    assert chain.offer(bare) == []
+    # the genuine authority's seal lands
+    good = eng.seal(chain, new_block(header))
+    assert chain.offer(good), chain.last_error
+
+
+def test_dev_engine_requires_key_to_seal():
+    eng = DevEngine(AUTH)  # verify-only
+    chain = BlockChain(genesis=make_genesis(), engine=eng)
+    with pytest.raises(EngineError):
+        eng.seal_next(chain)
+
+
+def test_geec_engine_minimal_header_rule():
+    chain = BlockChain(genesis=make_genesis(), engine=GeecEngine())
+    parent = chain.head()
+    no_time = new_block(Header(parent_hash=parent.hash, number=1, time=0,
+                               root=parent.header.root))
+    assert chain.offer(no_time) == []
+    assert "engine" in (chain.last_error or "")
+    ok = new_block(Header(parent_hash=parent.hash, number=1,
+                          time=parent.header.time + 1,
+                          root=parent.header.root))
+    assert chain.offer(ok), chain.last_error

@@ -228,3 +228,70 @@ def test_process_block_roots_evm_effects():
     assert state.code(caddr) == runtime
     assert state.root() != s.root()
     assert receipts_root(receipts) != receipts_root(())
+
+
+def test_bn256_precompiles():
+    """EIP-196/197 precompiles 0x06-0x08 (ref: core/vm/contracts.go
+    bn256Add/ScalarMul/Pairing over crypto/bn256)."""
+    from eges_tpu.crypto import bn254 as bn
+
+    s = st()
+    e = EVM(s, BlockCtx())
+
+    def enc_g1(pt):
+        if pt is None:
+            return bytes(64)
+        return pt[0].to_bytes(32, "big") + pt[1].to_bytes(32, "big")
+
+    def enc_g2(pt):
+        (xr, xi), (yr, yi) = pt
+        return b"".join(v.to_bytes(32, "big") for v in (xi, xr, yi, yr))
+
+    # ECADD: G1 + G1 == 2*G1
+    res = e.call(A, (6).to_bytes(20, "big"), 0,
+                 enc_g1(bn.G1) + enc_g1(bn.G1), 10_000)
+    assert res.success
+    assert res.output == enc_g1(bn.g1_mul(2, bn.G1))
+    # ECMUL: 7 * G1
+    res = e.call(A, (7).to_bytes(20, "big"), 0,
+                 enc_g1(bn.G1) + (7).to_bytes(32, "big"), 100_000)
+    assert res.success and res.output == enc_g1(bn.g1_mul(7, bn.G1))
+    # ECPAIRING: e(P,Q) * e(-P,Q) == 1 -> returns 1
+    neg_g1 = (bn.G1[0], (-bn.G1[1]) % bn.P)
+    data = (enc_g1(bn.G1) + enc_g2(bn.G2)
+            + enc_g1(neg_g1) + enc_g2(bn.G2))
+    res = e.call(A, (8).to_bytes(20, "big"), 0, data, 400_000)
+    assert res.success and int.from_bytes(res.output, "big") == 1
+    # an unbalanced pairing returns 0
+    res = e.call(A, (8).to_bytes(20, "big"), 0,
+                 enc_g1(bn.G1) + enc_g2(bn.G2), 400_000)
+    assert res.success and int.from_bytes(res.output, "big") == 0
+    # invalid point consumes the frame's gas (error semantics)
+    bad = (123).to_bytes(32, "big") + (45).to_bytes(32, "big") + bytes(64)
+    res = e.call(A, (6).to_bytes(20, "big"), 0, bad, 10_000)
+    assert not res.success
+
+
+def test_modexp_precompile():
+    """0x05 bigModExp (EIP-198; ref: core/vm/contracts.go bigModExp)."""
+    s = st()
+    e = EVM(s, BlockCtx())
+
+    def enc(base: int, exp: int, mod: int, bl=32, el=32, ml=32):
+        return (bl.to_bytes(32, "big") + el.to_bytes(32, "big")
+                + ml.to_bytes(32, "big") + base.to_bytes(bl, "big")
+                + exp.to_bytes(el, "big") + mod.to_bytes(ml, "big"))
+
+    res = e.call(A, (5).to_bytes(20, "big"), 0, enc(3, 200, 1000), 100_000)
+    assert res.success
+    assert int.from_bytes(res.output, "big") == pow(3, 200, 1000)
+    # zero modulus -> zero output; empty mod length -> empty output
+    res = e.call(A, (5).to_bytes(20, "big"), 0, enc(3, 5, 0), 100_000)
+    assert res.success and int.from_bytes(res.output, "big") == 0
+    res = e.call(A, (5).to_bytes(20, "big"), 0, enc(3, 5, 0, ml=0),
+                 100_000)
+    assert res.success and res.output == b""
+    # gas too small for a big exponent fails the frame
+    res = e.call(A, (5).to_bytes(20, "big"), 0,
+                 enc((1 << 255) | 1, (1 << 255) | 1, (1 << 255) | 1), 300)
+    assert not res.success

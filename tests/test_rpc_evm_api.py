@@ -1,0 +1,112 @@
+"""RPC EVM-surface tests: eth_call, estimateGas, getLogs, filters,
+gasPrice, getCode/getStorageAt, debug_* namespace (ref roles:
+internal/ethapi/api.go Call, eth/filters/, eth/gasprice/,
+internal/debug/api.go)."""
+
+import pytest
+
+from eges_tpu.core.chain import BlockChain, make_genesis
+from eges_tpu.core.state import contract_address
+from eges_tpu.core.types import Header, Transaction, new_block
+from eges_tpu.crypto import secp256k1 as secp
+from eges_tpu.rpc.server import RpcError, RpcServer
+
+PRIV = bytes([7]) * 32
+ADDR = secp.pubkey_to_address(secp.privkey_to_pubkey(PRIV))
+ETH = 10**18
+
+# runtime: counter at slot0 with a LOG1(topic=7) on each call
+# SLOAD(0) 1 ADD DUP1 SSTORE(0) MSTORE(0); LOG1(0,32,topic 7); RETURN 32
+RUNTIME = bytes.fromhex(
+    "600054600101806000556000526007602060" + "00a1" + "602060" + "00f3")
+INIT = (bytes([0x60, len(RUNTIME), 0x60, 0x0C, 0x60, 0x00, 0x39,
+               0x60, len(RUNTIME), 0x60, 0x00, 0xF3]) + RUNTIME)
+
+
+def _signed(nonce, to, payload=b"", gas=500_000, price=2):
+    t = Transaction(nonce=nonce, gas_price=price, gas_limit=gas, to=to,
+                    value=0, payload=payload)
+    return t.signed(PRIV)
+
+
+def _chain_with_contract():
+    chain = BlockChain(genesis=make_genesis(alloc={ADDR: 10 * ETH}),
+                       alloc={ADDR: 10 * ETH})
+    caddr = contract_address(ADDR, 0)
+    txs = [_signed(0, None, INIT), _signed(1, caddr), _signed(2, caddr)]
+    kept, root, rroot, gas, bloom = chain.execute_preview(txs, coinbase=bytes(20))
+    assert len(kept) == 3
+    head = chain.head()
+    blk = new_block(Header(parent_hash=head.hash, number=1,
+                           time=head.header.time + 1, root=root,
+                           receipt_hash=rroot, gas_used=gas,
+                           bloom=bloom), txs=kept)
+    assert chain.offer(blk), chain.last_error
+    return chain, caddr
+
+
+def test_eth_call_and_estimate_and_state_readers():
+    chain, caddr = _chain_with_contract()
+    rpc = RpcServer(chain)
+    # two on-chain calls happened: slot0 == 2
+    assert rpc.dispatch("eth_getStorageAt",
+                        ["0x" + caddr.hex(), "0x0"]).endswith("02")
+    assert rpc.dispatch("eth_getCode",
+                        ["0x" + caddr.hex()]) == "0x" + RUNTIME.hex()
+    # eth_call runs read-only: returns 3 without mutating the chain
+    out = rpc.dispatch("eth_call", [{"from": "0x" + ADDR.hex(),
+                                     "to": "0x" + caddr.hex()}])
+    assert int(out, 16) == 3
+    assert rpc.dispatch("eth_getStorageAt",
+                        ["0x" + caddr.hex(), "0x0"]).endswith("02")
+    gas = int(rpc.dispatch("eth_estimateGas",
+                           [{"from": "0x" + ADDR.hex(),
+                             "to": "0x" + caddr.hex()}]), 16)
+    assert gas > 20_000
+
+
+def test_get_logs_and_filters():
+    chain, caddr = _chain_with_contract()
+    rpc = RpcServer(chain)
+    logs = rpc.dispatch("eth_getLogs", [{"fromBlock": "0x0",
+                                         "toBlock": "0x1"}])
+    assert len(logs) == 2  # one per contract call
+    assert logs[0]["address"] == "0x" + caddr.hex()
+    topic7 = "0x" + (7).to_bytes(32, "big").hex()
+    assert logs[0]["topics"] == [topic7]
+    # topic filtering
+    assert rpc.dispatch("eth_getLogs", [{
+        "fromBlock": "0x0", "topics": [topic7]}]) == logs
+    assert rpc.dispatch("eth_getLogs", [{
+        "fromBlock": "0x0",
+        "topics": ["0x" + (8).to_bytes(32, "big").hex()]}]) == []
+    # address filtering
+    assert rpc.dispatch("eth_getLogs", [{
+        "fromBlock": "0x0", "address": "0x" + bytes(20).hex()}]) == []
+    # polling filters
+    fid = rpc.dispatch("eth_newFilter", [{"topics": [topic7]}])
+    assert rpc.dispatch("eth_getFilterChanges", [fid]) == []
+    bfid = rpc.dispatch("eth_newBlockFilter", [{}])
+    # a receipt lookup for a logging txn carries its logs
+    blk = chain.get_block_by_number(1)
+    rcpt = rpc.dispatch("eth_getTransactionReceipt",
+                        ["0x" + blk.transactions[1].hash.hex()])
+    assert rcpt["logs"] and rcpt["logs"][0]["topics"] == [topic7]
+    assert rpc.dispatch("eth_uninstallFilter", [fid]) is True
+    with pytest.raises(RpcError):
+        rpc.dispatch("eth_getFilterChanges", [fid])
+    assert rpc.dispatch("eth_uninstallFilter", [bfid]) is True
+
+
+def test_gas_price_oracle_and_debug():
+    chain, _ = _chain_with_contract()
+    rpc = RpcServer(chain)
+    assert int(rpc.dispatch("eth_gasPrice", []), 16) == 2  # median price
+    # debug namespace
+    assert rpc.dispatch("debug_startProfile", []) is True
+    report = rpc.dispatch("debug_stopProfile", [5])
+    assert "cumulative" in report or "function calls" in report
+    stacks = rpc.dispatch("debug_stacks", [])
+    assert "thread" in stacks
+    stats = rpc.dispatch("debug_stats", [])
+    assert stats["threads"] >= 1

@@ -74,7 +74,7 @@ def mk_chain(alloc):
 
 
 def block_with(chain, txs, coinbase=COINBASE):
-    kept, root, rroot, gas = chain.execute_preview(list(txs), coinbase)
+    kept, root, rroot, gas, bloom = chain.execute_preview(list(txs), coinbase)
     parent = chain.head()
     return new_block(Header(parent_hash=parent.hash,
                             number=parent.number + 1, coinbase=coinbase,
