@@ -114,6 +114,10 @@ class GeecNode:
         self._txn_seen: set[bytes] = set()
         self._sync_target = 0
         self._sync_progress = False
+        # fetched-ahead staging: certified blocks beyond the chain's
+        # out-of-order window wait here (the downloader queue role,
+        # ref: eth/downloader/queue.go — bounded, lowest numbers kept)
+        self._sync_stash: dict[int, Block] = {}
         self.geec_txn_sink = None  # app-layer callback for confirmed geec txns
         self.txpool = None  # optional TxPool; proposals drain it
 
@@ -934,6 +938,8 @@ class GeecNode:
 
     SYNC_BATCH = 128       # blocks per request (served cap matches)
     SYNC_MAX_STALL = 8     # fruitless retries before giving up
+    SYNC_FANOUT = 3        # concurrent ranged requests to distinct peers
+    SYNC_STASH_MAX = 2048  # fetched-ahead blocks held for the funnel
 
     def _request_backfill(self, target: int, start: int | None = None) -> None:
         """Start (or extend) a sync toward ``target``.
@@ -960,25 +966,42 @@ class GeecNode:
         elif retry >= self.SYNC_MAX_STALL:
             # no peer served anything across a full rotation: the target
             # is unreachable (e.g. a forged confirm number) — abandon it
+            # AND drop the fetched-ahead staging (unapplied peer-supplied
+            # blocks must not squat memory after the sync dies)
             self._cancel_timer("backfill")
             self._sync_target = 0
+            self._sync_stash.clear()
             return
         if start is None:
             # overlap a few blocks behind our head so the reply exposes
             # the fork point when our tail is locally-forced empties
             # (replace_suffix needs the anchor)
             start = max(1, height - 7)
-        count = max(min(self._sync_target - start + 1, self.SYNC_BATCH), 1)
-        req = M.BlockFetchReq(start=start, count=count,
-                              ip=self.cfg.consensus_ip,
-                              port=self.cfg.consensus_port)
-        peer = self._pick_sync_peer(retry)
-        if peer is not None and retry % 3 != 2:
-            self.transport.send_direct(
-                peer.ip, peer.port,
-                M.pack_direct(M.UDP_GET_BLOCKS, self.coinbase, req))
-        else:
-            self.transport.gossip(M.pack_gossip(M.GOSSIP_GET_BLOCKS, req))
+        # concurrent per-peer ranged fetch (the downloader's parallel
+        # queues, ref: eth/downloader/downloader.go fetchParts role):
+        # split the outstanding range into SYNC_FANOUT chunks and ask a
+        # DIFFERENT member peer for each; arrivals beyond the insert
+        # window stage in _sync_stash until the head catches up
+        for lane in range(self.SYNC_FANOUT):
+            lane_start = start + lane * self.SYNC_BATCH
+            if lane_start > self._sync_target:
+                break
+            count = max(min(self._sync_target - lane_start + 1,
+                            self.SYNC_BATCH), 1)
+            req = M.BlockFetchReq(start=lane_start, count=count,
+                                  ip=self.cfg.consensus_ip,
+                                  port=self.cfg.consensus_port)
+            peer = self._pick_sync_peer(retry + lane)
+            if peer is not None and retry % 3 != 2:
+                self.transport.send_direct(
+                    peer.ip, peer.port,
+                    M.pack_direct(M.UDP_GET_BLOCKS, self.coinbase, req))
+            elif lane == 0:
+                # every third rotation (or with no member peers) the
+                # first lane broadcasts instead — the gossip fallback
+                # for peers outside the membership
+                self.transport.gossip(
+                    M.pack_gossip(M.GOSSIP_GET_BLOCKS, req))
         self._set_timer("backfill", self.ccfg.validate_timeout_ms / 1e3,
                         lambda: self._sync_tick(None, retry + 1))
 
@@ -1091,8 +1114,29 @@ class GeecNode:
             if done:
                 self._sync_progress = True
         for b in blocks:
-            if self.chain.offer(b):
+            if b.number > self.chain.height() + 256:
+                # beyond the insert funnel's buffer window: stage it
+                # (concurrent lanes fetch ahead of the head)
+                if (len(self._sync_stash) < self.SYNC_STASH_MAX
+                        or b.number < max(self._sync_stash)):
+                    self._sync_stash[b.number] = b
+                    while len(self._sync_stash) > self.SYNC_STASH_MAX:
+                        del self._sync_stash[max(self._sync_stash)]
+            elif self.chain.offer(b):
                 self._sync_progress = True
+        # drain staged blocks that entered the window as the head moved
+        while self._sync_stash:
+            window_end = self.chain.height() + 256
+            ready = [n for n in self._sync_stash if n <= window_end]
+            if not ready:
+                break
+            progressed = False
+            for n in sorted(ready):
+                if self.chain.offer(self._sync_stash.pop(n)):
+                    progressed = True
+                    self._sync_progress = True
+            if not progressed:
+                break
         # continuation: more of the range outstanding -> next request now
         if (self._sync_progress
                 and self.chain.height() < getattr(self, "_sync_target", 0)):

@@ -348,6 +348,10 @@ def recover_senders(txns, verifier) -> list:
     return senders
 
 
+BLOCK_GAS_LIMIT = 30_000_000  # default block gas cap (params.GenesisGasLimit
+#                               role) — bounds adversarial EVM work per block
+
+
 def apply_txn(state: StateDB, txn, sender: bytes, coinbase: bytes,
               gas_so_far: int, *, ctx=None, verifier=None) -> Receipt:
     """Apply one signed transaction, mutating ``state``
@@ -385,6 +389,12 @@ def apply_txn(state: StateDB, txn, sender: bytes, coinbase: bytes,
     gas_limit = txn.gas_limit or intrinsic
     if gas_limit < intrinsic:
         raise StateError("intrinsic gas too low")
+    block_cap = (ctx.gas_limit if ctx is not None else 0) or BLOCK_GAS_LIMIT
+    if gas_so_far + gas_limit > block_cap:
+        # block gas limit bounds total EVM work per block (the liveness
+        # guard: without it a zero-price txn could stuff enough pairing
+        # calls to stall every validator past its timeouts)
+        raise StateError("exceeds block gas limit")
     upfront = gas_limit * txn.gas_price
     if acct.balance < txn.value + upfront:
         raise StateError("insufficient balance for value + fee")

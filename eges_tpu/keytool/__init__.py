@@ -1,0 +1,1 @@
+"""Key management CLI package (ref role: cmd/ethkey + geth account)."""

@@ -1,0 +1,75 @@
+"""``python -m eges_tpu.keytool`` — key management CLI.
+
+Role parity with ``geth account new/list`` and ``cmd/ethkey``
+(ref: cmd/geth/accountcmd.go, cmd/ethkey/main.go): create, list,
+inspect and sign with web3-v3 keystore files.
+"""
+
+from __future__ import annotations
+
+import argparse
+import getpass
+import sys
+
+from eges_tpu.crypto import secp256k1 as secp
+from eges_tpu.crypto.keccak import keccak256
+from eges_tpu.crypto.keystore import Keystore
+
+
+def _password(args) -> str:
+    if args.password is not None:
+        return args.password
+    return getpass.getpass("password: ")
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="eges-tpu-keytool")
+    p.add_argument("--keystore", default="./keystore")
+    p.add_argument("--password", default=None,
+                   help="password (prompted when omitted)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("new", help="create an account (geth account new)")
+    sub.add_parser("list", help="list accounts (geth account list)")
+    imp = sub.add_parser("import", help="import a raw hex private key")
+    imp.add_argument("privhex")
+    insp = sub.add_parser("inspect", help="show address/pubkey of a key "
+                                          "(ethkey inspect)")
+    insp.add_argument("address")
+    signp = sub.add_parser("sign", help="sign keccak256(message) "
+                                        "(ethkey signmessage)")
+    signp.add_argument("address")
+    signp.add_argument("message")
+    args = p.parse_args(argv)
+
+    ks = Keystore(args.keystore)
+    if args.cmd == "new":
+        addr = ks.new_account(_password(args))
+        print("0x" + addr.hex())
+    elif args.cmd == "list":
+        for i, a in enumerate(ks.accounts()):
+            print(f"Account #{i}: 0x{a.hex()}")
+    elif args.cmd == "import":
+        addr = ks.import_key(bytes.fromhex(args.privhex.removeprefix("0x")),
+                             _password(args))
+        print("0x" + addr.hex())
+    elif args.cmd == "inspect":
+        addr = bytes.fromhex(args.address.removeprefix("0x"))
+        priv = ks.get_key(addr, _password(args))
+        pub = secp.privkey_to_pubkey(priv)
+        print("Address:   0x" + addr.hex())
+        print("PublicKey: 0x04" + pub.hex())
+    elif args.cmd == "sign":
+        addr = bytes.fromhex(args.address.removeprefix("0x"))
+        priv = ks.get_key(addr, _password(args))
+        # geth's personal-message envelope so signatures interop
+        msg = args.message.encode()
+        env = b"\x19Ethereum Signed Message:\n" + str(len(msg)).encode() + msg
+        sig = secp.ecdsa_sign(keccak256(env), priv)
+        print("0x" + sig.hex())
+    else:  # pragma: no cover
+        p.error("unknown command")
+        sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()

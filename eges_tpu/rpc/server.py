@@ -310,6 +310,10 @@ class RpcServer:
 
         if runs(lo):
             return lo
+        if not runs(hi):
+            # even the cap cannot execute it as a txn (intrinsic tax +
+            # 63/64 rule); geth errors the same way
+            raise RpcError(-32000, "gas required exceeds allowance")
         while lo + 1 < hi:
             mid = (lo + hi) // 2
             if runs(mid):
@@ -424,20 +428,43 @@ class RpcServer:
         from_n, to_n, addresses, topics = self._parse_filter(obj)
         return self._logs_in_range(from_n, to_n, addresses, topics)
 
+    FILTER_TTL_S = 300.0   # unpolled filters expire (geth's 5-min timeout)
+    FILTER_MAX = 256       # hard cap on installed filters per node
+
+    def _expire_filters(self) -> None:
+        import time
+
+        now = time.monotonic()
+        for fid in [k for k, f in self._filters.items()
+                    if now - f["touched"] > self.FILTER_TTL_S]:
+            del self._filters[fid]
+        while len(self._filters) > self.FILTER_MAX:
+            oldest = min(self._filters, key=lambda k:
+                         self._filters[k]["touched"])
+            del self._filters[oldest]
+
     def _new_filter(self, method: str, obj: dict) -> str:
+        import time
+
+        self._expire_filters()
         self._filter_seq += 1
         fid = _hex(self._filter_seq)
         self._filters[fid] = {
             "kind": "logs" if method == "eth_newFilter" else "blocks",
             "obj": obj,
             "last": self.chain.height(),
+            "touched": time.monotonic(),
         }
         return fid
 
     def _filter_changes(self, fid: str):
+        import time
+
+        self._expire_filters()
         f = self._filters.get(fid)
         if f is None:
             raise RpcError(-32000, "filter not found")
+        f["touched"] = time.monotonic()
         h = self.chain.height()
         start, f["last"] = f["last"] + 1, h
         if start > h:

@@ -260,11 +260,11 @@ def test_bn256_precompiles():
     neg_g1 = (bn.G1[0], (-bn.G1[1]) % bn.P)
     data = (enc_g1(bn.G1) + enc_g2(bn.G2)
             + enc_g1(neg_g1) + enc_g2(bn.G2))
-    res = e.call(A, (8).to_bytes(20, "big"), 0, data, 400_000)
+    res = e.call(A, (8).to_bytes(20, "big"), 0, data, 2_000_000)
     assert res.success and int.from_bytes(res.output, "big") == 1
     # an unbalanced pairing returns 0
     res = e.call(A, (8).to_bytes(20, "big"), 0,
-                 enc_g1(bn.G1) + enc_g2(bn.G2), 400_000)
+                 enc_g1(bn.G1) + enc_g2(bn.G2), 2_000_000)
     assert res.success and int.from_bytes(res.output, "big") == 0
     # invalid point consumes the frame's gas (error semantics)
     bad = (123).to_bytes(32, "big") + (45).to_bytes(32, "big") + bytes(64)

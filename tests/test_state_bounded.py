@@ -1,0 +1,56 @@
+"""Memory-bound test for the state layer (round-2 verdict item 10's
+acceptance criterion): a 10k-account genesis driven for 5k blocks must
+keep snapshot memory bounded — overlays share structure, the trie is
+persistent, and pruning holds the snapshot count at _STATE_KEEP."""
+
+import pytest
+
+from eges_tpu.core.chain import BlockChain, make_genesis
+from eges_tpu.core.types import Header, Transaction, new_block
+from eges_tpu.crypto import secp256k1 as secp
+
+PRIV = bytes([5]) * 32
+ADDR = secp.pubkey_to_address(secp.privkey_to_pubkey(PRIV))
+ETH = 10**18
+
+
+@pytest.mark.slow
+def test_memory_bounded_10k_accounts_5k_blocks():
+    alloc = {bytes([i & 0xFF, i >> 8]) * 10: ETH for i in range(1, 10_000)}
+    alloc[ADDR] = 1000 * ETH
+    chain = BlockChain(genesis=make_genesis(alloc=alloc), alloc=alloc)
+
+    n_blocks = 5_000
+    for n in range(1, n_blocks + 1):
+        to = bytes([(n % 250) + 1, (n >> 8) & 0xFF]) * 10
+        t = Transaction(nonce=n - 1, gas_price=0, to=to,
+                        value=1).signed(PRIV)
+        kept, root, rroot, gas, bloom = chain.execute_preview([t])
+        parent = chain.head()
+        blk = new_block(Header(parent_hash=parent.hash, number=n,
+                               time=parent.header.time + 1, root=root,
+                               receipt_hash=rroot, gas_used=gas,
+                               bloom=bloom), txs=kept)
+        assert chain.offer(blk), chain.last_error
+
+    assert chain.height() == n_blocks
+    # snapshot count pruned to the keep window
+    assert len(chain._states) <= chain._STATE_KEEP + 64
+    # overlay sharing: retained snapshots hold only their own block's
+    # dirty accounts, NOT 10k-account copies.  Walk each snapshot's
+    # LOCAL dict only (the shared bases are counted once via id()).
+    seen = set()
+    total_entries = 0
+    for st in chain._states.values():
+        s = st
+        while s is not None and id(s) not in seen:
+            seen.add(id(s))
+            total_entries += len(s._local)
+            s = s._base
+    # each block dirties ~3 accounts (sender, recipient, coinbase);
+    # flattening every _MAX_DEPTH copies adds a full 10k snapshot per
+    # 48 blocks within the kept window (~21 of them) — still far from
+    # the unshared worst case of 1024 x 10k
+    assert total_entries < 500_000, total_entries
+    # spot-check state correctness after the run
+    assert chain.head_state().nonce(ADDR) == n_blocks

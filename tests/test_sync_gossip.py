@@ -97,3 +97,25 @@ def test_rpc_state_methods():
     assert rcpt is not None and rcpt["status"] == "0x1"
     assert int(rcpt["gasUsed"], 16) == INTRINSIC_GAS
     assert rpc.dispatch("eth_getTransactionReceipt", ["0x" + "ab" * 32]) is None
+
+
+def test_concurrent_lanes_fill_stash_and_catch_up():
+    """A node 400+ blocks behind issues multiple concurrent ranged
+    requests (downloader fetchParts role); fetched-ahead blocks stage in
+    the sync stash until the insert window reaches them."""
+    c = SimCluster(4, txn_per_block=1, seed=17, mine=[True, True, True,
+                                                      False])
+    c.net.partition("node3")
+    c.start()
+    c.run(60, stop_condition=lambda: min(
+        sn.chain.height() for sn in c.nodes[:3]) >= 400)
+    assert min(sn.chain.height() for sn in c.nodes[:3]) >= 400
+    late = c.nodes[3].node
+    assert c.nodes[3].chain.height() == 0
+    c.net.heal("node3")
+    # the next confirm gossip triggers sync with SYNC_FANOUT lanes
+    c.run(30, stop_condition=lambda: c.nodes[3].chain.height()
+          >= c.nodes[0].chain.height() - 2)
+    assert c.nodes[3].chain.height() >= c.nodes[0].chain.height() - 2
+    # the staging buffer emptied once the head caught up
+    assert not late._sync_stash
