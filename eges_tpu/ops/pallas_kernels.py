@@ -1,0 +1,129 @@
+"""Pallas TPU kernels for the bignum hot loop (SURVEY §7 step 1:
+"secp256k1 batch ops as JAX/Pallas kernels").
+
+The XLA graph form of the verifier (ops/bigint.py, ops/ec.py) already
+keeps everything fused on-device; these kernels are the next rung —
+hand-placed VMEM tiles for the single hottest primitive, the F_P
+modular multiply, which the Strauss ladder executes ~4000x per
+recovered signature.
+
+Layout: the graph stores a field element as ``[B, 16]`` u32 limbs
+(rows on sublanes).  The kernel TRANSPOSES to ``[16, B]`` — 16 limbs
+land exactly on a float32-tile's 8x128 sublane granularity (two
+sublanes of 8) and the batch rides the 128-wide lane axis, so every
+limb row is one natural VPU vector.  The schoolbook product unrolls
+256 mul-adds over Python-static sublane indices; the pseudo-Mersenne
+reduction mirrors ``FieldP._reduce_cols`` bit-for-bit (same fold
+constants, same carry chains), so kernel and graph agree exactly.
+
+The kernel is opt-in (`EGES_TPU_PALLAS=1` or ``use_pallas=True``
+callers) and falls back to the jnp path off-TPU; correctness is pinned
+by a differential test in interpret mode (tests/test_pallas_kernels.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from eges_tpu.ops.bigint import MASK, NLIMBS
+
+LANE_BLOCK = 256  # batch columns per kernel invocation
+
+
+def _fp_mul_kernel(a_ref, b_ref, out_ref):
+    """One [16, LANE_BLOCK] tile: out = a * b mod P (relaxed form).
+
+    Mirrors ``big_mul_cols`` + ``FieldP._reduce_cols``: column sums of
+    the 16x16 limb products (anti-diagonal accumulation), two
+    delta-folds of the high columns (delta_P = 2^32 + 977), two full
+    carry chains and the closing 5-step mini-chain.
+    """
+    a = a_ref[:, :]  # [16, B]
+    b = b_ref[:, :]
+    mask = jnp.uint32(MASK)
+
+    # schoolbook columns: cols[k] = sum_{i+j=k} lo(a_i b_j)
+    #                             + sum_{i+j=k-1} hi(a_i b_j)   (< 2^21)
+    zero = jnp.zeros_like(a[0])
+    cols = [zero] * 32
+    for i in range(NLIMBS):
+        ai = a[i]
+        for j in range(NLIMBS):
+            p = ai * b[j]
+            cols[i + j] = cols[i + j] + (p & mask)
+            cols[i + j + 1] = cols[i + j + 1] + (p >> 16)
+
+    # fold 1: columns 16..31 via delta = 2^32 + 977  (w = 18 wide)
+    c977 = jnp.uint32(977)
+    for _ in range(2):
+        w = len(cols)
+        if w <= 16:
+            break
+        hi = cols[16:]
+        lo = cols[:16] + [zero] * max(0, len(hi) + 2 - 16)
+        for j, h in enumerate(hi):
+            lo[j] = lo[j] + h * c977
+            lo[j + 2] = lo[j + 2] + h
+        cols = lo[: max(16, len(hi) + 2)]
+
+    # first full carry
+    out = []
+    carry = zero
+    for k in range(16):
+        t = cols[k] + carry
+        out.append(t & mask)
+        carry = t >> 16
+    out[0] = out[0] + carry * c977
+    out[2] = out[2] + carry
+    # second full carry
+    carry = zero
+    for k in range(16):
+        t = out[k] + carry
+        out[k] = t & mask
+        carry = t >> 16
+    out[0] = out[0] + carry * c977
+    out[2] = out[2] + carry
+    # closing mini-chain
+    carry = zero
+    for k in range(5):
+        t = out[k] + carry
+        out[k] = t & mask
+        carry = t >> 16
+
+    for k in range(16):
+        out_ref[k, :] = out[k]
+
+
+def fp_mul_pallas(a: jnp.ndarray, b: jnp.ndarray, *,
+                  interpret: bool | None = None) -> jnp.ndarray:
+    """``[B, 16] x [B, 16] -> [B, 16]`` F_P multiply via the Pallas
+    kernel; bit-identical to ``bigint.FP.mul`` (relaxed outputs)."""
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B = a.shape[0]
+    pad = (-B) % LANE_BLOCK
+    at = jnp.pad(a, ((0, pad), (0, 0))).T  # [16, B+pad]
+    bt = jnp.pad(b, ((0, pad), (0, 0))).T
+    n_blocks = at.shape[1] // LANE_BLOCK
+
+    out = pl.pallas_call(
+        _fp_mul_kernel,
+        out_shape=jax.ShapeDtypeStruct(at.shape, jnp.uint32),
+        grid=(n_blocks,),
+        in_specs=[pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i)),
+                  pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i)),
+        interpret=interpret,
+    )(at, bt)
+    return out.T[:B]
+
+
+def pallas_enabled() -> bool:
+    """Opt-in switch for routing FP.mul through the kernel on TPU."""
+    return os.environ.get("EGES_TPU_PALLAS", "") == "1"
