@@ -192,7 +192,9 @@ class NodeService:
         if self.txn_service is not None:
             await self.txn_service.start()
         if self.rpc is not None:
-            await self.rpc.start()
+            # HTTP + the geth.ipc-convention unix socket in the datadir
+            await self.rpc.start(
+                ipc_path=os.path.join(self.cfg.datadir, "geec.ipc"))
         # give gossip dials a moment, like the reference's block-1 grace
         # sleep (consensus/geec/geec.go:296)
         await asyncio.sleep(1.0)

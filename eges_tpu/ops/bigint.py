@@ -387,6 +387,12 @@ class FieldP(Mod):
         # constant for branchless subtraction: a - b ≡
         #   a + (0xFFFF - b) + (2^256 - 2*delta + 1)  (mod P), see sub()
         self._subc_np = int_to_limbs((1 << 256) - 2 * ((1 << 256) - P) + 1)
+        # EGES_TPU_PALLAS=1 routes equal-shape batched multiplies through
+        # the hand-tiled Pallas kernel (ops/pallas_kernels.py) — a
+        # measurement hook for TPU A/B runs, not a default (per-mul
+        # pallas_call boundaries forgo XLA fusion between field ops)
+        import os as _os
+        self._use_pallas = _os.environ.get("EGES_TPU_PALLAS", "") == "1"
 
     # -- the shared reduction tail ---------------------------------------
 
@@ -444,6 +450,11 @@ class FieldP(Mod):
     # -- relaxed ops ------------------------------------------------------
 
     def mul(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        if self._use_pallas and a.ndim >= 2 and a.shape == b.shape:
+            from eges_tpu.ops.pallas_kernels import fp_mul_pallas
+            flat = fp_mul_pallas(a.reshape(-1, NLIMBS),
+                                 b.reshape(-1, NLIMBS))
+            return flat.reshape(a.shape)
         return self._reduce_cols(big_mul_cols(a, b))
 
     def sqr(self, a: jnp.ndarray) -> jnp.ndarray:

@@ -125,5 +125,7 @@ def fp_mul_pallas(a: jnp.ndarray, b: jnp.ndarray, *,
 
 
 def pallas_enabled() -> bool:
-    """Opt-in switch for routing FP.mul through the kernel on TPU."""
+    """Opt-in switch: ``EGES_TPU_PALLAS=1`` at import time routes
+    ``FP.mul`` on 2-D batches through the kernel (see
+    ``bigint.FieldP.mul``'s dispatch)."""
     return os.environ.get("EGES_TPU_PALLAS", "") == "1"

@@ -7,11 +7,19 @@ the Geec path, plus the ``thw`` namespace the engine registers
 asyncio streams — no external web framework, single event loop shared
 with the consensus node.
 
+Transports: HTTP (keep-alive, batch requests) and a geth.ipc-style
+unix socket (newline-delimited JSON).
+
 Methods:
   eth_blockNumber, eth_getBlockByNumber, eth_getBlockByHash,
   eth_getBalance, eth_getTransactionCount, eth_getTransactionReceipt,
-  eth_sendRawTransaction, net_version, web3_clientVersion,
-  thw_register, thw_membership, thw_status, thw_pendingGeecTxns
+  eth_getCode, eth_getStorageAt, eth_call, eth_estimateGas,
+  eth_gasPrice, eth_getLogs, eth_newFilter, eth_newBlockFilter,
+  eth_getFilterChanges, eth_uninstallFilter, eth_sendRawTransaction,
+  net_version, web3_clientVersion,
+  thw_register, thw_membership, thw_status, thw_pendingGeecTxns,
+  thw_metrics, debug_startProfile, debug_stopProfile, debug_stacks,
+  debug_stats
 """
 
 from __future__ import annotations
@@ -560,10 +568,69 @@ class RpcServer:
         finally:
             writer.close()
 
-    async def start(self) -> None:
+    IPC_LIMIT = 16 * 1024 * 1024  # max request line (large raw txns)
+
+    async def _handle_ipc(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        """IPC framing: newline-delimited raw JSON-RPC (no HTTP
+        envelope), matching geth's geth.ipc convention."""
+        try:
+            while True:
+                try:
+                    line = await reader.readline()
+                except ValueError:
+                    # over-limit request: answer with a JSON-RPC error
+                    # instead of silently dropping the connection
+                    writer.write(json.dumps({
+                        "jsonrpc": "2.0", "id": None,
+                        "error": {"code": -32600,
+                                  "message": "request too large"},
+                    }).encode() + b"\n")
+                    await writer.drain()
+                    break
+                if not line:
+                    break
+                writer.write(self._handle_body(line) + b"\n")
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    async def start(self, ipc_path: str | None = None) -> None:
         self._server = await asyncio.start_server(
             self._handle_conn, self.bind_ip, self.port)
+        if ipc_path:
+            import os
+            import socket as _socket
+            if os.path.exists(ipc_path):
+                # refuse to sever a LIVE endpoint (a second node on the
+                # same datadir); only clear stale leftover sockets
+                probe = _socket.socket(_socket.AF_UNIX)
+                try:
+                    probe.settimeout(0.5)
+                    probe.connect(ipc_path)
+                    probe.close()
+                    raise RpcError(
+                        -32000, f"ipc endpoint {ipc_path} is in use "
+                                "(another node on this datadir?)")
+                except (ConnectionRefusedError, FileNotFoundError, OSError):
+                    probe.close()
+                    try:
+                        os.unlink(ipc_path)
+                    except FileNotFoundError:
+                        pass
+            self._ipc_server = await asyncio.start_unix_server(
+                self._handle_ipc, path=ipc_path, limit=self.IPC_LIMIT)
+            self._ipc_path = ipc_path
 
     def close(self) -> None:
         if self._server is not None:
             self._server.close()
+        if getattr(self, "_ipc_server", None) is not None:
+            self._ipc_server.close()
+            import os
+            try:
+                os.unlink(self._ipc_path)
+            except OSError:
+                pass

@@ -1,0 +1,45 @@
+"""Aggregate-signature scheme tests (BASELINE config-5 stretch: one
+pairing check for a whole ACK quorum)."""
+
+import pytest
+
+from eges_tpu.crypto import aggsig
+from eges_tpu.crypto import bn254 as bn
+
+
+def test_single_sign_verify_and_reject():
+    sk, pk = aggsig.keygen(b"node-a")
+    sig = aggsig.sign(sk, b"block 7 ack")
+    assert aggsig.verify(pk, b"block 7 ack", sig)
+    assert not aggsig.verify(pk, b"block 8 ack", sig)
+    sk2, pk2 = aggsig.keygen(b"node-b")
+    assert not aggsig.verify(pk2, b"block 7 ack", sig)
+
+
+@pytest.mark.slow
+def test_aggregate_quorum_verifies_in_one_check():
+    quorum = []
+    sigs = []
+    for i in range(5):
+        sk, pk = aggsig.keygen(bytes([i + 1]))
+        msg = b"ack block 9 from voter %d" % i
+        quorum.append((pk, msg))
+        sigs.append(aggsig.sign(sk, msg))
+    asig = aggsig.aggregate(sigs)
+    assert aggsig.verify_aggregate(quorum, asig)
+    # a single forged vote breaks the aggregate
+    bad = list(quorum)
+    bad[2] = (bad[2][0], b"ack block 999")
+    assert not aggsig.verify_aggregate(bad, asig)
+    # dropping a signer breaks it too
+    assert not aggsig.verify_aggregate(quorum[:-1],
+                                       aggsig.aggregate(sigs))
+    # duplicate messages are refused (distinct-message rule)
+    dup = quorum[:-1] + [quorum[0]]
+    assert not aggsig.verify_aggregate(dup, asig)
+
+
+def test_hash_to_g1_points_on_curve():
+    for i in range(8):
+        pt = aggsig.hash_to_g1(bytes([i]) * 3)
+        assert bn.g1_is_on_curve(pt)

@@ -44,3 +44,44 @@ def test_console_attaches_and_queries():
     assert debug.stats()["threads"] >= 1
 
     loop_box["loop"].call_soon_threadsafe(loop_box["loop"].stop)
+
+
+def test_ipc_endpoint_serves_jsonrpc(tmp_path):
+    """The geth.ipc-convention unix socket speaks newline-delimited
+    JSON-RPC (ref: rpc/ipc.go role)."""
+    import json
+    import socket
+
+    chain = BlockChain(genesis=make_genesis())
+    ipc = str(tmp_path / "geec.ipc")
+    ready = threading.Event()
+    loop_box = {}
+
+    def serve():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        loop_box["loop"] = loop
+        rpc = RpcServer(chain, port=0)
+        loop.run_until_complete(rpc.start(ipc_path=ipc))
+        ready.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    assert ready.wait(10)
+
+    s = socket.socket(socket.AF_UNIX)
+    s.settimeout(10)
+    s.connect(ipc)
+    s.sendall(json.dumps({"jsonrpc": "2.0", "id": 1,
+                          "method": "eth_blockNumber",
+                          "params": []}).encode() + b"\n")
+    line = b""
+    while not line.endswith(b"\n"):
+        chunk = s.recv(4096)
+        assert chunk, f"server closed early; got {line!r}"
+        line += chunk
+    out = json.loads(line)
+    assert out["result"] == "0x0"
+    s.close()
+    loop_box["loop"].call_soon_threadsafe(loop_box["loop"].stop)
