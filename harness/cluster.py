@@ -339,7 +339,9 @@ def node_heights(dirpath: str) -> list[int]:
     """Log-grep liveness oracle (ref: grep.py + test-sep-2.sh)."""
     heights = []
     for name in sorted(os.listdir(dirpath)):
-        if not name.endswith(".log"):
+        # node logs only — bootnode.log has no head lines and must not
+        # drag a -1 into the liveness check
+        if not (name.startswith("node") and name.endswith(".log")):
             continue
         h = -1
         with open(os.path.join(dirpath, name), "rb") as f:
