@@ -399,10 +399,56 @@ def synctest(dirpath: str, n: int, seconds: float, **kw) -> bool:
         kill_cluster(dirpath)
 
 
+def loadtest(dirpath: str, n: int, seconds: float, *, n_udp=300,
+             **kw) -> bool:
+    """End-to-end load: UDP geec txns (Geec_Client role) + a signed RPC
+    txn, asserted on-chain via the RPC surface (the reference drives
+    this manually with Geec_Client + log greps; automated here)."""
+    import json
+    import socket
+    import urllib.request
+
+    from eges_tpu.core.types import Transaction
+
+    def rpc(method, params, port=RPC_BASE):
+        body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                           "params": params}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}", data=body,
+            headers={"Content-Type": "application/json"})
+        return json.loads(
+            urllib.request.urlopen(req, timeout=10).read())["result"]
+
+    start_cluster(dirpath, n, **kw)
+    try:
+        time.sleep(12)
+        t = Transaction(nonce=0, gas_price=0, gas_limit=21_000,
+                        to=bytes(20), value=0).signed(node_key(0))
+        txh = rpc("eth_sendRawTransaction", ["0x" + t.encode().hex()])
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        for i in range(n_udp):
+            s.sendto(b"load payload %d" % i, ("127.0.0.1", TXN_BASE))
+            time.sleep(0.005)
+        time.sleep(min(8.0, seconds))
+        rec = rpc("eth_getTransactionReceipt", [txh])
+        h = int(rpc("eth_blockNumber", []), 16)
+        geec_total = sum(
+            rpc("eth_getBlockByNumber", [hex(b), False])["geecTxnCount"]
+            for b in range(1, h + 1))
+        share = rpc("thw_metrics", []).get("verifier.device_share")
+        print(f"[loadtest] height={h} geec_on_chain={geec_total}/{n_udp} "
+              f"signed_mined={(rec or {}).get('status') == '0x1'} "
+              f"device_share={share}")
+        return (rec is not None and rec.get("status") == "0x1"
+                and geec_total >= int(n_udp * 0.8))
+    finally:
+        kill_cluster(dirpath)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("cmd", choices=["start", "kill", "status", "soak",
-                                    "restart", "synctest"])
+                                    "restart", "synctest", "loadtest"])
     ap.add_argument("--dir", required=True)
     ap.add_argument("--nodes", type=int, default=3)
     ap.add_argument("--seconds", type=float, default=60)
@@ -434,6 +480,10 @@ def main() -> None:
     elif args.cmd == "synctest":
         ok = synctest(args.dir, args.nodes, args.seconds, **kw)
         print("SYNCTEST", "PASS" if ok else "FAIL")
+        sys.exit(0 if ok else 1)
+    elif args.cmd == "loadtest":
+        ok = loadtest(args.dir, args.nodes, args.seconds, **kw)
+        print("LOADTEST", "PASS" if ok else "FAIL")
         sys.exit(0 if ok else 1)
 
 
