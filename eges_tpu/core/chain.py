@@ -41,6 +41,12 @@ class MemoryStore:
         self._by_hash: dict[bytes, bytes] = {}
         self._hash_by_number: dict[int, bytes] = {}
         self._head: bytes | None = None
+        # durable-lookup roles (ref: core/database_util.go
+        # WriteReceipts + WriteTxLookupEntries): receipts by block hash,
+        # txn hash -> block number — never pruned, unlike the chain's
+        # in-memory state window
+        self._receipts: dict[bytes, list[bytes]] = {}
+        self._tx_loc: dict[bytes, int] = {}
 
     def put_block(self, block: Block) -> None:
         raw = block.encode()
@@ -60,6 +66,18 @@ class MemoryStore:
 
     def get_head(self) -> bytes | None:
         return self._head
+
+    def put_receipts(self, block_hash: bytes, encoded: list[bytes],
+                     tx_locs) -> None:
+        self._receipts[block_hash] = list(encoded)
+        for th, n in tx_locs:
+            self._tx_loc[th] = n
+
+    def get_receipts(self, block_hash: bytes) -> list[bytes] | None:
+        return self._receipts.get(block_hash)
+
+    def tx_loc(self, txn_hash: bytes) -> int | None:
+        return self._tx_loc.get(txn_hash)
 
     def close(self) -> None:
         pass
@@ -83,7 +101,9 @@ class FileStore(MemoryStore):
         self._log_path = os.path.join(path, "blocks.log")
         self._head_path = os.path.join(path, "HEAD")
         self._replay()
+        self._replay_receipts()
         self._log = open(self._log_path, "ab")
+        self._rlog = open(os.path.join(path, "receipts.log"), "ab")
 
     def _replay(self) -> None:
         if not os.path.exists(self._log_path):
@@ -131,8 +151,52 @@ class FileStore(MemoryStore):
             f.write(h)
         os.replace(tmp, self._head_path)
 
+    def put_receipts(self, block_hash: bytes, encoded: list[bytes],
+                     tx_locs) -> None:
+        """Durable receipts + txn-lookup entries (the LevelDB
+        WriteReceipts/WriteTxLookupEntries role) — an append-only
+        sidecar log so historical receipts survive the in-memory state
+        window AND restarts.  Non-fsynced: derived data, rebuilt from
+        block replay if a tail is torn."""
+        if block_hash in self._receipts:
+            return
+        rec = rlp.encode([block_hash, list(encoded),
+                          [[th, n] for th, n in tx_locs]])
+        self._rlog.write(struct.pack("<I", len(rec)) + rec)
+        self._rlog.flush()
+        super().put_receipts(block_hash, encoded, tx_locs)
+
+    def _replay_receipts(self) -> None:
+        path = os.path.join(self._dir, "receipts.log")
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        good_end = 0
+        while pos + 4 <= len(data):
+            (n,) = struct.unpack("<I", data[pos : pos + 4])
+            if pos + 4 + n > len(data):
+                break  # torn tail
+            try:
+                bh, encoded, locs = rlp.decode(data[pos + 4 : pos + 4 + n])
+            except Exception:
+                break
+            super().put_receipts(
+                bytes(bh), [bytes(e) for e in encoded],
+                [(bytes(th), rlp.decode_uint(num)) for th, num in locs])
+            pos += 4 + n
+            good_end = pos
+        if good_end != len(data):
+            # truncate the tear (mirror _replay): appends after a torn
+            # record would be unreadable forever, and each restart would
+            # re-append the whole post-tear suffix unboundedly
+            with open(path, "r+b") as f:
+                f.truncate(good_end)
+
     def close(self) -> None:
         self._log.close()
+        self._rlog.close()
 
 
 def make_genesis(extra: bytes = b"geec-genesis", time: int = 0,
@@ -221,7 +285,7 @@ class BlockChain:
             parent_state = self._states[blk.header.parent_hash]
             state, receipts, _ = self._process(blk, parent_state)
             self._remember_state(blk.hash, n, state, receipts)
-            self._index_txns(blk)
+            self._index_txns(blk, receipts)
 
     # -- reads ------------------------------------------------------------
 
@@ -321,7 +385,7 @@ class BlockChain:
                 for th in self._txs_by_height.pop(n):
                     self._tx_index.pop(th, None)
 
-    def _index_txns(self, block: Block) -> None:
+    def _index_txns(self, block: Block, receipts=()) -> None:
         if not block.transactions:
             return
         hashes = []
@@ -329,11 +393,27 @@ class BlockChain:
             self._tx_index[t.hash] = (block.number, i)
             hashes.append(t.hash)
         self._txs_by_height[block.number] = hashes
+        # durable sidecar (the LevelDB receipts + tx-lookup role): the
+        # in-memory window prunes, the store does not
+        self.store.put_receipts(block.hash, [r.encode() for r in receipts],
+                                [(h, block.number) for h in hashes])
 
     def lookup_txn(self, txn_hash: bytes):
-        """``(block, index, receipt) | None`` via the txn index."""
+        """``(block, index, receipt) | None`` via the txn index, falling
+        back to the store for history outside the in-memory window."""
         loc = self._tx_index.get(txn_hash)
         if loc is None:
+            n = self.store.tx_loc(txn_hash)
+            if n is None:
+                return None
+            blk = self.get_block_by_number(n)
+            if blk is None:
+                return None
+            for i, t in enumerate(blk.transactions):
+                if t.hash == txn_hash:
+                    receipts = self.receipts_of(blk.hash)
+                    return blk, i, (receipts[i] if i < len(receipts)
+                                    else None)
             return None
         n, i = loc
         blk = self.get_block_by_number(n)
@@ -352,7 +432,15 @@ class BlockChain:
         return self._states[self._head.hash]
 
     def receipts_of(self, block_hash: bytes) -> tuple:
-        return self._receipts.get(block_hash, ())
+        got = self._receipts.get(block_hash)
+        if got is not None:
+            return got
+        # outside the pruned window: the durable sidecar still has them
+        stored = self.store.get_receipts(block_hash)
+        if stored is None:
+            return ()
+        from eges_tpu.core.state import Receipt
+        return tuple(Receipt.from_rlp(rlp.decode(e)) for e in stored)
 
     def execute_preview(self, txs, coinbase: bytes = bytes(20),
                         ctx=None) -> tuple:
@@ -532,7 +620,7 @@ class BlockChain:
         self.store.set_head(block.hash)
         self._head = block
         self._remember_state(block.hash, block.number, state, receipts)
-        self._index_txns(block)
+        self._index_txns(block, receipts)
         metrics.timer("chain.insert").update(time.monotonic() - t0)
         metrics.counter("chain.blocks").inc()
         metrics.counter("chain.txns").inc(len(block.transactions))
