@@ -185,3 +185,20 @@ def test_ecdsa_verify_point(rng):
     z_bad = jnp.asarray(np.roll(np.asarray(z), 1, axis=0))
     ok = np.asarray(fn(z_bad, r, s_, qx, qy))
     assert ok.tolist() == [0] * n
+
+
+@pytest.mark.slow
+def test_glv_ladder_matches_plain_ladder(rng):
+    """The GLV-split ladder must agree with the plain 64-window Strauss
+    ladder (kept as the in-repo reference implementation) bit-for-bit
+    after normalization."""
+    ks = _rand_scalars(6, rng)
+    us = _rand_scalars(6, rng)
+    pts = [host.point_mul(k, host.G) for k in _rand_scalars(6, rng)]
+    px, py = _points_to_limbs(pts)
+    u1 = jnp.asarray(np.stack([int_to_limbs(k) for k in ks]))
+    u2 = jnp.asarray(np.stack([int_to_limbs(u) for u in us]))
+    glv = ec.to_affine(ec.strauss_gR(u1, u2, px, py))
+    plain = ec.to_affine(ec.strauss_gR_plain(u1, u2, px, py))
+    for a, b in zip(glv, plain):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
