@@ -210,6 +210,7 @@ class DiscoveryClient:
     async def _loop(self) -> None:
         import secrets as _secrets
 
+        rounds = 0
         while True:
             gip, gport, cip, cport = self.endpoint
             ann = encode_announce(self.priv, self.pub, gip, gport, cip, cport)
@@ -220,7 +221,12 @@ class DiscoveryClient:
                     self._transport.sendto(query, bn)
                 except Exception:
                     pass
-            await asyncio.sleep(self.interval_s)
+            rounds += 1
+            # fast-start: tight announce/lookup rounds until the mesh
+            # forms (peers only learn each other after BOTH have
+            # announced — a cold cluster on the steady cadence would
+            # take ~interval_s to converge), then settle down
+            await asyncio.sleep(1.0 if rounds < 8 else self.interval_s)
 
     def close(self) -> None:
         if self._task is not None:

@@ -421,7 +421,14 @@ def loadtest(dirpath: str, n: int, seconds: float, *, n_udp=300,
 
     start_cluster(dirpath, n, **kw)
     try:
-        time.sleep(12)
+        # wait for chain liveness first (discovery-mode clusters take a
+        # few seconds longer to form the mesh than static peer lists)
+        deadline = time.time() + max(45.0, seconds)
+        while time.time() < deadline:
+            time.sleep(3)
+            hs = node_heights(dirpath)
+            if hs and min(hs) >= 1:
+                break
         t = Transaction(nonce=0, gas_price=0, gas_limit=21_000,
                         to=bytes(20), value=0).signed(node_key(0))
         txh = rpc("eth_sendRawTransaction", ["0x" + t.encode().hex()])
