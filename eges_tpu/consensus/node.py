@@ -877,12 +877,14 @@ class GeecNode:
     _TXN_SEEN_CAP = 1 << 16
 
     def submit_txns(self, txns) -> None:
-        """Local ingress (RPC eth_sendRawTransaction): admit to our pool;
-        admitted txns are broadcast via the pool's admission hook."""
+        """Local ingress (RPC eth_sendRawTransaction): admit to our pool
+        via the journaled local path (they survive a restart, ref:
+        core/tx_pool.go journal); admitted txns are broadcast via the
+        pool's admission hook."""
         txns = list(txns)
         if self.txpool is not None:
             self._ensure_pool_relay()
-            self.txpool.add_remotes(txns)
+            self.txpool.add_locals(txns)
         else:
             self.broadcast_txns(txns)
 

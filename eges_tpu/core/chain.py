@@ -312,6 +312,12 @@ class BlockChain:
         NotifyNewBlock hook (ref: core/blockchain.go:526-527)."""
         self._listeners.append(fn)
 
+    def remove_listener(self, fn) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
+
     # -- verification -----------------------------------------------------
 
     def _verify_header(self, header: Header) -> None:

@@ -24,13 +24,19 @@ from eges_tpu.core.types import Transaction
 class TxPool:
     def __init__(self, clock, verifier=None, *, window_ms: float = 5.0,
                  max_batch: int = 1024, max_pending: int = 100_000,
-                 on_admitted=None):
+                 on_admitted=None, journal_path: str | None = None):
         self.clock = clock
         self.verifier = verifier
         self.window_ms = window_ms
         self.max_batch = max_batch
         self.max_pending = max_pending
         self.on_admitted = on_admitted
+        # local-txn journal (ref: core/tx_pool.go journal — locally
+        # submitted txns survive a restart): append-only RLP records,
+        # rotated to the still-pending set when it grows stale
+        self.journal_path = journal_path
+        self._journal = None
+        self._journal_count = 0
         # sender -> {nonce -> txn}; admission order preserved separately
         # as (sender, txn) so selection never rescans the whole pool
         self.pending: dict[bytes, dict[int, Transaction]] = {}
@@ -218,6 +224,94 @@ class TxPool:
     def remove_included(self, txns) -> None:
         """Drop txns included in a canonical block."""
         self._evict(txns)
+        if (self.journal_path and
+                self._journal_count > max(64, 4 * len(self._by_hash))):
+            self._rotate_journal()
+
+    # -- local-txn journal (ref: core/tx_pool.go newTxJournal) ------------
+
+    def add_locals(self, txns) -> None:
+        """Admit locally-submitted txns AND journal them so they survive
+        a node restart (remote gossip txns are not journaled).  Only
+        FRESH txns journal — resubmitting the same txn N times must not
+        grow the file — and a journal that outgrows the live pool 4x
+        rotates even on a quiet chain."""
+        fresh = [t for t in txns if t.hash not in self._known]
+        if self.journal_path and fresh:
+            import struct
+
+            if self._journal is None:
+                self._journal = open(self.journal_path, "ab")
+            for t in fresh:
+                raw = t.encode()
+                self._journal.write(struct.pack("<I", len(raw)) + raw)
+                self._journal_count += 1
+            self._journal.flush()
+            if self._journal_count > max(64, 4 * (len(self._by_hash)
+                                                  + len(fresh))):
+                self._rotate_journal()
+        self.add_remotes(txns)
+
+    def load_journal(self) -> int:
+        """Re-queue journaled local txns (stale nonces fall out at
+        selection); returns how many were loaded.  A torn tail is
+        repaired by rewriting the parsed prefix — otherwise every
+        append after the tear would be unreadable forever."""
+        import os
+        import struct
+
+        if not self.journal_path or not os.path.exists(self.journal_path):
+            return 0
+        with open(self.journal_path, "rb") as f:
+            data = f.read()
+        txns = []
+        pos = 0
+        good_end = 0
+        while pos + 4 <= len(data):
+            (n,) = struct.unpack("<I", data[pos : pos + 4])
+            if pos + 4 + n > len(data):
+                break  # torn tail
+            try:
+                txns.append(Transaction.decode(data[pos + 4 : pos + 4 + n]))
+            except Exception:
+                break
+            pos += 4 + n
+            good_end = pos
+        if good_end != len(data):
+            with open(self.journal_path, "r+b") as f:
+                f.truncate(good_end)
+        self._journal_count = len(txns)
+        if txns:
+            self.add_remotes(txns)
+            self._flush()
+        return len(txns)
+
+    def _rotate_journal(self) -> None:
+        """Rewrite the journal with the still-pending set (a superset of
+        the locals — geth rotates locals only; re-journaling a remote is
+        harmless and keeps the rotation logic index-free)."""
+        import os
+        import struct
+
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+        tmp = self.journal_path + ".tmp"
+        kept = 0
+        with open(tmp, "wb") as f:
+            for s, t in self._order:
+                if t.hash in self._dead or t.hash not in self._by_hash:
+                    continue
+                raw = t.encode()
+                f.write(struct.pack("<I", len(raw)) + raw)
+                kept += 1
+        os.replace(tmp, self.journal_path)
+        self._journal_count = kept
+
+    def close(self) -> None:
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
 
     def __len__(self) -> int:
         return len(self._by_hash)

@@ -150,7 +150,12 @@ class NodeService:
                 ncfg.consensus_ip, ncfg.geec_txn_port, self.node.on_geec_txn)
 
         from eges_tpu.core.txpool import TxPool
-        self.txpool = TxPool(self.clock, verifier=verifier)
+        self.txpool = TxPool(
+            self.clock, verifier=verifier,
+            journal_path=os.path.join(cfg.datadir, "transactions.rlp"))
+        loaded = self.txpool.load_journal()
+        if loaded:
+            self.log.geec("txpool journal", reloaded=loaded)
         self.node.txpool = self.txpool
 
         self.rpc = None
@@ -239,6 +244,7 @@ class NodeService:
         if self.rpc is not None:
             self.rpc.close()
         self.node.stop()
+        self.txpool.close()
         self.gossip.close()
         self.direct.close()
         if self.txn_service is not None:

@@ -84,6 +84,46 @@ def ring_tally(fn, mesh, axis: str = "dp", *, n_in: int, n_out: int,
         tuple([PS(axis)] * n_out + [PS()])))
 
 
+def all_to_all_resplit(fn, mesh, axis: str = "dp", *, n_in: int,
+                       feature_axis: int = 1):
+    """The Ulysses-style layout swap: inputs arrive ROW-sharded, an
+    ``all_to_all`` re-splits them FEATURE-sharded (every device sees all
+    rows for its feature slice), ``fn`` runs on the feature shard, and a
+    second ``all_to_all`` restores row sharding.
+
+    In this domain the "features" are the 65 signature bytes / 16 limbs
+    of a row; the layout matters when a stage's reduction runs across
+    rows (e.g. a cross-row histogram or a bytewise transform) rather
+    than within them.  The pattern is the all-to-all half of the
+    sequence-parallel toolbox (ring collectives being the other), kept
+    here as a first-class, tested layout the verifier pipeline can adopt
+    per-stage (ref role: the reference has no SP — SURVEY §5 maps the
+    axis to the signature batch).
+
+    ``fn`` maps ``n_in`` arrays of shape ``[rows, F/n]`` to one array of
+    the same leading shape; the wrapper returns the row-sharded result.
+    The mesh size must divide both the row count and the feature dim.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as PS
+
+    def shard_fn(*args):
+        # [rows/n, F] per device -> all_to_all -> [rows, F/n]
+        resplit = [
+            jax.lax.all_to_all(a, axis, split_axis=feature_axis,
+                               concat_axis=0, tiled=True)
+            for a in args
+        ]
+        out = fn(*resplit)
+        # back: [rows, F/n] -> [rows/n, F]
+        return jax.lax.all_to_all(out, axis, split_axis=0,
+                                  concat_axis=feature_axis, tiled=True)
+
+    return jax.jit(jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=tuple([PS(axis)] * n_in),
+        out_specs=PS(axis)))
+
+
 def ring_gather(fn, mesh, axis: str = "dp", *, n_in: int,
                 gather_out: int = 0):
     """Row-sharded map whose ``gather_out`` output is ring-all-gathered:

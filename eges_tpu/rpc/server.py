@@ -103,6 +103,8 @@ class RpcServer:
         self._server = None
         self._filters: dict = {}
         self._filter_seq = 0
+        self._ws_conns: list = []  # (writer, subscriptions) per WS conn
+        chain.add_listener(self._on_block_for_ws)
 
     # -- method handlers --------------------------------------------------
 
@@ -555,6 +557,9 @@ class RpcServer:
                         break
                     k, _, v = h.decode().partition(":")
                     headers[k.strip().lower()] = v.strip()
+                if headers.get("upgrade", "").lower() == "websocket":
+                    await self._handle_ws(reader, writer, headers)
+                    return
                 length = int(headers.get("content-length", 0))
                 body = await reader.readexactly(length) if length else b""
                 resp = self._handle_body(body)
@@ -567,6 +572,176 @@ class RpcServer:
             pass
         finally:
             writer.close()
+
+    # -- WebSocket transport + eth_subscribe push (ref: rpc/websocket.go
+    # + eth/filters/filter_system.go subscription events) ----------------
+
+    @staticmethod
+    def _ws_frame(payload: bytes, opcode: int = 1) -> bytes:
+        n = len(payload)
+        head = bytes([0x80 | opcode])
+        if n < 126:
+            head += bytes([n])
+        elif n < 1 << 16:
+            head += bytes([126]) + n.to_bytes(2, "big")
+        else:
+            head += bytes([127]) + n.to_bytes(8, "big")
+        return head + payload
+
+    @staticmethod
+    async def _ws_read_raw(reader) -> tuple[int, int, bytes] | None:
+        try:
+            h = await reader.readexactly(2)
+        except asyncio.IncompleteReadError:
+            return None
+        fin = h[0] & 0x80
+        opcode = h[0] & 0x0F
+        masked = h[1] & 0x80
+        n = h[1] & 0x7F
+        if n == 126:
+            n = int.from_bytes(await reader.readexactly(2), "big")
+        elif n == 127:
+            n = int.from_bytes(await reader.readexactly(8), "big")
+        if n > 16 * 1024 * 1024:
+            return None
+        mask = await reader.readexactly(4) if masked else b""
+        data = await reader.readexactly(n)
+        if masked:
+            data = bytes(b ^ mask[i % 4] for i, b in enumerate(data))
+        return fin, opcode, data
+
+    async def _ws_read_frame(self, reader) -> tuple[int, bytes] | None:
+        """One complete MESSAGE: reassembles fragmented frames (FIN=0
+        text/binary + opcode-0 continuations); control frames interleave
+        and are returned as-is."""
+        buf = b""
+        first_opcode = None
+        while True:
+            raw = await self._ws_read_raw(reader)
+            if raw is None:
+                return None
+            fin, opcode, data = raw
+            if opcode >= 8:  # control frames never fragment
+                return opcode, data
+            if first_opcode is None:
+                first_opcode = opcode or 1
+            buf += data
+            if len(buf) > 16 * 1024 * 1024:
+                return None
+            if fin:
+                return first_opcode, buf
+
+    async def _handle_ws(self, reader, writer, headers: dict) -> None:
+        import base64
+        import hashlib
+
+        key = headers.get("sec-websocket-key", "")
+        accept = base64.b64encode(hashlib.sha1(
+            (key + "258EAFA5-E914-47DA-95CA-C5AB0DC85B11").encode()
+        ).digest()).decode()
+        writer.write((
+            "HTTP/1.1 101 Switching Protocols\r\n"
+            "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Accept: {accept}\r\n\r\n").encode())
+        await writer.drain()
+
+        subs: dict[str, dict] = {}  # sub id -> {"kind", "obj"}
+        self._ws_conns.append((writer, subs))
+        try:
+            while True:
+                frame = await self._ws_read_frame(reader)
+                if frame is None:
+                    break
+                opcode, data = frame
+                if opcode == 8:  # close
+                    break
+                if opcode == 9:  # ping -> pong
+                    writer.write(self._ws_frame(data, opcode=10))
+                    await writer.drain()
+                    continue
+                if opcode not in (1, 2):
+                    continue
+                try:
+                    req = json.loads(data)
+                except ValueError:
+                    continue
+                method = req.get("method", "")
+                params = req.get("params", []) or []
+                rid = req.get("id")
+                try:
+                    if method == "eth_subscribe":
+                        if not params:
+                            raise RpcError(-32602, "missing subscription kind")
+                        kind = params[0]
+                        if kind not in ("newHeads", "logs"):
+                            raise RpcError(-32602, f"unsupported: {kind}")
+                        obj = params[1] if len(params) > 1 else {}
+                        if kind == "logs":
+                            try:  # validate ONCE here, not on every push
+                                self._parse_filter(obj)
+                            except Exception:
+                                raise RpcError(-32602, "invalid log filter")
+                        self._filter_seq += 1
+                        sid = _hex(self._filter_seq)
+                        subs[sid] = {"kind": kind, "obj": obj}
+                        result = sid
+                    elif method == "eth_unsubscribe":
+                        if not params:
+                            raise RpcError(-32602, "missing subscription id")
+                        result = subs.pop(params[0], None) is not None
+                    else:
+                        result = self.dispatch(method, params)
+                    out = {"jsonrpc": "2.0", "id": rid, "result": result}
+                except RpcError as e:
+                    out = {"jsonrpc": "2.0", "id": rid,
+                           "error": {"code": e.code, "message": e.message}}
+                except Exception as e:  # malformed params must not kill
+                    out = {"jsonrpc": "2.0", "id": rid,  # the connection
+                           "error": {"code": -32603, "message": str(e)}}
+                writer.write(self._ws_frame(json.dumps(out).encode()))
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError):
+            pass
+        finally:
+            self._ws_conns = [(w, s) for w, s in self._ws_conns
+                              if w is not writer]
+            writer.close()
+
+    def _on_block_for_ws(self, block) -> None:
+        """Chain listener: push newHeads/logs notifications to every
+        subscribed WS connection (fire-and-forget writes on the shared
+        event loop)."""
+        if not self._ws_conns:
+            return
+        head_json = None
+        for writer, subs in list(self._ws_conns):
+            for sid, sub in subs.items():
+                try:
+                    if sub["kind"] == "newHeads":
+                        if head_json is None:
+                            head_json = _block_json(block, False)
+                        result = head_json
+                    else:
+                        from_n = to_n = block.number
+                        _, _, addrs, topics = self._parse_filter(sub["obj"])
+                        logs = self._logs_in_range(from_n, to_n, addrs,
+                                                   topics)
+                        if not logs:
+                            continue
+                        result = logs
+                    msg = {"jsonrpc": "2.0", "method": "eth_subscription",
+                           "params": {"subscription": sid,
+                                      "result": result}}
+                    transport = writer.transport
+                    if (transport is not None and
+                            transport.get_write_buffer_size() > 4 << 20):
+                        # a subscriber that stopped reading must not grow
+                        # our buffers without bound: drop it
+                        writer.close()
+                        continue
+                    writer.write(self._ws_frame(json.dumps(msg).encode()))
+                except Exception:
+                    pass
 
     IPC_LIMIT = 16 * 1024 * 1024  # max request line (large raw txns)
 
@@ -625,6 +800,7 @@ class RpcServer:
             self._ipc_path = ipc_path
 
     def close(self) -> None:
+        self.chain.remove_listener(self._on_block_for_ws)
         if self._server is not None:
             self._server.close()
         if getattr(self, "_ipc_server", None) is not None:

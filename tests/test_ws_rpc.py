@@ -1,0 +1,102 @@
+"""WebSocket RPC transport + eth_subscribe push (ref roles:
+rpc/websocket.go, eth/filters/filter_system.go)."""
+
+import asyncio
+import base64
+import hashlib
+import json
+import os
+import socket
+import threading
+
+from eges_tpu.core.chain import BlockChain, make_genesis
+from eges_tpu.core.types import Header, new_block
+from eges_tpu.rpc.server import RpcServer
+
+GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+def _client_frame(payload: bytes) -> bytes:
+    mask = os.urandom(4)
+    body = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+    n = len(payload)
+    assert n < 126
+    return bytes([0x81, 0x80 | n]) + mask + body
+
+
+def _read_frame(sock) -> bytes:
+    h = sock.recv(2)
+    n = h[1] & 0x7F
+    if n == 126:
+        n = int.from_bytes(sock.recv(2), "big")
+    data = b""
+    while len(data) < n:
+        data += sock.recv(n - len(data))
+    return data
+
+
+def test_ws_subscribe_new_heads_and_rpc():
+    chain = BlockChain(genesis=make_genesis())
+    ready = threading.Event()
+    box = {}
+
+    def serve():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        box["loop"] = loop
+        rpc = RpcServer(chain, port=0)
+        loop.run_until_complete(rpc.start())
+        box["port"] = rpc._server.sockets[0].getsockname()[1]
+        ready.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    assert ready.wait(10)
+
+    s = socket.create_connection(("127.0.0.1", box["port"]), timeout=10)
+    s.settimeout(10)
+    key = base64.b64encode(os.urandom(16)).decode()
+    s.sendall((f"GET / HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+               f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+               f"Sec-WebSocket-Version: 13\r\n\r\n").encode())
+    resp = b""
+    while b"\r\n\r\n" not in resp:
+        resp += s.recv(4096)
+    want = base64.b64encode(hashlib.sha1((key + GUID).encode())
+                            .digest()).decode()
+    assert f"Sec-WebSocket-Accept: {want}".encode() in resp
+
+    # plain RPC over the socket works
+    s.sendall(_client_frame(json.dumps({
+        "jsonrpc": "2.0", "id": 1, "method": "eth_blockNumber",
+        "params": []}).encode()))
+    out = json.loads(_read_frame(s))
+    assert out["result"] == "0x0"
+
+    # subscribe, then insert a block on the server loop -> push arrives
+    s.sendall(_client_frame(json.dumps({
+        "jsonrpc": "2.0", "id": 2, "method": "eth_subscribe",
+        "params": ["newHeads"]}).encode()))
+    sid = json.loads(_read_frame(s))["result"]
+
+    def insert():
+        parent = chain.head()
+        blk = new_block(Header(parent_hash=parent.hash, number=1,
+                               time=parent.header.time + 1,
+                               root=parent.header.root))
+        assert chain.offer(blk), chain.last_error
+
+    box["loop"].call_soon_threadsafe(insert)
+    note = json.loads(_read_frame(s))
+    assert note["method"] == "eth_subscription"
+    assert note["params"]["subscription"] == sid
+    assert note["params"]["result"]["number"] == "0x1"
+
+    # unsubscribe stops the stream
+    s.sendall(_client_frame(json.dumps({
+        "jsonrpc": "2.0", "id": 3, "method": "eth_unsubscribe",
+        "params": [sid]}).encode()))
+    assert json.loads(_read_frame(s))["result"] is True
+    s.close()
+    box["loop"].call_soon_threadsafe(box["loop"].stop)
