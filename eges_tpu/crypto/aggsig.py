@@ -1,9 +1,10 @@
 """Pairing-based aggregate signatures (the BASELINE config-5 stretch:
 one pairing check replaces N per-vote secp256k1 verifies).
 
-BLS-style scheme over the alt_bn128 pairing (:mod:`eges_tpu.crypto.
-bn254` — bilinearity-tested; the reference's crypto/bn256 role), in the
-minimal-signature-size arrangement:
+BLS scheme over **BLS12-381** by default (:mod:`eges_tpu.crypto.
+bls12_381`; pass ``curve=bn254`` for the EVM-precompile curve — both
+expose the same module surface), in the minimal-signature-size
+arrangement:
 
 * secret key ``sk``: scalar mod N
 * public key ``pk = sk * G2``        (G2, 4 field words)
@@ -13,10 +14,9 @@ minimal-signature-size arrangement:
   verify-aggregate: ``e(asig, G2) == prod e(H(m_i), pk_i)``
   — via one product-of-pairings check (the 0x08-precompile predicate).
 
-``H`` is hash-and-check (try-and-increment on keccak counters): NOT the
-RFC 9380 encoding — this chain only needs all of ITS nodes to agree,
-and the scheme swaps to BLS12-381 + a standard hash-to-curve without
-changing any caller.  Rogue-key defense: verify_aggregate takes
+``H`` is hash-and-check (try-and-increment on keccak counters) with
+cofactor clearing: NOT the RFC 9380 encoding — this chain only needs
+all of ITS nodes to agree.  Rogue-key defense: verify_aggregate takes
 distinct messages per signer (the distinct-message variant of
 Boneh-Gentry-Lynn-Shacham); same-message aggregation would need
 proof-of-possession, which registration can carry later.
@@ -24,90 +24,105 @@ proof-of-possession, which registration can carry later.
 
 from __future__ import annotations
 
-from eges_tpu.crypto import bn254 as bn
+from eges_tpu.crypto import bls12_381 as _default_curve
 from eges_tpu.crypto.keccak import keccak256
 
+bn = _default_curve  # module-level default; every entry point takes curve=
 
-def hash_to_g1(msg: bytes):
+
+def hash_to_g1(msg: bytes, curve=None):
     """Try-and-increment: the first counter whose keccak lands on an
-    x-coordinate with a quadratic-residue RHS gives the point; even y
-    chosen by a parity bit of the hash."""
+    x-coordinate with a quadratic-residue RHS gives the point (even y
+    chosen by a parity bit of the hash), then cofactor-cleared into the
+    order-R subgroup (BLS12-381's G1 cofactor is ~2^125; BN254's is 1)."""
+    c = curve or bn
     for ctr in range(256):
         h = keccak256(bytes([ctr]) + msg)
-        x = int.from_bytes(h, "big") % bn.P
-        rhs = (x * x * x + 3) % bn.P
-        y = pow(rhs, (bn.P + 1) // 4, bn.P)
-        if y * y % bn.P == rhs:
+        x = int.from_bytes(h, "big") % c.P
+        rhs = (x * x * x + c.B1) % c.P
+        y = pow(rhs, (c.P + 1) // 4, c.P)
+        if y * y % c.P == rhs:
             if (h[31] & 1) != (y & 1):
-                y = bn.P - y
-            return (x, y)
+                y = c.P - y
+            pt = (x, y)
+            return c.g1_mul(c.H1, pt) if c.H1 != 1 else pt
     raise ValueError("hash_to_g1: no point found (p=3 mod 4 guarantees "
                      "~50% per counter; unreachable)")
 
 
-def keygen(seed: bytes):
-    sk = int.from_bytes(keccak256(b"aggsig-key" + seed), "big") % bn.N
+def keygen(seed: bytes, curve=None):
+    c = curve or bn
+    sk = int.from_bytes(keccak256(b"aggsig-key" + seed), "big") % c.N
     if sk == 0:
         sk = 1
-    return sk, bn.g2_mul(sk, bn.G2)
+    return sk, c.g2_mul(sk, c.G2)
 
 
-def sign(sk: int, msg: bytes):
-    return bn.g1_mul(sk, hash_to_g1(msg))
+def sign(sk: int, msg: bytes, curve=None):
+    c = curve or bn
+    return c.g1_mul(sk, hash_to_g1(msg, c))
 
 
-def _valid_g1(pt) -> bool:
-    """Shape + curve membership for attacker-supplied G1 data."""
+def _valid_g1(pt, c) -> bool:
+    """Shape + SUBGROUP membership for attacker-supplied G1 data.
+
+    Curve membership alone is not enough on BLS12-381 (G1 cofactor
+    ~2^125): adding a cofactor-torsion point to a valid signature
+    yields a distinct encoding that still verifies — the malleability
+    the IRTF BLS draft's subgroup check exists to kill."""
     try:
         x, y = pt
         return (isinstance(x, int) and isinstance(y, int)
-                and bn.g1_is_on_curve((x, y)))
+                and c.g1_in_subgroup((x, y)))
     except (TypeError, ValueError):
         return False
 
 
-def _valid_g2(pt) -> bool:
+def _valid_g2(pt, c) -> bool:
     """Shape + subgroup membership for attacker-supplied G2 data."""
     try:
         (xr, xi), (yr, yi) = pt
         if not all(isinstance(v, int) for v in (xr, xi, yr, yi)):
             return False
-        return bn.g2_in_subgroup(((xr, xi), (yr, yi)))
+        return c.g2_in_subgroup(((xr, xi), (yr, yi)))
     except (TypeError, ValueError):
         return False
 
 
-def verify(pk, msg: bytes, sig) -> bool:
+def verify(pk, msg: bytes, sig, curve=None) -> bool:
     """``e(sig, G2) == e(H(m), pk)`` via the product check
     ``e(-sig, G2) * e(H(m), pk) == 1``.  Malformed or off-curve input
     (this is a network-facing entry point) rejects, never raises."""
-    if not _valid_g1(sig) or not _valid_g2(pk):
+    c = curve or bn
+    if not _valid_g1(sig, c) or not _valid_g2(pk, c):
         return False
-    neg_sig = (sig[0], (-sig[1]) % bn.P)
-    return bn.pairing_check([(neg_sig, bn.G2), (hash_to_g1(msg), pk)])
+    neg_sig = (sig[0], (-sig[1]) % c.P)
+    return c.pairing_check([(neg_sig, c.G2), (hash_to_g1(msg, c), pk)])
 
 
-def aggregate(sigs):
+def aggregate(sigs, curve=None):
     """Sum of G1 signatures — constant-size regardless of signer count
     (the ACK-quorum compression this scheme buys)."""
+    c = curve or bn
     out = None
     for s in sigs:
-        out = bn.g1_add(out, s)
+        out = c.g1_add(out, s)
     return out
 
 
-def verify_aggregate(pks_msgs, asig) -> bool:
+def verify_aggregate(pks_msgs, asig, curve=None) -> bool:
     """``e(asig, G2) == prod e(H(m_i), pk_i)`` — ONE multi-pairing for
     the whole quorum.  ``pks_msgs``: [(pk_g2, msg_bytes), ...] with
     DISTINCT messages (see module docstring)."""
-    if not pks_msgs or not _valid_g1(asig):
+    c = curve or bn
+    if not pks_msgs or not _valid_g1(asig, c):
         return False
-    if not all(_valid_g2(pk) for pk, _ in pks_msgs):
+    if not all(_valid_g2(pk, c) for pk, _ in pks_msgs):
         return False
     msgs = [m for _, m in pks_msgs]
     if len(set(msgs)) != len(msgs):
         return False  # distinct-message requirement (rogue-key defense)
-    neg_asig = (asig[0], (-asig[1]) % bn.P)
-    pairs = [(neg_asig, bn.G2)]
-    pairs.extend((hash_to_g1(m), pk) for pk, m in pks_msgs)
-    return bn.pairing_check(pairs)
+    neg_asig = (asig[0], (-asig[1]) % c.P)
+    pairs = [(neg_asig, c.G2)]
+    pairs.extend((hash_to_g1(m, c), pk) for pk, m in pks_msgs)
+    return c.pairing_check(pairs)

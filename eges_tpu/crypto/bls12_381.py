@@ -1,0 +1,337 @@
+"""BLS12-381: curve ops and the optimal-ate pairing (M-type twist).
+
+The BASELINE config-5 curve (aggregate-verify at scale; the reference's
+crypto/bn256 plays the same role for its EVM).  Built from the curve
+definition — tower ``F_p2 = F_p(i), i^2 = -1``; ``F_p12 = F_p2[w]/(w^6
+- xi)`` with ``xi = 1 + i`` — sharing the representation of
+:mod:`eges_tpu.crypto.bn254` (6-vector of F_p2 coefficients over w).
+
+Unlike BN254's D-twist, BLS12-381's G2 lives on the M-twist ``y^2 =
+x^3 + 4*xi``; the untwist DIVIDES by powers of w, so the Miller loop
+here stays entirely on the twisted curve and evaluates its lines at the
+twisted image of the G1 point ``psi(P) = (xP*w^2, yP*w^3)`` — a sparse
+element on w^0/w^2/w^3 with no stray scaling factors.  The BLS family
+also needs no Frobenius correction lines: the loop runs exactly
+``|x|`` bits (x = -0xd201000000010000) and conjugates the result for
+the sign.
+
+Validated by bilinearity/nondegeneracy self-tests plus the aggregate
+scheme's end-to-end checks (tests/test_aggsig.py).
+"""
+
+from __future__ import annotations
+
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+X_BLS = 0xD201000000010000  # |x|; the BLS parameter is -x
+H1 = 0x396C8C005555E1568C00AAAB0000AAAB  # G1 cofactor
+N = R  # group order alias (the bn254-compatible name)
+
+
+def _inv(a: int) -> int:
+    return pow(a, P - 2, P)
+
+
+# -- F_p2 = F_p(i), i^2 = -1 (same shape as bn254's, over this P) ----------
+
+def f2_add(x, y):
+    return ((x[0] + y[0]) % P, (x[1] + y[1]) % P)
+
+
+def f2_sub(x, y):
+    return ((x[0] - y[0]) % P, (x[1] - y[1]) % P)
+
+
+def f2_neg(x):
+    return ((-x[0]) % P, (-x[1]) % P)
+
+
+def f2_mul(x, y):
+    return ((x[0] * y[0] - x[1] * y[1]) % P,
+            (x[0] * y[1] + x[1] * y[0]) % P)
+
+
+def f2_muls(x, s: int):
+    return ((x[0] * s) % P, (x[1] * s) % P)
+
+
+def f2_sqr(x):
+    return f2_mul(x, x)
+
+
+def f2_inv(x):
+    d = _inv((x[0] * x[0] + x[1] * x[1]) % P)
+    return ((x[0] * d) % P, (-x[1] * d) % P)
+
+
+def f2_conj(x):
+    return (x[0], (-x[1]) % P)
+
+
+F2_ONE = (1, 0)
+F2_ZERO = (0, 0)
+XI = (1, 1)  # the twist constant 1 + i
+
+
+# -- F_p12 as 6 F_p2 coefficients over w (w^6 = xi) ------------------------
+
+F12_ONE = (F2_ONE,) + (F2_ZERO,) * 5
+
+
+def f12_mul(x, y):
+    out = [F2_ZERO] * 11
+    for i in range(6):
+        if y[i] == F2_ZERO:
+            continue
+        for j in range(6):
+            if x[j] == F2_ZERO:
+                continue
+            out[i + j] = f2_add(out[i + j], f2_mul(x[j], y[i]))
+    for k in range(10, 5, -1):
+        if out[k] != F2_ZERO:
+            out[k - 6] = f2_add(out[k - 6], f2_mul(out[k], XI))
+    return tuple(out[:6])
+
+
+def f12_sqr(x):
+    return f12_mul(x, x)
+
+
+def f12_conj(x):
+    return tuple(c if k % 2 == 0 else f2_neg(c) for k, c in enumerate(x))
+
+
+def f12_inv(x):
+    """Inverse by solving x*y = 1 as a 6x6 F_p2 linear system."""
+    rows = []
+    for j in range(6):
+        col = [F2_ZERO] * 11
+        for i in range(6):
+            col[i + j] = x[i]
+        for k in range(10, 5, -1):
+            if col[k] != F2_ZERO:
+                col[k - 6] = f2_add(col[k - 6], f2_mul(col[k], XI))
+        rows.append(col[:6])
+    M = [[rows[j][i] for j in range(6)] for i in range(6)]
+    rhs = [F2_ONE if i == 0 else F2_ZERO for i in range(6)]
+    for c in range(6):
+        piv = next(r for r in range(c, 6) if M[r][c] != F2_ZERO)
+        M[c], M[piv] = M[piv], M[c]
+        rhs[c], rhs[piv] = rhs[piv], rhs[c]
+        ip = f2_inv(M[c][c])
+        M[c] = [f2_mul(v, ip) for v in M[c]]
+        rhs[c] = f2_mul(rhs[c], ip)
+        for r in range(6):
+            if r != c and M[r][c] != F2_ZERO:
+                f = M[r][c]
+                M[r] = [f2_sub(v, f2_mul(f, vc))
+                        for v, vc in zip(M[r], M[c])]
+                rhs[r] = f2_sub(rhs[r], f2_mul(f, rhs[c]))
+    return tuple(rhs)
+
+
+def f12_pow(x, e: int):
+    out = F12_ONE
+    base = x
+    while e:
+        if e & 1:
+            out = f12_mul(out, base)
+        base = f12_sqr(base)
+        e >>= 1
+    return out
+
+
+def _f2_pow(x, e: int):
+    out = F2_ONE
+    base = x
+    while e:
+        if e & 1:
+            out = f2_mul(out, base)
+        base = f2_sqr(base)
+        e >>= 1
+    return out
+
+
+_GAMMA = []
+
+
+def f12_frobenius(x):
+    global _GAMMA
+    if not _GAMMA:
+        g1 = _f2_pow(XI, (P - 1) // 6)
+        cur = F2_ONE
+        for _ in range(6):
+            _GAMMA.append(cur)
+            cur = f2_mul(cur, g1)
+    return tuple(f2_mul(f2_conj(c), _GAMMA[k]) for k, c in enumerate(x))
+
+
+# -- groups ----------------------------------------------------------------
+
+B1 = 4
+B2 = f2_muls(XI, 4)  # M-twist: y^2 = x^3 + 4*xi
+
+G1 = (
+    0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB,
+    0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1,
+)
+G2 = (
+    (0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+     0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E),
+    (0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+     0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE),
+)
+
+
+def g1_is_on_curve(pt) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    if not (0 <= x < P and 0 <= y < P):
+        return False
+    return (y * y - x * x * x - B1) % P == 0
+
+
+def g1_add(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    x1, y1 = a
+    x2, y2 = b
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None
+        lam = (3 * x1 * x1) * _inv(2 * y1) % P
+    else:
+        lam = (y2 - y1) * _inv(x2 - x1) % P
+    x3 = (lam * lam - x1 - x2) % P
+    return (x3, (lam * (x1 - x3) - y1) % P)
+
+
+def g1_mul(k: int, pt):
+    k %= R
+    out = None
+    add = pt
+    while k:
+        if k & 1:
+            out = g1_add(out, add)
+        add = g1_add(add, add)
+        k >>= 1
+    return out
+
+
+def g2_is_on_curve(pt) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    return f2_sqr(y) == f2_add(f2_mul(f2_sqr(x), x), B2)
+
+
+def g2_add(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    x1, y1 = a
+    x2, y2 = b
+    if x1 == x2:
+        if f2_add(y1, y2) == F2_ZERO:
+            return None
+        lam = f2_mul(f2_muls(f2_sqr(x1), 3), f2_inv(f2_muls(y1, 2)))
+    else:
+        lam = f2_mul(f2_sub(y2, y1), f2_inv(f2_sub(x2, x1)))
+    x3 = f2_sub(f2_sub(f2_sqr(lam), x1), x2)
+    return (x3, f2_sub(f2_mul(lam, f2_sub(x1, x3)), y1))
+
+
+def g2_mul(k: int, pt):
+    k %= R
+    out = None
+    add = pt
+    while k:
+        if k & 1:
+            out = g2_add(out, add)
+        add = g2_add(add, add)
+        k >>= 1
+    return out
+
+
+def g2_neg(pt):
+    return None if pt is None else (pt[0], f2_neg(pt[1]))
+
+
+def g1_in_subgroup(pt) -> bool:
+    return g1_is_on_curve(pt) and g1_mul(R, pt) is None
+
+
+def g2_in_subgroup(pt) -> bool:
+    return g2_is_on_curve(pt) and g2_mul(R, pt) is None
+
+
+# -- optimal ate pairing (M-twist lines, loop length |x|) ------------------
+
+def _line(Q1, Q2, Pp):
+    """Line through Q1,Q2 on the TWISTED curve, evaluated at the twisted
+    image of the G1 point ``psi(P) = (xP*w^2, yP*w^3)`` — the M-twist
+    form where everything stays on E' and the line value is the sparse
+    F_p12 element
+
+        l = (yP*w^3 - yR) - lam*(xP*w^2 - xR)
+          = (lam*xR - yR)*w^0 - (lam*xP)*w^2 + yP*w^3
+
+    (vertical lines degenerate to ``xP*w^2 - xR``).
+    """
+    x1, y1 = Q1
+    x2, y2 = Q2
+    xp, yp = Pp
+    out = [F2_ZERO] * 6
+    if x1 == x2 and f2_add(y1, y2) == F2_ZERO:
+        out[0] = f2_neg(x1)
+        out[2] = (xp % P, 0)
+        return tuple(out)
+    if x1 == x2 and y1 == y2:
+        lam = f2_mul(f2_muls(f2_sqr(x1), 3), f2_inv(f2_muls(y1, 2)))
+    else:
+        lam = f2_mul(f2_sub(y2, y1), f2_inv(f2_sub(x2, x1)))
+    out[0] = f2_sub(f2_mul(lam, x1), y1)
+    out[2] = f2_neg(f2_muls(lam, xp))
+    out[3] = (yp % P, 0)
+    return tuple(out)
+
+
+def _miller(Q, Pp):
+    """Miller loop over |x| (BLS family: no correction lines); the
+    negative sign of x is applied by conjugating the result."""
+    f = F12_ONE
+    T = Q
+    for bit in bin(X_BLS)[3:]:
+        f = f12_mul(f12_sqr(f), _line(T, T, Pp))
+        T = g2_add(T, T)
+        if bit == "1":
+            f = f12_mul(f, _line(T, Q, Pp))
+            T = g2_add(T, Q)
+    return f12_conj(f)  # x < 0
+
+
+def _final_exp(f):
+    f = f12_mul(f12_conj(f), f12_inv(f))          # ^(p^6 - 1)
+    f = f12_mul(f12_frobenius(f12_frobenius(f)), f)  # ^(p^2 + 1)
+    return f12_pow(f, (P**4 - P**2 + 1) // R)     # hard part, plain pow
+
+
+def pairing(Pp, Q):
+    """``e(P, Q)`` for P in G1, Q in G2 (None = identity -> 1)."""
+    if Pp is None or Q is None:
+        return F12_ONE
+    return _final_exp(_miller(Q, Pp))
+
+
+def pairing_check(pairs) -> bool:
+    """True iff ``prod e(P_i, Q_i) == 1`` — one shared final exp."""
+    f = F12_ONE
+    for Pp, Q in pairs:
+        if Pp is None or Q is None:
+            continue
+        f = f12_mul(f, _miller(Q, Pp))
+    return _final_exp(f) == F12_ONE

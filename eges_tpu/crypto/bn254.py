@@ -18,6 +18,7 @@ from __future__ import annotations
 P = 21888242871839275222246405745257275088696311157297823662689037894645226208583
 N = 21888242871839275222246405745257275088548364400416034343698204186575808495617
 U = 4965661367192848881  # BN parameter
+H1 = 1  # G1 cofactor (prime-order curve)
 
 
 def _inv(a: int, m: int = P) -> int:
@@ -237,6 +238,11 @@ def g1_mul(k: int, pt):
         add = g1_add(add, add)
         k >>= 1
     return out
+
+
+def g1_in_subgroup(pt) -> bool:
+    """G1 has cofactor 1 (prime-order curve): on-curve IS in-subgroup."""
+    return g1_is_on_curve(pt)
 
 
 def g2_is_on_curve(pt) -> bool:

@@ -4,7 +4,6 @@ pairing check for a whole ACK quorum)."""
 import pytest
 
 from eges_tpu.crypto import aggsig
-from eges_tpu.crypto import bn254 as bn
 
 
 def test_single_sign_verify_and_reject():
@@ -40,6 +39,40 @@ def test_aggregate_quorum_verifies_in_one_check():
 
 
 def test_hash_to_g1_points_on_curve():
+    from eges_tpu.crypto import bls12_381 as bls
+
     for i in range(8):
         pt = aggsig.hash_to_g1(bytes([i]) * 3)
-        assert bn.g1_is_on_curve(pt)
+        assert bls.g1_is_on_curve(pt)
+
+
+def test_bls12_381_pairing_bilinearity():
+    """The default curve's pairing: nondegenerate and bilinear."""
+    from eges_tpu.crypto import bls12_381 as bls
+
+    e1 = bls.pairing(bls.G1, bls.G2)
+    assert e1 != bls.F12_ONE
+    sq = bls.f12_mul(e1, e1)
+    assert bls.pairing(bls.g1_mul(2, bls.G1), bls.G2) == sq
+    assert bls.pairing(bls.G1, bls.g2_mul(2, bls.G2)) == sq
+
+
+def test_aggsig_on_bn254_curve_parameter():
+    """The scheme runs identically over the EVM-precompile curve."""
+    from eges_tpu.crypto import bn254
+
+    sk, pk = aggsig.keygen(b"alt", bn254)
+    sig = aggsig.sign(sk, b"bn254 msg", bn254)
+    assert aggsig.verify(pk, b"bn254 msg", sig, bn254)
+    assert not aggsig.verify(pk, b"tampered", sig, bn254)
+
+
+def test_hash_to_g1_in_subgroup():
+    """Cofactor clearing lands hashes in the order-R subgroup (BLS12-381
+    G1 cofactor ~2^125 — without clearing, signatures would live outside
+    the group the pairing argument assumes)."""
+    from eges_tpu.crypto import bls12_381 as bls
+
+    for i in range(3):
+        pt = aggsig.hash_to_g1(bytes([i]) * 4)
+        assert bls.g1_in_subgroup(pt)
