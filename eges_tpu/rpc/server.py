@@ -181,6 +181,18 @@ class RpcServer:
         if method == "eth_getBalance":
             st = self._state_for(params[1] if len(params) > 1 else "latest")
             return _hex(st.balance(bytes.fromhex(params[0][2:])))
+        if method == "eth_getTransactionByHash":
+            hit = self.chain.lookup_txn(bytes.fromhex(params[0][2:]))
+            if hit is None:
+                return None
+            blk, i, _ = hit
+            out = _txn_json(blk.transactions[i])
+            out["blockNumber"] = _hex(blk.number)
+            out["blockHash"] = "0x" + blk.hash.hex()
+            out["transactionIndex"] = _hex(i)
+            return out
+        if method == "eth_chainId":
+            return _hex(self.chain_id)
         if method == "eth_getTransactionCount":
             st = self._state_for(params[1] if len(params) > 1 else "latest")
             return _hex(st.nonce(bytes.fromhex(params[0][2:])))

@@ -110,3 +110,18 @@ def test_gas_price_oracle_and_debug():
     assert "thread" in stacks
     stats = rpc.dispatch("debug_stats", [])
     assert stats["threads"] >= 1
+
+
+def test_get_transaction_by_hash_and_chain_id():
+    chain, caddr = _chain_with_contract()
+    rpc = RpcServer(chain)
+    blk = chain.get_block_by_number(1)
+    h = blk.transactions[1].hash
+    got = rpc.dispatch("eth_getTransactionByHash", ["0x" + h.hex()])
+    assert got["hash"] == "0x" + h.hex()
+    assert got["blockNumber"] == "0x1"
+    assert got["transactionIndex"] == "0x1"
+    assert got["to"] == "0x" + caddr.hex()
+    assert rpc.dispatch("eth_getTransactionByHash",
+                        ["0x" + bytes(32).hex()]) is None
+    assert int(rpc.dispatch("eth_chainId", []), 16) == 930412
