@@ -295,3 +295,26 @@ def test_modexp_precompile():
     res = e.call(A, (5).to_bytes(20, "big"), 0,
                  enc((1 << 255) | 1, (1 << 255) | 1, (1 << 255) | 1), 300)
     assert not res.success
+
+
+def test_delegatecall_keeps_caller_and_storage_context():
+    """DELEGATECALL runs the library's code in the caller's storage with
+    the ORIGINAL caller visible (ref: evm.DelegateCall semantics)."""
+    s = st()
+    lib = b"\xb1" * 20  # library address
+    proxy = b"\xd2" * 20
+    # library runtime: SSTORE(0, CALLER); store 7 at slot1
+    lib_code = bytes.fromhex("33600055600760015500")
+    s.set_code(lib, lib_code)
+    # proxy runtime: DELEGATECALL(gas, lib, 0,0,0,0); STOP
+    proxy_code = (bytes.fromhex("600060006000600073") + lib
+                  + bytes.fromhex("62030d40f45000"))
+    s.set_code(proxy, proxy_code)
+    e = EVM(s, BlockCtx())
+    res = e.call(A, proxy, 0, b"", 500_000)
+    assert res.success
+    # storage wrote to the PROXY, not the library
+    assert s.storage_at(proxy, 1) == 7
+    assert s.storage_at(lib, 1) == 0
+    # CALLER inside the delegated frame is the proxy's caller (A)
+    assert s.storage_at(proxy, 0) == int.from_bytes(A, "big")
