@@ -151,3 +151,32 @@ def test_16_node_lossy_convergence():
     assert c.min_height() >= 10, sorted(set(c.heights()))
     h = c.min_height()
     assert len({sn.chain.get_block_by_number(h).hash for sn in c.nodes}) == 1
+
+
+@pytest.mark.slow
+def test_mixed_batch_through_real_device_verifier():
+    """BASELINE config 3 with the REAL BatchVerifier: one device batch
+    carrying a proposer signature, 256 validator ACK votes and a
+    1000-txn block's senders — recovered in a single padded bucket on
+    the JAX device (the NativeBatchVerifier variant covers the routing
+    share; this covers the device execution)."""
+    import numpy as np
+
+    from eges_tpu.crypto import secp256k1 as secp
+    from eges_tpu.crypto.verifier import BatchVerifier
+
+    n_votes, n_txns = 256, 1000
+    sigs = np.zeros((1 + n_votes + n_txns, 65), np.uint8)
+    hashes = np.zeros((1 + n_votes + n_txns, 32), np.uint8)
+    expect = []
+    for i in range(sigs.shape[0]):
+        priv = (i + 21).to_bytes(32, "big")
+        h = secp.pubkey_to_address(secp.privkey_to_pubkey(priv)) + b"\1" * 12
+        sigs[i] = np.frombuffer(secp.ecdsa_sign(h, priv), np.uint8)
+        hashes[i] = np.frombuffer(h, np.uint8)
+        expect.append(secp.pubkey_to_address(secp.privkey_to_pubkey(priv)))
+    bv = BatchVerifier()
+    addrs, ok = bv.recover_addresses(sigs, hashes)
+    assert ok.all()
+    for i in (0, 1, 17, 256, 999, 1256):
+        assert bytes(addrs[i]) == expect[i]
