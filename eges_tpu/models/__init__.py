@@ -1,11 +1,36 @@
 """The framework's "model" registry.
 
-This domain's flagship model is the batched signature-verification
-pipeline (SURVEY §3.5's hot path as one fused device program); the
-registry gives the driver entry point, the benchmark, and tests one
-shared definition of "the model" and its example inputs.
+This domain's "models" are the verification workload families the
+device executes as fused programs (SURVEY §3.5's hot path and its
+siblings).  The registry gives the driver entry point, the benchmark
+and tests one shared definition of each and its example inputs:
+
+* ``ecrecover`` (flagship) — batched sender/signer recovery,
+  ``(sigs [N,65], hashes [N,32]) -> (addrs, pubs, ok)``.
+* ``classic_verify`` — batched ECDSA verify against known pubkeys
+  (the VerifySignature role, ref: crypto/secp256k1/secp256.go:126).
+* ``keccak256`` — batched fixed-length Keccak-256 (the address/bloom
+  hashing substrate, ref: crypto/crypto.go:43).
 """
 
 from eges_tpu.models.flagship import (  # noqa: F401
     example_batch, flagship_forward,
 )
+
+
+def model(name: str):
+    """Named jittable forward steps (the model-family registry)."""
+    if name in ("ecrecover", "flagship"):
+        return flagship_forward()
+    if name == "classic_verify":
+        from eges_tpu.crypto.verifier import verify_batch
+
+        return verify_batch
+    if name == "keccak256":
+        from eges_tpu.ops.keccak_tpu import keccak256_fixed
+
+        return keccak256_fixed
+    raise KeyError(f"unknown model {name!r}")
+
+
+MODELS = ("ecrecover", "classic_verify", "keccak256")

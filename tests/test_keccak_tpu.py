@@ -43,3 +43,15 @@ def test_pubkey_to_address_matches_host():
     addrs = np.asarray(jax.jit(keccak_tpu.pubkey_to_address)(qx, qy))
     for p, a in zip(pubs, addrs):
         assert bytes(a) == host.pubkey_to_address(p)
+
+
+def test_model_registry_names_all_families():
+    from eges_tpu import models
+
+    for name in models.MODELS:
+        assert callable(models.model(name))
+    assert models.model("flagship") is models.model("ecrecover")
+    import pytest
+
+    with pytest.raises(KeyError):
+        models.model("nope")
