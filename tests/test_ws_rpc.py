@@ -20,8 +20,12 @@ def _client_frame(payload: bytes) -> bytes:
     mask = os.urandom(4)
     body = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
     n = len(payload)
-    assert n < 126
-    return bytes([0x81, 0x80 | n]) + mask + body
+    if n < 126:
+        head = bytes([0x81, 0x80 | n])
+    else:
+        assert n < 1 << 16
+        head = bytes([0x81, 0x80 | 126]) + n.to_bytes(2, "big")
+    return head + mask + body
 
 
 def _read_frame(sock) -> bytes:
@@ -98,5 +102,85 @@ def test_ws_subscribe_new_heads_and_rpc():
         "jsonrpc": "2.0", "id": 3, "method": "eth_unsubscribe",
         "params": [sid]}).encode()))
     assert json.loads(_read_frame(s))["result"] is True
+    s.close()
+    box["loop"].call_soon_threadsafe(box["loop"].stop)
+
+
+def test_ws_logs_subscription_push_and_filter():
+    """logs subscriptions push only matching logs; invalid filters are
+    rejected at subscribe time."""
+    from eges_tpu.core.state import contract_address
+    from eges_tpu.core.types import Transaction
+    from eges_tpu.crypto import secp256k1 as secp
+
+    PRIV = bytes([7]) * 32
+    ADDR = secp.pubkey_to_address(secp.privkey_to_pubkey(PRIV))
+    ETH = 10**18
+    # counter+LOG1(topic 7) runtime (same blob as test_rpc_evm_api)
+    RUNTIME = bytes.fromhex(
+        "600054600101806000556000526007602060" + "00a1" + "602060" + "00f3")
+    INIT = (bytes([0x60, len(RUNTIME), 0x60, 0x0C, 0x60, 0x00, 0x39,
+                   0x60, len(RUNTIME), 0x60, 0x00, 0xF3]) + RUNTIME)
+
+    chain = BlockChain(genesis=make_genesis(alloc={ADDR: 10 * ETH}),
+                       alloc={ADDR: 10 * ETH})
+    ready = threading.Event()
+    box = {}
+
+    def serve():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        box["loop"] = loop
+        rpc = RpcServer(chain, port=0)
+        loop.run_until_complete(rpc.start())
+        box["port"] = rpc._server.sockets[0].getsockname()[1]
+        ready.set()
+        loop.run_forever()
+
+    threading.Thread(target=serve, daemon=True).start()
+    assert ready.wait(10)
+
+    s = socket.create_connection(("127.0.0.1", box["port"]), timeout=10)
+    s.settimeout(10)
+    key = base64.b64encode(os.urandom(16)).decode()
+    s.sendall((f"GET / HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+               f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+               f"Sec-WebSocket-Version: 13\r\n\r\n").encode())
+    resp = b""
+    while b"\r\n\r\n" not in resp:
+        resp += s.recv(4096)
+
+    topic7 = "0x" + (7).to_bytes(32, "big").hex()
+    # invalid filter rejected at subscribe time
+    s.sendall(_client_frame(json.dumps({
+        "jsonrpc": "2.0", "id": 1, "method": "eth_subscribe",
+        "params": ["logs", {"fromBlock": "bogus"}]}).encode()))
+    assert json.loads(_read_frame(s))["error"]["code"] == -32602
+    # matching subscription
+    s.sendall(_client_frame(json.dumps({
+        "jsonrpc": "2.0", "id": 2, "method": "eth_subscribe",
+        "params": ["logs", {"topics": [topic7]}]}).encode()))
+    sid = json.loads(_read_frame(s))["result"]
+
+    def insert():
+        txs = [Transaction(nonce=0, gas_price=1, gas_limit=500_000,
+                           to=None, payload=INIT).signed(PRIV),
+               Transaction(nonce=1, gas_price=1, gas_limit=200_000,
+                           to=contract_address(ADDR, 0)).signed(PRIV)]
+        kept, root, rroot, gas, bloom = chain.execute_preview(txs)
+        parent = chain.head()
+        from eges_tpu.core.types import Header, new_block
+        blk = new_block(Header(parent_hash=parent.hash, number=1,
+                               time=parent.header.time + 1, root=root,
+                               receipt_hash=rroot, gas_used=gas,
+                               bloom=bloom), txs=kept)
+        assert chain.offer(blk), chain.last_error
+
+    box["loop"].call_soon_threadsafe(insert)
+    note = json.loads(_read_frame(s))
+    assert note["method"] == "eth_subscription"
+    assert note["params"]["subscription"] == sid
+    logs = note["params"]["result"]
+    assert logs and logs[0]["topics"] == [topic7]
     s.close()
     box["loop"].call_soon_threadsafe(box["loop"].stop)
