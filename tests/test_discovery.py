@@ -193,3 +193,20 @@ def test_discovery_client_learns_peers_via_bootnode():
         assert (a1, ("127.0.0.1", 7001)) in learned
 
     asyncio.run(scenario())
+
+
+def test_bootnode_renewal_keeps_entry_alive():
+    """Re-announcing refreshes the TTL: an entry stays live across
+    eviction sweeps as long as the node keeps announcing."""
+    now = [0.0]
+    bn = BootnodeService("0.0.0.0", 0, clock=lambda: now[0])
+    priv, pub, addr = kp(20)
+    for _ in range(4):
+        bn.handle(encode_announce(priv, pub, "1.1.1.1", 1, "1.1.1.1", 2,
+                                  now=now[0]), lambda d: None)
+        now[0] += ANNOUNCE_TTL_S * 0.8  # advance, but keep announcing
+        bn._evict(now[0])
+        assert addr in bn.registry
+    now[0] += ANNOUNCE_TTL_S * 1.5  # stop announcing -> expires
+    bn._evict(now[0])
+    assert addr not in bn.registry
