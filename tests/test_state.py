@@ -303,3 +303,37 @@ def test_receipts_log_torn_tail_truncates(tmp_path):
         sizes.append(os.path.getsize(rpath))
     # the log must not grow on every restart (the pre-fix behavior)
     assert sizes[1] == sizes[2], sizes
+
+
+def test_blocks_log_torn_tail_truncates_and_resumes(tmp_path):
+    """A crash mid-append leaves a torn blocks.log record: restart must
+    truncate it and resume from the last good block (ref: the LevelDB
+    atomicity the FileStore's fsync'd append-log replaces)."""
+    import os
+
+    from eges_tpu.core.chain import FileStore
+
+    alloc = {ADDR_A: 10 * ETH}
+    store = FileStore(str(tmp_path / "cd"))
+    chain = BlockChain(store=store, genesis=make_genesis(alloc=alloc),
+                       alloc=alloc)
+    for n in range(1, 5):
+        t = signed_txn(PRIV_A, n - 1, ADDR_B, 1, gas_price=0)
+        assert chain.offer(block_with(chain, [t])), chain.last_error
+    store.close()
+
+    bpath = str(tmp_path / "cd" / "blocks.log")
+    good = os.path.getsize(bpath)
+    with open(bpath, "ab") as f:
+        f.write(b"\xff\xff\xff\x7f partial-record-garbage")
+
+    s2 = FileStore(str(tmp_path / "cd"))
+    assert os.path.getsize(bpath) == good  # tear truncated
+    c2 = BlockChain(store=s2, genesis=make_genesis(alloc=alloc),
+                    alloc=alloc)
+    assert c2.height() == 4
+    # and the chain keeps extending after the repair
+    t = signed_txn(PRIV_A, 4, ADDR_B, 1, gas_price=0)
+    assert c2.offer(block_with(c2, [t])), c2.last_error
+    assert c2.height() == 5
+    s2.close()
