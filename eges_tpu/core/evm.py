@@ -620,8 +620,12 @@ class EVM:
             elif op == 0x40:  # BLOCKHASH
                 use(G_HIGH + 10); n = pop()
                 bh = self.ctx.blockhash
+                # only the previous 256 ancestors — never the block
+                # being executed, whose hash is not yet sealed
+                # (ref core/vm/instructions.go opBlockhash: distance
+                # 1..256, else zero)
                 push(int.from_bytes(bh(n), "big")
-                     if bh is not None and 0 <= self.ctx.number - n <= 256
+                     if bh is not None and 1 <= self.ctx.number - n <= 256
                      else 0)
             elif op == 0x41:  # COINBASE
                 use(G_BASE); push(int.from_bytes(self.ctx.coinbase, "big"))

@@ -210,7 +210,11 @@ def g1_add(a, b):
 
 
 def g1_mul(k: int, pt):
-    k %= R
+    # NO reduction mod R here: g1_in_subgroup multiplies by R itself
+    # and relies on the full scalar being used (a reduced scalar would
+    # make the check `R*pt == O` vacuously true for any on-curve point)
+    if k < 0:
+        raise ValueError("negative scalar")
     out = None
     add = pt
     while k:
@@ -246,7 +250,8 @@ def g2_add(a, b):
 
 
 def g2_mul(k: int, pt):
-    k %= R
+    if k < 0:  # see g1_mul: no reduction, subgroup checks need R*pt
+        raise ValueError("negative scalar")
     out = None
     add = pt
     while k:

@@ -229,7 +229,13 @@ def g1_add(a, b):
 
 
 def g1_mul(k: int, pt):
-    k %= N
+    # NO reduction mod N, mirroring g2_mul and bls12_381.g1_mul: the
+    # `order*pt == O` subgroup checks there rely on the full scalar, and
+    # the two curve modules keep one scalar-mult contract (bn254 G1 has
+    # cofactor 1, so reduction would be harmless HERE — but restoring it
+    # would fork the contract and invite the vacuous-check bug back)
+    if k < 0:
+        raise ValueError("negative scalar")
     out = None
     add = pt
     while k:
@@ -272,7 +278,8 @@ def g2_add(a, b):
 
 
 def g2_mul(k: int, pt):
-    k %= N
+    if k < 0:  # see g1_mul: no reduction, subgroup checks need N*pt
+        raise ValueError("negative scalar")
     out = None
     add = pt
     while k:

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import struct
+import time
 
 
 class AsyncioClock:
@@ -105,11 +106,18 @@ class _FrameAuth:
     MAGIC = b"geec-gossip-v1\x00\x00"
     MAGIC2 = b"geec-gossip-v2\x00\x00"
 
-    def __init__(self, secret: bytes, keypair: tuple[bytes, bytes] | None = None):
+    def __init__(self, secret: bytes, keypair: tuple[bytes, bytes] | None = None,
+                 allow_downgrade: bool = False):
         import secrets as _secrets
 
         self.secret = secret
         self.keypair = keypair  # (priv32, pub64) -> v2 handshake
+        # Round-3 advisor: a keyed side silently accepting a v1 hello
+        # bypasses the authorize() membership gate (peer_addr never
+        # set), and the default v1 secret is derivable from the public
+        # genesis file.  Downgrade is therefore opt-in (mixed-mode
+        # deployments mid-upgrade), never the default.
+        self.allow_downgrade = allow_downgrade
         self.my_nonce = _secrets.token_bytes(16)
         self.send_key = b""
         self.recv_key = b""
@@ -136,9 +144,11 @@ class _FrameAuth:
         received).  A keyed endpoint receiving a v1 hello falls back to
         v1 symmetric keys, and a keyless endpoint can parse a v2 hello's
         nonce and derive the same v1 keys — so mixed generations and
-        keyless tooling interop instead of mutually AuthError-ing.  A
-        downgrade by an outsider is not possible: v1 still requires the
-        network secret."""
+        keyless tooling interop instead of mutually AuthError-ing —
+        but ONLY when ``allow_downgrade`` is set: by default a keyed
+        endpoint rejects v1 hellos, because the v1 secret may be
+        derivable (genesis-hash default) and a downgraded connection
+        has no authenticated identity for the membership gate."""
         from eges_tpu.crypto.keccak import keccak256
 
         m2 = len(self.MAGIC2)
@@ -172,6 +182,8 @@ class _FrameAuth:
         elif data.startswith(self.MAGIC) and len(data) == len(self.MAGIC) + 16:
             peer_nonce = data[len(self.MAGIC):]
             if self.keypair is not None:
+                if not self.allow_downgrade:
+                    raise AuthError("v1 hello rejected (downgrade)")
                 # keyed side of a mixed pair: fall back to v1
                 self.keypair = None
         else:
@@ -218,7 +230,7 @@ class GossipPlane:
     def __init__(self, bind_ip: str, bind_port: int, peers: list[tuple[str, int]],
                  on_gossip, secret: bytes | None = None,
                  keypair: tuple[bytes, bytes] | None = None,
-                 authorize=None):
+                 authorize=None, allow_v1_peers: bool = False):
         self.bind_ip = bind_ip
         self.bind_port = bind_port
         self.peers = [p for p in peers if p != (bind_ip, bind_port)]
@@ -226,6 +238,7 @@ class GossipPlane:
         self.secret = secret
         self.keypair = keypair if secret is not None else None
         self.authorize = authorize  # callable(addr20) -> bool, v2 only
+        self.allow_v1_peers = allow_v1_peers  # mixed-mode upgrades only
         self._server: asyncio.AbstractServer | None = None
         self._writers: dict[tuple[str, int], tuple] = {}  # peer -> (writer, auth)
         self._tasks: list[asyncio.Task] = []
@@ -264,7 +277,8 @@ class GossipPlane:
         """Returns a ready _FrameAuth, or None in plaintext mode."""
         if self.secret is None:
             return None
-        auth = _FrameAuth(self.secret, keypair=self.keypair)
+        auth = _FrameAuth(self.secret, keypair=self.keypair,
+                          allow_downgrade=self.allow_v1_peers)
         writer.write(self._frame(auth.hello()))
         await writer.drain()
         auth.on_hello(await asyncio.wait_for(self._read_frame(reader),
@@ -294,25 +308,50 @@ class GossipPlane:
         finally:
             writer.close()
 
+    AUTH_RETRY_S = 60.0  # gate-rejected peers re-dial slowly: the
+    #                      membership gate may admit them once they
+    #                      register, but each attempt costs a full
+    #                      ECDSA+ECDH handshake — not a transient error.
+    #                      Two shapes of rejection: our own gate raises
+    #                      AuthError (rejected below), and the REMOTE
+    #                      gate just closes right after the handshake —
+    #                      the dialer can't see why, so repeated
+    #                      instant-closes escalate to the same slow
+    #                      cadence.  Connection-refused (peer not up
+    #                      yet: late joiners, restarts) never counts.
+
     async def _dial_loop(self, peer: tuple[str, int]) -> None:
         backoff = 0.2
+        quick_closes = 0
         while not self._closed:
+            rejected = False
+            held = None
             try:
                 reader, writer = await asyncio.open_connection(*peer)
                 try:
                     auth = await self._handshake(reader, writer)
                 except AuthError:
                     self.auth_failures += 1
+                    rejected = True
                     raise ConnectionError
                 self._writers[peer] = (writer, auth)
-                backoff = 0.2
-                # hold the connection; writer errors surface on send
-                while not writer.is_closing() and not self._closed:
-                    await asyncio.sleep(0.5)
+                t0 = time.monotonic()
+                try:
+                    # hold the connection; writer errors surface on send
+                    while not writer.is_closing() and not self._closed:
+                        await asyncio.sleep(0.5)
+                finally:
+                    held = time.monotonic() - t0
             except (ConnectionError, OSError, asyncio.TimeoutError):
                 pass
             self._writers.pop(peer, None)
-            await asyncio.sleep(backoff)
+            if held is not None and held >= 2.0:
+                backoff, quick_closes = 0.2, 0  # was a real connection
+            elif held is not None:
+                quick_closes += 1
+            await asyncio.sleep(
+                self.AUTH_RETRY_S if rejected or quick_closes >= 3
+                else backoff)
             backoff = min(backoff * 2, 5.0)
 
     def broadcast(self, data: bytes) -> None:

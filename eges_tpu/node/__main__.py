@@ -68,6 +68,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "from the genesis hash)")
     p.add_argument("--plaintextGossip", action="store_true",
                    help="disable the gossip auth layer")
+    p.add_argument("--gossipAllowlist", default="",
+                   help="comma-separated hex addresses; when set, only "
+                        "listed peers or current members may hold gossip "
+                        "connections (membership gate on the v2 "
+                        "handshake identity)")
+    p.add_argument("--allowV1Peers", action="store_true",
+                   help="accept legacy v1 symmetric hellos on a keyed "
+                        "node (mixed-mode upgrades; bypasses per-peer "
+                        "identity, so off by default)")
     return p
 
 
@@ -87,6 +96,9 @@ def main(argv=None) -> None:
         verbosity=args.verbosity, use_tpu_verifier=args.tpuVerify,
         rpc_port=args.rpcPort, net_secret_hex=args.netSecret,
         plaintext_gossip=args.plaintextGossip,
+        allow_v1_peers=args.allowV1Peers,
+        gossip_allowlist=tuple(a for a in args.gossipAllowlist.split(",")
+                               if a),
         bootnodes=parse_peers(args.bootnodes),
         verifier_mode=args.verifier)
 

@@ -44,6 +44,18 @@ class ServiceConfig:
     net_secret_hex: str = ""           # gossip-plane auth secret; ""
     #                                    derives one from the genesis hash
     plaintext_gossip: bool = False     # disable the auth layer entirely
+    allow_v1_peers: bool = False       # accept legacy v1 (symmetric)
+    #                                    hellos on keyed nodes — mixed-
+    #                                    mode upgrades only; bypasses
+    #                                    per-peer identity, so never on
+    #                                    by default
+    gossip_allowlist: tuple[str, ...] = ()  # hex addresses; when set,
+    #                                    gossip connections are admitted
+    #                                    only for peers whose handshake
+    #                                    identity is listed here OR is a
+    #                                    current member — the membership
+    #                                    gate the v2 handshake's
+    #                                    peer_addr exists to serve
     bootnodes: tuple[tuple[str, int], ...] = ()  # discovery; makes
     #                                    --peers optional (ref:
     #                                    p2p/discover + cmd/bootnode)
@@ -128,11 +140,39 @@ class NodeService:
             from eges_tpu.crypto.keccak import keccak256
             secret = keccak256(b"geec/net-secret" + genesis.hash)
         # ECDH per-connection keys (v2 handshake) whenever auth is on:
-        # session keys no other member can compute, identity = node key
+        # session keys no other member can compute, identity = node key.
+        # With an allowlist configured, that identity feeds the
+        # membership gate: a peer must be explicitly listed or already a
+        # registered member (joiners register THROUGH an allowlisted
+        # seed, so bootstrap still works).  Without one, the plane is
+        # authenticated but open — any keyholder may connect.
+        authorize = None
+        if cfg.gossip_allowlist:
+            # an allowlist only binds when every connection carries a v2
+            # identity: plaintext mode never handshakes, and v1 hellos
+            # have no identity — both would silently void the gate
+            if cfg.plaintext_gossip:
+                raise ValueError("--gossipAllowlist requires the auth "
+                                 "layer; remove --plaintextGossip")
+            if cfg.allow_v1_peers:
+                raise ValueError("--gossipAllowlist is unenforceable for "
+                                 "identity-less v1 peers; remove "
+                                 "--allowV1Peers")
+            allowed = set()
+            for a in cfg.gossip_allowlist:
+                raw = bytes.fromhex(a.removeprefix("0x"))
+                if len(raw) != 20:
+                    raise ValueError(f"allowlist entry {a!r} is not a "
+                                     "20-byte address")
+                allowed.add(raw)
+            authorize = (lambda addr: addr in allowed
+                         or addr in self.node.membership)
         self.gossip = GossipPlane(cfg.gossip_ip, cfg.gossip_port,
                                   list(cfg.peers), self.node.on_gossip,
                                   secret=secret,
-                                  keypair=(priv, secp.privkey_to_pubkey(priv)))
+                                  keypair=(priv, secp.privkey_to_pubkey(priv)),
+                                  allow_v1_peers=cfg.allow_v1_peers,
+                                  authorize=authorize)
         self.node.transport = SocketTransport(self.gossip, self.direct)
 
         self.discovery = None

@@ -1,0 +1,197 @@
+"""Opportunistic TPU bench capture: treat the tunnel as a resource that
+appears for minutes, not hours.
+
+Round-3 postmortem: the axon tunnel was down for the entire round and
+``jax.devices()`` itself hung for >15 minutes per probe, so the round
+ended with a CPU-fallback bench on record.  The watcher that existed
+only *logged* probe failures; nothing acted when the tunnel returned.
+
+This watcher closes that loop.  It runs for the whole session:
+
+1. **Probe** — spawn a killable child that just queries
+   ``jax.devices()``; hard-kill after ``PROBE_TIMEOUT_S``.  A hung
+   tunnel can only cost us one child, never the watcher.
+2. **Warm** — the moment a TPU answers, compile the 256- and 1024-row
+   recover graphs in separate killable children with the persistent
+   compilation cache enabled.  Each bucket that finishes is cached on
+   disk, so a tunnel flap mid-warm still leaves the next attempt
+   cheaper (the first-contact compile is the whole bench budget,
+   BENCH_r03: 26 s even warm on CPU).
+3. **Bench** — run ``bench.py --tpu-only`` with a generous budget and
+   stage every JSON line it prints; the best line with a non-CPU
+   device string is written to ``BENCH_tpu_capture.json`` at the repo
+   root for the driver/judge.
+4. Once a capture with p50/p99 at 1024 exists, drop to a slow
+   re-confirm cadence instead of hammering the tunnel.
+
+Status and history live under ``.tpu_watch/`` (gitignored); the capture
+file is the deliverable.  Reference hot path being measured:
+crypto/secp256k1/secp256.go:105 via core/types/transaction_signing.go.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DIR = os.path.join(_REPO, ".tpu_watch")
+CAPTURE = os.path.join(_REPO, "BENCH_tpu_capture.json")
+
+PROBE_TIMEOUT_S = float(os.environ.get("TPU_WATCH_PROBE_TIMEOUT", "75"))
+PROBE_PERIOD_S = float(os.environ.get("TPU_WATCH_PERIOD", "150"))
+SETTLED_PERIOD_S = 1800.0          # after a full capture: re-confirm slowly
+WARM_TIMEOUT_S = 420.0             # per-bucket compile child
+BENCH_BUDGET_S = float(os.environ.get("TPU_WATCH_BENCH_BUDGET", "1200"))
+
+_PROBE_SRC = (
+    "import jax, json\n"
+    "d = jax.devices()[0]\n"
+    "print('PROBE ' + json.dumps({'platform': d.platform,"
+    " 'device': str(d)}), flush=True)\n"
+)
+
+_WARM_SRC = """
+import os, sys, time, json
+import jax
+jax.config.update('jax_compilation_cache_dir',
+                  os.path.join({repo!r}, '.jax_cache'))
+jax.config.update('jax_persistent_cache_min_compile_time_secs', 2.0)
+import jax.numpy as jnp
+from eges_tpu.crypto.verifier import ecrecover_batch
+from eges_tpu.models.flagship import example_batch
+n = {batch}
+sigs, hashes, _, _ = example_batch(n, invalid_every=17)
+t0 = time.monotonic()
+out = jax.jit(ecrecover_batch)(jnp.asarray(sigs), jnp.asarray(hashes))
+jax.block_until_ready(out)
+print('WARM ' + json.dumps({{'batch': n,
+    'compile_s': round(time.monotonic() - t0, 1),
+    'device': str(jax.devices()[0])}}), flush=True)
+"""
+
+
+def _log(msg: str) -> None:
+    line = time.strftime("%H:%M:%S ") + msg
+    with open(os.path.join(_DIR, "watch.log"), "a") as f:
+        f.write(line + "\n")
+
+
+def _run_child(argv: list[str], timeout: float,
+               env: dict | None = None) -> tuple[int, str]:
+    """Run argv in its own process group; SIGKILL the whole group on
+    timeout (a hung axon client ignores SIGTERM)."""
+    proc = subprocess.Popen(
+        argv, cwd=_REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        return proc.returncode, out.decode(errors="replace")
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        # collect whatever the child wrote before hanging — the log is
+        # the only postmortem for a wedged axon client
+        out, _ = proc.communicate()
+        return -9, out.decode(errors="replace")
+
+
+def probe() -> dict | None:
+    rc, out = _run_child([sys.executable, "-c", _PROBE_SRC],
+                         PROBE_TIMEOUT_S)
+    for line in out.splitlines():
+        if line.startswith("PROBE "):
+            info = json.loads(line[len("PROBE "):])
+            if info["platform"] not in ("cpu", "interpreter"):
+                return info
+    return None
+
+
+def warm(batch: int) -> bool:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    src = _WARM_SRC.format(repo=_REPO, batch=batch)
+    rc, out = _run_child([sys.executable, "-c", src], WARM_TIMEOUT_S, env)
+    for line in out.splitlines():
+        if line.startswith("WARM "):
+            _log(f"warm ok: {line[5:]}")
+            return True
+    _log(f"warm {batch} failed rc={rc}: {out[-300:]!r}")
+    return False
+
+
+def bench() -> dict | None:
+    """Run the real bench TPU-only; return the best TPU-device line."""
+    env = dict(os.environ)
+    env["BENCH_BUDGET_S"] = str(BENCH_BUDGET_S)
+    rc, out = _run_child(
+        [sys.executable, os.path.join(_REPO, "bench.py"), "--tpu-only"],
+        BENCH_BUDGET_S + 120, env)
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    with open(os.path.join(_DIR, f"bench-{stamp}.out"), "w") as f:
+        f.write(out)
+    best = None
+    for line in out.splitlines():
+        try:
+            res = json.loads(line)
+        except ValueError:
+            continue
+        dev = str(res.get("device", ""))
+        if not dev or "CPU" in dev.upper():
+            continue
+        # rank: a line carrying the p50@1024 latency beats any line
+        # without it (that field is the BASELINE.md deliverable); among
+        # equals, higher throughput wins
+        def rank(r: dict) -> tuple:
+            return ("p50_latency_ms_at_1024" in r, r.get("value", 0))
+
+        if best is None or rank(res) >= rank(best):
+            best = res
+    return best
+
+
+def main() -> None:
+    os.makedirs(_DIR, exist_ok=True)
+    _log(f"watcher start pid={os.getpid()}")
+    captured_full = False
+    if os.path.exists(CAPTURE):
+        try:
+            with open(CAPTURE) as f:
+                captured_full = "p50_latency_ms_at_1024" in json.load(f)
+        except Exception:
+            pass
+    while True:
+        info = probe()
+        if info is None:
+            _log("probe: tunnel down")
+            time.sleep(PROBE_PERIOD_S)
+            continue
+        _log(f"probe: TPU UP {info}")
+        # warm the two buckets the bench needs first; each is its own
+        # child so a flap mid-compile still banks the finished buckets.
+        # A warm failure means the tunnel just flapped — go back to the
+        # cheap probe cadence instead of sinking the full bench budget
+        # into a dead tunnel.
+        if not all(warm(b) for b in (256, 1024)):
+            time.sleep(PROBE_PERIOD_S)
+            continue
+        res = bench()
+        if res is not None:
+            res["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+            with open(CAPTURE, "w") as f:
+                json.dump(res, f, indent=1)
+            _log(f"CAPTURED: {json.dumps(res)}")
+            captured_full = "p50_latency_ms_at_1024" in res
+        else:
+            _log("bench produced no TPU-device line")
+        time.sleep(SETTLED_PERIOD_S if captured_full else PROBE_PERIOD_S)
+
+
+if __name__ == "__main__":
+    main()

@@ -76,3 +76,58 @@ def test_hash_to_g1_in_subgroup():
     for i in range(3):
         pt = aggsig.hash_to_g1(bytes([i]) * 4)
         assert bls.g1_in_subgroup(pt)
+
+
+def test_subgroup_checks_reject_non_subgroup_points():
+    """On-curve points OUTSIDE the prime-order subgroup must be rejected.
+
+    Round-3 advisor finding: g1_mul/g2_mul reduced the scalar mod the
+    group order, so ``order * pt`` used a zero scalar and every on-curve
+    point passed — making the rogue-point defense in aggsig and the EVM
+    pairing precompile's EIP-197 G2 enforcement vacuous.  These points
+    were found by solving y^2 = x^3 + b over the field for small x (an
+    F_{p^2} sqrt for the twists) and checking they escape the subgroup;
+    the reference's bn256 rejects such points at unmarshal
+    (crypto/bn256/cloudflare/bn256.go UnmarshalG2).
+    """
+    from eges_tpu.crypto import bls12_381 as bls
+    from eges_tpu.crypto import bn254 as bn
+
+    # BLS12-381 G1: cofactor ~2^125, plenty of on-curve escapees
+    g1_bad = (4, 1630892974828014537729259858097113969650871260980656934049590190201941782487224876496582135785777461178964897591404)
+    assert bls.g1_is_on_curve(g1_bad)
+    assert not bls.g1_in_subgroup(g1_bad)
+
+    # BLS12-381 G2 twist
+    g2_bad = ((1, 1),
+              (311688683428330151962104749992854273459448819385146446203084639679840366624001480874956539328156700613564807878113,
+               3879716364193915737907595657035595943018088573163693908517845603495240024895728806625723123689514181843611925140285))
+    assert bls.g2_is_on_curve(g2_bad)
+    assert not bls.g2_in_subgroup(g2_bad)
+
+    # bn254 G2 twist (G1 there has cofactor 1: on-curve == in-subgroup)
+    bn_g2_bad = ((2, 1),
+                 (7292567877523311580221095596750716176434782432868683424513645834767876293070,
+                  19659275751359636165940301690575149581329631496732780143538578556285923319774))
+    assert bn.g2_is_on_curve(bn_g2_bad)
+    assert not bn.g2_in_subgroup(bn_g2_bad)
+
+    # and the genuine generators still pass
+    assert bls.g1_in_subgroup(bls.G1)
+    assert bls.g2_in_subgroup(bls.G2)
+    assert bn.g2_in_subgroup(bn.G2)
+
+
+def test_aggsig_rejects_non_subgroup_signature_and_pubkey():
+    """The wire-level defense: a signature/pubkey outside the subgroup
+    fails verification (not just the raw math helper)."""
+    from eges_tpu.crypto import bls12_381 as bls
+
+    sk, pk = aggsig.keygen(b"seed-x")
+    sig = aggsig.sign(sk, b"msg")
+    g1_bad = (4, 1630892974828014537729259858097113969650871260980656934049590190201941782487224876496582135785777461178964897591404)
+    assert not aggsig.verify(pk, b"msg", g1_bad)
+    g2_bad = ((1, 1),
+              (311688683428330151962104749992854273459448819385146446203084639679840366624001480874956539328156700613564807878113,
+               3879716364193915737907595657035595943018088573163693908517845603495240024895728806625723123689514181843611925140285))
+    assert not aggsig.verify(g2_bad, b"msg", sig)

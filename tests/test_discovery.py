@@ -146,12 +146,24 @@ def test_v2_sessions_have_distinct_keys_per_connection():
     assert C.send_key != B1.recv_key  # ...but derives different keys
 
 
-def test_mixed_v1_v2_handshake_interops():
-    """A keyed (v2) endpoint and a keyless (v1) endpoint must still
-    derive matching session keys — mixed generations/tooling interop."""
+def test_keyed_endpoint_rejects_v1_hello_by_default():
+    """Round-3 advisor: silently downgrading on a v1 hello bypassed the
+    authorize() membership gate, and the default v1 secret is derivable
+    from the public genesis file.  Downgrade must be explicit opt-in."""
     net = b"\x33" * 32
     pa, puba, _ = kp(15)
     keyed = _FrameAuth(net, keypair=(pa, puba))
+    keyless = _FrameAuth(net)
+    with pytest.raises(AuthError):
+        keyed.on_hello(keyless.hello())
+
+
+def test_mixed_v1_v2_handshake_interops():
+    """A keyed (v2) endpoint opting into mixed mode and a keyless (v1)
+    endpoint still derive matching session keys — upgrade interop."""
+    net = b"\x33" * 32
+    pa, puba, _ = kp(15)
+    keyed = _FrameAuth(net, keypair=(pa, puba), allow_downgrade=True)
     keyless = _FrameAuth(net)
     keyed_hello = keyed.hello()      # v2
     keyless_hello = keyless.hello()  # v1

@@ -318,3 +318,31 @@ def test_delegatecall_keeps_caller_and_storage_context():
     assert s.storage_at(lib, 1) == 0
     # CALLER inside the delegated frame is the proxy's caller (A)
     assert s.storage_at(proxy, 0) == int.from_bytes(A, "big")
+
+
+def test_blockhash_serves_only_previous_256_ancestors():
+    """Distance 0 (the block being executed — hash not yet sealed) and
+    distances > 256 push zero; 1..256 hit the callable (round-3 advisor;
+    ref core/vm/instructions.go opBlockhash)."""
+    served = []
+
+    def bh(n):
+        served.append(n)
+        return n.to_bytes(32, "big")
+
+    # PUSH1 <n>, BLOCKHASH, PUSH1 0, MSTORE, PUSH1 32, PUSH1 0, RETURN
+    def probe(n):
+        code = bytes([0x60, n, 0x40, 0x60, 0x00, 0x52,
+                      0x60, 0x20, 0x60, 0x00, 0xF3])
+        s = st()
+        s.set_code(B, code)
+        e = EVM(s, BlockCtx(coinbase=COINBASE, number=7, time=99,
+                            blockhash=bh))
+        res = e.call(A, B, 0, b"", 1_000_000)
+        assert res.success
+        return int.from_bytes(res.output, "big")
+
+    assert probe(6) == 6          # distance 1: served
+    assert probe(7) == 0          # distance 0: the current block — zero
+    assert probe(8) == 0          # future block — zero
+    assert 7 not in served and 8 not in served

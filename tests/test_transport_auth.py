@@ -115,3 +115,45 @@ def test_metrics_are_wired():
     rpc = RpcServer(c.nodes[0].chain, node=c.nodes[0].node)
     out = rpc.dispatch("thw_metrics", [])
     assert out["chain.blocks"] >= 15
+
+
+def test_service_wires_membership_gate_from_allowlist(tmp_path):
+    """Round-4 review: the v2 handshake identity must actually feed an
+    authorize() gate on the node — an allowlisted or registered peer is
+    admitted, anyone else is rejected even with a valid network secret."""
+    import json as _json
+
+    from eges_tpu.crypto import secp256k1 as secp
+    from eges_tpu.node.service import NodeService, ServiceConfig
+
+    gen = tmp_path / "genesis.json"
+    gen.write_text(_json.dumps({"config": {"thw": {}}, "timestamp": "0x0"}))
+    priv = bytes([7]) * 32
+    friend = secp.pubkey_to_address(secp.privkey_to_pubkey(bytes([8]) * 32))
+    stranger = secp.pubkey_to_address(secp.privkey_to_pubkey(bytes([9]) * 32))
+
+    async def run():
+        svc = NodeService(ServiceConfig(
+            datadir=str(tmp_path / "d"), genesis_path=str(gen),
+            key_hex=priv.hex(), verifier_mode="none", mine=False,
+            gossip_allowlist=(friend.hex(),)))
+        gate = svc.gossip.authorize
+        assert gate is not None
+        assert gate(friend)
+        assert not gate(stranger)
+        # a registered member passes without being listed
+        from eges_tpu.consensus.membership import Member
+        svc.node.membership.add(Member(addr=stranger, ip="127.0.0.1",
+                                       port=1))
+        assert gate(stranger)
+
+    asyncio.run(run())
+
+    # without an allowlist the plane stays open (authenticated only)
+    async def run_open():
+        svc = NodeService(ServiceConfig(
+            datadir=str(tmp_path / "d2"), genesis_path=str(gen),
+            key_hex=priv.hex(), verifier_mode="none", mine=False))
+        assert svc.gossip.authorize is None
+
+    asyncio.run(run_open())
