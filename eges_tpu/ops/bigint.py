@@ -510,6 +510,14 @@ class OrderN(Mod):
         super().__init__(N, n_folds=3)
 
     def mul(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        # EGES_TPU_PALLAS=ladder on hardware: the mod-N multiply rides
+        # its Mosaic kernel alongside the fused ladder step (only ~8
+        # calls per recover — the win is uniformity, not throughput)
+        from eges_tpu.ops.pallas_kernels import ladder_kernels_enabled
+        if ladder_kernels_enabled() and a.ndim >= 2 and a.shape == b.shape:
+            from eges_tpu.ops.pallas_kernels import fn_mul_pallas
+            return fn_mul_pallas(a.reshape(-1, NLIMBS),
+                                 b.reshape(-1, NLIMBS)).reshape(a.shape)
         return self._red_cols(big_mul_cols(a, b))
 
     def sqr(self, a: jnp.ndarray) -> jnp.ndarray:

@@ -378,9 +378,23 @@ def strauss_gR(u1: jnp.ndarray, u2: jnp.ndarray, rx: jnp.ndarray, ry: jnp.ndarra
                       jnp.broadcast_to(n1r, d_g1.shape[:-1]),
                       jnp.broadcast_to(n2r, d_g1.shape[:-1])])
 
+    # EGES_TPU_PALLAS=ladder: the window step (4 doublings + 4
+    # conditional adds) runs as two fused Mosaic kernels instead of the
+    # XLA subgraphs — same math, VMEM-resident accumulator, and a
+    # compiled graph measured in hundreds of ops instead of tens of
+    # thousands (ops/pallas_kernels.py; TPU backend only)
+    from eges_tpu.ops.pallas_kernels import (
+        ladder_add_mixed, ladder_double4, ladder_kernels_enabled,
+    )
+    use_kernels = ladder_kernels_enabled() and rx.ndim == 2
+
     def body(i, acc):
         j = GLV_WINDOWS - 1 - i
-        acc = jax.lax.fori_loop(0, WINDOW, lambda _, a: jac_double(a), acc)
+        if use_kernels:
+            acc = ladder_double4(acc)
+        else:
+            acc = jax.lax.fori_loop(0, WINDOW,
+                                    lambda _, a: jac_double(a), acc)
         dj = [jax.lax.dynamic_index_in_dim(d, j, axis=-1, keepdims=False)
               for d in (d_g1, d_g2, d_r1, d_r2)]
         # stacked operands so the conditional mixed add traces ONCE
@@ -395,6 +409,8 @@ def strauss_gR(u1: jnp.ndarray, u2: jnp.ndarray, rx: jnp.ndarray, ry: jnp.ndarra
         nzs = jnp.stack([(d != 0).astype(jnp.uint32) for d in dj])
 
         def add_step(t, a):
+            if use_kernels:
+                return ladder_add_mixed(a, xs[t], ys[t], negs[t], nzs[t])
             y_t = select(negs[t], FP.neg(ys[t]), ys[t])
             added = jac_add_mixed(a, xs[t], y_t)
             return tuple(select(nzs[t], n, o) for n, o in zip(added, a))

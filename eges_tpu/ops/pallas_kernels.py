@@ -2,53 +2,113 @@
 "secp256k1 batch ops as JAX/Pallas kernels").
 
 The XLA graph form of the verifier (ops/bigint.py, ops/ec.py) already
-keeps everything fused on-device; these kernels are the next rung —
-hand-placed VMEM tiles for the single hottest primitive, the F_P
-modular multiply, which the Strauss ladder executes ~4000x per
-recovered signature.
+keeps everything fused on-device, but it pays twice for being a graph:
+~66k StableHLO ops (45-85 s compiles) and per-op dispatch granularity.
+These kernels collapse the Strauss ladder's window step — the ~4000
+field multiplies per recovered signature — into TWO hand-tiled Mosaic
+kernels:
 
-Layout: the graph stores a field element as ``[B, 16]`` u32 limbs
-(rows on sublanes).  The kernel TRANSPOSES to ``[16, B]`` — 16 limbs
-land exactly on a float32-tile's 8x128 sublane granularity (two
-sublanes of 8) and the batch rides the 128-wide lane axis, so every
-limb row is one natural VPU vector.  The schoolbook product unrolls
-256 mul-adds over Python-static sublane indices; the pseudo-Mersenne
-reduction mirrors ``FieldP._reduce_cols`` bit-for-bit (same fold
-constants, same carry chains), so kernel and graph agree exactly.
+* ``ladder_double4``: four chained Jacobian doublings (the per-window
+  doubling run) with the accumulator resident in VMEM throughout.
+* ``ladder_add_mixed``: one conditional mixed add — table operand,
+  per-row y-negation (GLV sign), the branchless exceptional cases of
+  ``ec.jac_add_mixed`` (infinity/double/opposite) and the digit!=0
+  select, all fused.
 
-The kernel is opt-in (`EGES_TPU_PALLAS=1` or ``use_pallas=True``
-callers) and falls back to the jnp path off-TPU; correctness is pinned
-by a differential test in interpret mode (tests/test_pallas_kernels.py).
+Layout: the graph stores a field element as ``[B, 16]`` u32 limbs (rows
+on sublanes).  Kernels TRANSPOSE to ``[16, B]`` — 16 limbs land exactly
+on two 8-sublane rows and the batch rides the 128-wide lane axis, so
+every limb row is one natural VPU vector.  The in-kernel field library
+(``_k_*``) mirrors ``bigint.FieldP`` bit-for-bit — same fold constants,
+same carry chains, same relaxed representation — so kernel and graph
+agree exactly.  Testing strategy (tests/test_pallas_kernels.py): the
+small F_P-mul kernel is differential-tested through ``pallas_call`` in
+interpret mode (covering the shared tiling/transpose plumbing); the
+fused ladder kernels' MATH is differential-tested in pure numpy via the
+``xp`` namespace parameter (identical uint32 wrap semantics, runs in
+milliseconds where interpret-mode XLA compiles of the flat graphs take
+tens of minutes on a 1-core host); the kernels themselves are exercised
+end-to-end only on a real TPU (Mosaic), where ``harness/tpu_watch.py``
+A/Bs them the moment the tunnel answers.
+
+Dispatch: ``EGES_TPU_PALLAS=1`` keeps the historical per-multiply
+kernel hook in ``FieldP.mul``; ``EGES_TPU_PALLAS=ladder`` routes the
+``strauss_gR`` window step through the fused kernels — on the TPU
+backend only (interpret mode lowers kernels back to per-block HLO,
+which would re-explode the CPU graph the rolled loops were built to
+avoid).
+
+Ref role: crypto/secp256k1/libsecp256k1/src/ecmult_impl.h (the windowed
+ladder the reference runs in C); consumed by secp256.go:105.
 """
 
 from __future__ import annotations
 
+import functools
 import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from eges_tpu.ops.bigint import MASK, NLIMBS
+from eges_tpu.ops.bigint import MASK, NLIMBS, P, int_to_limbs
 
 LANE_BLOCK = 256  # batch columns per kernel invocation
 
+_P_LIMBS = [int(v) for v in int_to_limbs(P)]
+_SUBC_LIMBS = [int(v) for v in int_to_limbs((1 << 256) - 2 * ((1 << 256) - P) + 1)]
+_ONE_LIMBS = [1] + [0] * 15
 
-def _fp_mul_kernel(a_ref, b_ref, out_ref):
-    """One [16, LANE_BLOCK] tile: out = a * b mod P (relaxed form).
 
-    Mirrors ``big_mul_cols`` + ``FieldP._reduce_cols``: column sums of
-    the 16x16 limb products (anti-diagonal accumulation), two
-    delta-folds of the high columns (delta_P = 2^32 + 977), two full
-    carry chains and the closing 5-step mini-chain.
+# ---------------------------------------------------------------------------
+# in-kernel field library: a value is a Python list of 16 [B]-wide u32
+# vectors (limb-major).  Bit-identical to bigint.FieldP's relaxed form.
+# ---------------------------------------------------------------------------
+
+def _k_carry_tail(cols, xp=jnp):
+    """16 columns (each < 2^31) -> relaxed 16-limb value; the shared
+    reduction tail of ``FieldP._reduce_cols`` (two full carry chains +
+    delta folds + the closing 5-step mini-chain).
+
+    All ``_k_*`` helpers take an array namespace ``xp``: ``jnp`` when
+    tracing inside a kernel, ``numpy`` in the differential tests — the
+    flat unrolled math is far too large for XLA CPU to compile in
+    reasonable time (compile cost grows superlinearly in flat-graph
+    size; measured 9 s for one in-kernel multiply, 84 s for four), but
+    numpy executes it in milliseconds with the exact same uint32 wrap
+    semantics, pinning the math bit-for-bit against the graph path.
     """
-    a = a_ref[:, :]  # [16, B]
-    b = b_ref[:, :]
-    mask = jnp.uint32(MASK)
+    mask = xp.uint32(MASK)
+    c977 = xp.uint32(977)
+    out = []
+    c = xp.zeros_like(cols[0])
+    for k in range(16):
+        t = cols[k] + c
+        out.append(t & mask)
+        c = t >> 16
+    out[0] = out[0] + c * c977
+    out[2] = out[2] + c
+    c = xp.zeros_like(c)
+    for k in range(16):
+        t = out[k] + c
+        out[k] = t & mask
+        c = t >> 16
+    out[0] = out[0] + c * c977
+    out[2] = out[2] + c
+    cc = xp.zeros_like(c)
+    for k in range(5):
+        t = out[k] + cc
+        out[k] = t & mask
+        cc = t >> 16
+    return out
 
-    # schoolbook columns: cols[k] = sum_{i+j=k} lo(a_i b_j)
-    #                             + sum_{i+j=k-1} hi(a_i b_j)   (< 2^21)
-    zero = jnp.zeros_like(a[0])
+
+def _k_mul(a, b, xp=jnp):
+    """Schoolbook 16x16 product columns + delta folds + carry tail
+    (mirrors ``big_mul_cols`` + ``FieldP._reduce_cols``)."""
+    mask = xp.uint32(MASK)
+    c977 = xp.uint32(977)
+    zero = xp.zeros_like(a[0])
     cols = [zero] * 32
     for i in range(NLIMBS):
         ai = a[i]
@@ -56,12 +116,9 @@ def _fp_mul_kernel(a_ref, b_ref, out_ref):
             p = ai * b[j]
             cols[i + j] = cols[i + j] + (p & mask)
             cols[i + j + 1] = cols[i + j + 1] + (p >> 16)
-
-    # fold 1: columns 16..31 via delta = 2^32 + 977  (w = 18 wide)
-    c977 = jnp.uint32(977)
+    # fold columns >= 16 via delta = 2^32 + 977 (two passes suffice)
     for _ in range(2):
-        w = len(cols)
-        if w <= 16:
+        if len(cols) <= 16:
             break
         hi = cols[16:]
         lo = cols[:16] + [zero] * max(0, len(hi) + 2 - 16)
@@ -69,63 +126,348 @@ def _fp_mul_kernel(a_ref, b_ref, out_ref):
             lo[j] = lo[j] + h * c977
             lo[j + 2] = lo[j + 2] + h
         cols = lo[: max(16, len(hi) + 2)]
+    return _k_carry_tail(cols, xp)
 
-    # first full carry
-    out = []
-    carry = zero
-    for k in range(16):
-        t = cols[k] + carry
-        out.append(t & mask)
-        carry = t >> 16
-    out[0] = out[0] + carry * c977
-    out[2] = out[2] + carry
-    # second full carry
-    carry = zero
-    for k in range(16):
-        t = out[k] + carry
-        out[k] = t & mask
-        carry = t >> 16
-    out[0] = out[0] + carry * c977
-    out[2] = out[2] + carry
-    # closing mini-chain
-    carry = zero
-    for k in range(5):
-        t = out[k] + carry
-        out[k] = t & mask
-        carry = t >> 16
 
-    for k in range(16):
-        out_ref[k, :] = out[k]
+def _k_sqr(a, xp=jnp):
+    return _k_mul(a, a, xp)
+
+
+def _k_add(a, b, xp=jnp):
+    return _k_carry_tail([x + y for x, y in zip(a, b)], xp)
+
+
+def _k_sub(a, b, xp=jnp):
+    """Branchless a - b: a + (0xFFFF - b) + (2^256 - 2*delta + 1),
+    mirroring ``FieldP.sub``."""
+    mask = xp.uint32(MASK)
+    return _k_carry_tail([
+        x + (mask - y) + xp.uint32(_SUBC_LIMBS[k])
+        for k, (x, y) in enumerate(zip(a, b))], xp)
+
+
+def _k_neg(a, xp=jnp):
+    return _k_sub([xp.zeros_like(v) for v in a], a, xp)
+
+
+def _k_mul_small(a, k: int, xp=jnp):
+    assert k < 16
+    return _k_carry_tail([v * xp.uint32(k) for v in a], xp)
+
+
+def _k_is_zero_mod(a, xp=jnp):
+    """Relaxed a ≡ 0 (mod P): exactly 0 or exactly P (u32 0/1 vector)."""
+    z = a[0] == 0
+    p = a[0] == xp.uint32(_P_LIMBS[0])
+    for k in range(1, 16):
+        z = z & (a[k] == 0)
+        p = p & (a[k] == xp.uint32(_P_LIMBS[k]))
+    return (z | p).astype(xp.uint32)
+
+
+def _k_select(flag, a, b, xp=jnp):
+    """flag ? a : b, flag a [B] u32 0/1 vector."""
+    f = flag.astype(bool)
+    return [xp.where(f, x, y) for x, y in zip(a, b)]
+
+
+def _k_jac_double(X1, Y1, Z1, xp=jnp):
+    """Mirror of ``ec.jac_double`` (dbl-2009-l, a=0)."""
+    A = _k_sqr(X1, xp)
+    B = _k_sqr(Y1, xp)
+    C = _k_sqr(B, xp)
+    t = _k_sqr(_k_add(X1, B, xp), xp)
+    D = _k_mul_small(_k_sub(_k_sub(t, A, xp), C, xp), 2, xp)
+    E = _k_mul_small(A, 3, xp)
+    F = _k_sqr(E, xp)
+    X3 = _k_sub(F, _k_mul_small(D, 2, xp), xp)
+    Y3 = _k_sub(_k_mul(E, _k_sub(D, X3, xp), xp), _k_mul_small(C, 8, xp), xp)
+    Z3 = _k_mul_small(_k_mul(Y1, Z1, xp), 2, xp)
+    return X3, Y3, Z3
+
+
+def _k_jac_add_mixed(X1, Y1, Z1, x2, y2, xp=jnp):
+    """Mirror of ``ec.jac_add_mixed`` (madd-2007-bl + branchless
+    exceptional cases)."""
+    Z1Z1 = _k_sqr(Z1, xp)
+    U2 = _k_mul(x2, Z1Z1, xp)
+    S2 = _k_mul(_k_mul(y2, Z1, xp), Z1Z1, xp)
+    H = _k_sub(U2, X1, xp)
+    r = _k_sub(S2, Y1, xp)
+
+    HH = _k_sqr(H, xp)
+    I = _k_mul_small(HH, 4, xp)
+    J = _k_mul(H, I, xp)
+    rr = _k_mul_small(r, 2, xp)
+    V = _k_mul(X1, I, xp)
+    X3 = _k_sub(_k_sub(_k_sqr(rr, xp), J, xp), _k_mul_small(V, 2, xp), xp)
+    Y3 = _k_sub(_k_mul(rr, _k_sub(V, X3, xp), xp),
+                _k_mul_small(_k_mul(Y1, J, xp), 2, xp), xp)
+    Z3 = _k_mul(_k_mul_small(Z1, 2, xp), H, xp)
+
+    DX, DY, DZ = _k_jac_double(X1, Y1, Z1, xp)
+
+    h0 = _k_is_zero_mod(H, xp)
+    r0 = _k_is_zero_mod(r, xp)
+    p1_inf = _k_is_zero_mod(Z1, xp)
+    dbl = h0 * r0
+    opp = h0 * (1 - r0)
+
+    onef = [xp.broadcast_to(xp.uint32(v), X1[0].shape)
+            for v in _ONE_LIMBS]
+    zerof = [xp.zeros_like(v) for v in X1]
+    X = _k_select(dbl, DX, X3, xp)
+    Y = _k_select(dbl, DY, Y3, xp)
+    Z = _k_select(dbl, DZ, Z3, xp)
+    Z = _k_select(opp, zerof, Z, xp)
+    Y = _k_select(opp, onef, Y, xp)
+    X = _k_select(p1_inf, x2, X, xp)
+    Y = _k_select(p1_inf, y2, Y, xp)
+    Z = _k_select(p1_inf, onef, Z, xp)
+    return X, Y, Z
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+def _read16(ref):
+    return [ref[k, :] for k in range(NLIMBS)]
+
+
+def _write16(ref, val):
+    for k in range(NLIMBS):
+        ref[k, :] = val[k]
+
+
+def _fp_mul_kernel(a_ref, b_ref, out_ref):
+    """One [16, LANE_BLOCK] tile: out = a * b mod P (relaxed form)."""
+    _write16(out_ref, _k_mul(_read16(a_ref), _read16(b_ref)))
+
+
+def _double4_kernel(x_ref, y_ref, z_ref, ox_ref, oy_ref, oz_ref):
+    """Four chained Jacobian doublings — the WINDOW=4 doubling run of a
+    Strauss window step — with the point resident in VMEM throughout."""
+    X, Y, Z = _read16(x_ref), _read16(y_ref), _read16(z_ref)
+    for _ in range(4):
+        X, Y, Z = _k_jac_double(X, Y, Z)
+    _write16(ox_ref, X)
+    _write16(oy_ref, Y)
+    _write16(oz_ref, Z)
+
+
+def _add_mixed_kernel(x_ref, y_ref, z_ref, px_ref, py_ref,
+                      neg_ref, nz_ref, ox_ref, oy_ref, oz_ref):
+    """One fused conditional table add: y-negation by the GLV sign flag,
+    the full branchless mixed add, then the digit!=0 select."""
+    X, Y, Z = _read16(x_ref), _read16(y_ref), _read16(z_ref)
+    px, py = _read16(px_ref), _read16(py_ref)
+    neg = neg_ref[0, :]
+    nz = nz_ref[0, :]
+    py = _k_select(neg, _k_neg(py), py)
+    AX, AY, AZ = _k_jac_add_mixed(X, Y, Z, px, py)
+    _write16(ox_ref, _k_select(nz, AX, X))
+    _write16(oy_ref, _k_select(nz, AY, Y))
+    _write16(oz_ref, _k_select(nz, AZ, Z))
+
+
+# ---------------------------------------------------------------------------
+# wrappers: [B, 16] graph layout <-> [16, B] kernel tiles
+# ---------------------------------------------------------------------------
+
+def _as_tiles(arrs, flags, B):
+    pad = (-B) % LANE_BLOCK
+    ats = [jnp.pad(a, ((0, pad), (0, 0))).T for a in arrs]
+    fts = [jnp.pad(f.astype(jnp.uint32), (0, pad)).reshape(1, -1)
+           for f in flags]
+    return ats, fts, ats[0].shape[1] // LANE_BLOCK
+
+
+def _pallas(kernel, ats, fts, n_blocks, n_out, interpret):
+    from jax.experimental import pallas as pl
+
+    wide = ats[0].shape[1]
+    specs = ([pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i))] * len(ats)
+             + [pl.BlockSpec((1, LANE_BLOCK), lambda i: (0, i))] * len(fts))
+    return pl.pallas_call(
+        kernel,
+        out_shape=tuple(jax.ShapeDtypeStruct((NLIMBS, wide), jnp.uint32)
+                        for _ in range(n_out)),
+        grid=(n_blocks,),
+        in_specs=specs,
+        out_specs=tuple(pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i))
+                        for _ in range(n_out)),
+        interpret=interpret,
+    )(*ats, *fts)
 
 
 def fp_mul_pallas(a: jnp.ndarray, b: jnp.ndarray, *,
                   interpret: bool | None = None) -> jnp.ndarray:
     """``[B, 16] x [B, 16] -> [B, 16]`` F_P multiply via the Pallas
     kernel; bit-identical to ``bigint.FP.mul`` (relaxed outputs)."""
-    from jax.experimental import pallas as pl
-
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        # axon is the tunnel's TPU platform — real Mosaic, not interpret
+        interpret = jax.default_backend() not in ("tpu", "axon")
     B = a.shape[0]
-    pad = (-B) % LANE_BLOCK
-    at = jnp.pad(a, ((0, pad), (0, 0))).T  # [16, B+pad]
-    bt = jnp.pad(b, ((0, pad), (0, 0))).T
-    n_blocks = at.shape[1] // LANE_BLOCK
-
-    out = pl.pallas_call(
-        _fp_mul_kernel,
-        out_shape=jax.ShapeDtypeStruct(at.shape, jnp.uint32),
-        grid=(n_blocks,),
-        in_specs=[pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i)),
-                  pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i))],
-        out_specs=pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i)),
-        interpret=interpret,
-    )(at, bt)
+    ats, _, nb = _as_tiles([a, b], [], B)
+    out, = _pallas(_fp_mul_kernel, ats, [], nb, 1, interpret)
     return out.T[:B]
 
 
+def ladder_double4(pt, *, interpret: bool | None = None):
+    """Four doublings of a Jacobian point batch ``(X, Y, Z)`` each
+    ``[B, 16]``; bit-identical to four ``ec.jac_double`` calls."""
+    if interpret is None:
+        # axon is the tunnel's TPU platform — real Mosaic, not interpret
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    B = pt[0].shape[0]
+    ats, _, nb = _as_tiles(list(pt), [], B)
+    out = _pallas(_double4_kernel, ats, [], nb, 3, interpret)
+    return tuple(o.T[:B] for o in out)
+
+
+def ladder_add_mixed(pt, px, py, neg, nz, *,
+                     interpret: bool | None = None):
+    """Fused conditional mixed add: ``pt + (px, ±py)`` where the sign is
+    ``neg`` per row, rows with ``nz == 0`` keep ``pt``.  Bit-identical
+    to the select/neg/``ec.jac_add_mixed`` composition in
+    ``strauss_gR``'s add step."""
+    if interpret is None:
+        # axon is the tunnel's TPU platform — real Mosaic, not interpret
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    B = pt[0].shape[0]
+    ats, fts, nb = _as_tiles(list(pt) + [px, py], [neg, nz], B)
+    out = _pallas(_add_mixed_kernel, ats, fts, nb, 3, interpret)
+    return tuple(o.T[:B] for o in out)
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
 def pallas_enabled() -> bool:
-    """Opt-in switch: ``EGES_TPU_PALLAS=1`` at import time routes
-    ``FP.mul`` on 2-D batches through the kernel (see
-    ``bigint.FieldP.mul``'s dispatch)."""
+    """Historical opt-in: ``EGES_TPU_PALLAS=1`` routes ``FP.mul`` on 2-D
+    batches through the per-multiply kernel (``bigint.FieldP.mul``)."""
     return os.environ.get("EGES_TPU_PALLAS", "") == "1"
+
+
+@functools.lru_cache(maxsize=1)
+def ladder_kernels_enabled() -> bool:
+    """``EGES_TPU_PALLAS=ladder`` fuses the Strauss window step into the
+    double4/add kernels — TPU backend only (interpret mode would lower
+    each kernel back to per-block HLO and re-explode the CPU graph)."""
+    return (os.environ.get("EGES_TPU_PALLAS", "") == "ladder"
+            and jax.default_backend() in ("tpu", "axon"))
+
+
+# ---------------------------------------------------------------------------
+# order-N (scalar field) multiply kernel: mirrors OrderN.mul =
+# _red_cols(big_mul_cols(a, b)) — the mod-N arithmetic of the scalar
+# recovery prelude (u1/u2, GLV decomposition)
+# ---------------------------------------------------------------------------
+
+from eges_tpu.ops.bigint import N as _ORDER_N  # noqa: E402
+
+_N_LIMBS_C = [int(v) for v in int_to_limbs(_ORDER_N)]
+_N_DELTA = (1 << 256) - _ORDER_N
+_N_DELTA_LIMBS = [int(v)
+                  for v in int_to_limbs(_N_DELTA,
+                                        (_N_DELTA.bit_length() + 15) // 16)]
+
+
+def _k_carry(cols, n_out, xp=jnp):
+    """Generic carry chain over small (< 2^31) columns -> n_out limbs."""
+    mask = xp.uint32(MASK)
+    out = []
+    c = xp.zeros_like(cols[0])
+    for k in range(len(cols)):
+        t = cols[k] + c
+        out.append(t & mask)
+        c = t >> 16
+    while len(out) < n_out:
+        out.append(c & mask)
+        c = c >> 16
+    return out[:n_out]
+
+
+def _k_mul_cols(a, b_const, xp=jnp):
+    """Uncarried schoolbook columns of (limb list a) x (Python-int limb
+    constants b_const); mirrors ``big_mul_cols``."""
+    mask = xp.uint32(MASK)
+    zero = xp.zeros_like(a[0])
+    cols = [zero] * (len(a) + len(b_const))
+    for i, ai in enumerate(a):
+        for j, bj in enumerate(b_const):
+            p = ai * xp.uint32(bj)
+            cols[i + j] = cols[i + j] + (p & mask)
+            cols[i + j + 1] = cols[i + j + 1] + (p >> 16)
+    return cols
+
+
+def _k_mul_cols_vv(a, b, xp=jnp):
+    """Uncarried schoolbook columns, both operands limb lists."""
+    mask = xp.uint32(MASK)
+    zero = xp.zeros_like(a[0])
+    cols = [zero] * (len(a) + len(b))
+    for i, ai in enumerate(a):
+        for j, bj in enumerate(b):
+            p = ai * bj
+            cols[i + j] = cols[i + j] + (p & mask)
+            cols[i + j + 1] = cols[i + j + 1] + (p >> 16)
+    return cols
+
+
+def _k_cond_sub_n(a, xp=jnp):
+    """One conditional subtract of N (borrow chain + select)."""
+    mask = xp.uint32(MASK)
+    out = []
+    borrow = xp.zeros_like(a[0])
+    for k in range(16):
+        t = a[k] + xp.uint32(1 << 16) - xp.uint32(_N_LIMBS_C[k]) - borrow
+        out.append(t & mask)
+        borrow = xp.uint32(1) - (t >> 16)
+    return _k_select(borrow, a, out, xp)
+
+
+def _k_fn_mul(a, b, xp=jnp):
+    """Canonical mod-N product; mirrors ``OrderN.mul`` fold-for-fold
+    (three delta folds 32 -> 26 -> 20 -> 16+eps, then two top-limb
+    folds and two conditional subtracts)."""
+    cols = _k_mul_cols_vv(a, b, xp)
+    while len(cols) > 16:
+        lo = cols[:16]
+        hi = _k_carry(cols[16:], len(cols) - 16 + 1, xp)
+        prod = _k_mul_cols(hi, _N_DELTA_LIMBS, xp)
+        w = max(16, len(prod))
+        zero = xp.zeros_like(cols[0])
+        lo_w = lo + [zero] * (w - 16)
+        pr_w = prod + [zero] * (w - len(prod))
+        cols = [x + y for x, y in zip(lo_w, pr_w)]
+    a17 = _k_carry(cols, 17, xp)
+    for _ in range(2):
+        top = a17[16]
+        fold = _k_mul_cols([top], _N_DELTA_LIMBS, xp)[:16]
+        zero = xp.zeros_like(top)
+        fold = fold + [zero] * (16 - len(fold))
+        a17 = _k_carry([x + y for x, y in zip(a17[:16], fold)], 17, xp)
+    out = a17[:16]
+    out = _k_cond_sub_n(out, xp)
+    return _k_cond_sub_n(out, xp)
+
+
+def _fn_mul_kernel(a_ref, b_ref, out_ref):
+    """One [16, LANE_BLOCK] tile: out = a * b mod N (canonical)."""
+    _write16(out_ref, _k_fn_mul(_read16(a_ref), _read16(b_ref)))
+
+
+def fn_mul_pallas(a: jnp.ndarray, b: jnp.ndarray, *,
+                  interpret: bool | None = None) -> jnp.ndarray:
+    """``[B, 16] x [B, 16] -> [B, 16]`` mod-N multiply via the Pallas
+    kernel; bit-identical to ``bigint.FN.mul``."""
+    if interpret is None:
+        # axon is the tunnel's TPU platform — real Mosaic, not interpret
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    B = a.shape[0]
+    ats, _, nb = _as_tiles([a, b], [], B)
+    out, = _pallas(_fn_mul_kernel, ats, [], nb, 1, interpret)
+    return out.T[:B]

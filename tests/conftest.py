@@ -12,14 +12,21 @@ import os
 # jax.config.update("jax_platforms", "axon,cpu") at interpreter startup,
 # overriding the env var), but the test suite needs the deterministic
 # 8-virtual-device CPU mesh (bench.py is what exercises the real chip).
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+_REAL_TPU = os.environ.get("EGES_TPU_TESTS_REAL", "") == "1"
+if not _REAL_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402  (after env setup, before any backend use)
 
-jax.config.update("jax_platforms", "cpu")
+if not _REAL_TPU:
+    jax.config.update("jax_platforms", "cpu")
+# EGES_TPU_TESTS_REAL=1 leaves the ambient (TPU) platform in place so
+# hardware-gated tests (e.g. the Mosaic ladder kernels) actually run;
+# used by harness/tpu_watch.py inside a live tunnel window.
 
 import subprocess  # noqa: E402
 

@@ -4,6 +4,7 @@ kernel lowers via Mosaic on a real TPU)."""
 
 import random
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -40,3 +41,209 @@ def test_fp_mul_kernel_extremes():
     np.testing.assert_array_equal(got, want)
     for v, row in zip(vals, got):
         assert limbs_to_int(row) % P == (v * v) % P
+
+
+def _rand_point_batch(n):
+    """Random affine points (as d*G host-side) lifted to Jacobian with a
+    random Z scaling, so X/Y/Z exercise full-width limbs."""
+    from eges_tpu.crypto import secp256k1 as host
+    from eges_tpu.ops.ec import GX_INT, GY_INT
+
+    xs, ys, zs = [], [], []
+    for _ in range(n):
+        d = rng.randrange(1, host.N)
+        x, y = host.point_mul(d, (GX_INT, GY_INT))
+        z = rng.randrange(1, P)
+        z2 = z * z % P
+        xs.append(int_to_limbs(x * z2 % P))
+        ys.append(int_to_limbs(y * z * z2 % P))
+        zs.append(int_to_limbs(z))
+    return (jnp.asarray(np.stack(xs)), jnp.asarray(np.stack(ys)),
+            jnp.asarray(np.stack(zs)))
+
+
+def _affine_batch(n):
+    from eges_tpu.crypto import secp256k1 as host
+    from eges_tpu.ops.ec import GX_INT, GY_INT
+
+    xs, ys = [], []
+    for _ in range(n):
+        d = rng.randrange(1, host.N)
+        x, y = host.point_mul(d, (GX_INT, GY_INT))
+        xs.append(int_to_limbs(x))
+        ys.append(int_to_limbs(y))
+    return jnp.asarray(np.stack(xs)), jnp.asarray(np.stack(ys))
+
+
+def _t(arr):
+    """[B, 16] array -> limb-major list of 16 numpy [B]-vectors."""
+    a = np.asarray(arr)
+    return [a[:, k].copy() for k in range(16)]
+
+
+def _untq(limbs):
+    return np.stack([np.asarray(v) for v in limbs], axis=-1)
+
+
+def test_k_jac_double_matches_graph_path():
+    """The in-kernel doubling math (numpy namespace) is bit-identical
+    to ec.jac_double — including a chained 4x run (the double4 kernel
+    body) and an infinity row."""
+    from eges_tpu.ops.ec import jac_double
+    from eges_tpu.ops.pallas_kernels import _k_jac_double
+
+    n = 9
+    pt = _rand_point_batch(n)
+    pt = tuple(jnp.concatenate([t, jnp.zeros((1, 16), jnp.uint32)])
+               for t in pt)
+    K = [_t(t) for t in pt]
+    want = pt
+    for _ in range(4):
+        want = jac_double(want)
+        K = _k_jac_double(*K, xp=np)
+        for g, w in zip(K, want):  # compare every step, not just the end
+            np.testing.assert_array_equal(_untq(g), np.asarray(w))
+
+
+def test_k_jac_add_mixed_matches_graph_path():
+    """The in-kernel conditional-add math must equal the strauss_gR
+    composition: per-row y-negation, branchless mixed add (incl.
+    infinity/double/opposite cases), digit!=0 select."""
+    from eges_tpu.ops.bigint import select
+    from eges_tpu.ops.ec import jac_add_mixed
+    from eges_tpu.ops.pallas_kernels import (
+        _k_jac_add_mixed, _k_neg, _k_select,
+    )
+
+    n = 8
+    pt = _rand_point_batch(n)
+    px, py = _affine_batch(n)
+
+    # craft exceptional rows: 0 = generic, 1 = same point (doubling),
+    # 2 = opposite point (-> infinity), 3 = acc at infinity
+    pt_l = [np.asarray(t).copy() for t in pt]
+    px_l, py_l = np.asarray(px).copy(), np.asarray(py).copy()
+    from eges_tpu.crypto import secp256k1 as host
+    from eges_tpu.ops.ec import GX_INT, GY_INT
+    x1, y1 = host.point_mul(5, (GX_INT, GY_INT))
+    for row, y_val in ((1, y1), (2, P - y1)):
+        pt_l[0][row] = int_to_limbs(x1)
+        pt_l[1][row] = int_to_limbs(y1)
+        pt_l[2][row] = int_to_limbs(1)
+        px_l[row] = int_to_limbs(x1)
+        py_l[row] = int_to_limbs(y_val)
+    pt_l[2][3] = 0  # infinity acc
+    pt = tuple(jnp.asarray(t) for t in pt_l)
+    px, py = jnp.asarray(px_l), jnp.asarray(py_l)
+
+    neg = np.asarray([0, 0, 0, 0, 1, 1, 0, 1], np.uint32)
+    nz = np.asarray([1, 1, 1, 1, 1, 0, 1, 1], np.uint32)
+
+    # graph-path reference (the exact strauss_gR add-step composition)
+    y_t = select(jnp.asarray(neg), FP.neg(py), py)
+    added = jac_add_mixed(pt, px, jnp.asarray(y_t))
+    want = tuple(select(jnp.asarray(nz), a, o)
+                 for a, o in zip(added, pt))
+
+    # in-kernel math, numpy namespace (what _add_mixed_kernel runs)
+    X, Y, Z = _t(pt[0]), _t(pt[1]), _t(pt[2])
+    pxl, pyl = _t(px), _t(py)
+    pyl = _k_select(neg, _k_neg(pyl, xp=np), pyl, xp=np)
+    AX, AY, AZ = _k_jac_add_mixed(X, Y, Z, pxl, pyl, xp=np)
+    got = (_k_select(nz, AX, X, xp=np), _k_select(nz, AY, Y, xp=np),
+           _k_select(nz, AZ, Z, xp=np))
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(_untq(g), np.asarray(w))
+
+
+@pytest.mark.skipif(jax.default_backend() not in ("tpu", "axon"),
+                    reason="Mosaic kernels need real TPU hardware; the "
+                           "interpret-mode lowering of these flat "
+                           "graphs takes tens of minutes to compile")
+def test_ladder_kernels_on_tpu(monkeypatch):
+    """End-to-end on hardware: the fused kernels through pallas_call
+    must match the XLA graph path — in isolation AND through the full
+    strauss_gR wiring (digit indexing, neg/nz rows, the kernel-path
+    dispatch), which is what the watcher treats this test as proving."""
+    from eges_tpu.ops import pallas_kernels as pk
+    from eges_tpu.ops.bigint import FN, select
+    from eges_tpu.ops.ec import jac_add_mixed, jac_double, strauss_gR
+    from eges_tpu.ops.pallas_kernels import (
+        fn_mul_pallas, ladder_add_mixed, ladder_double4,
+    )
+
+    n = 9
+    pt = _rand_point_batch(n)
+    want = pt
+    for _ in range(4):
+        want = jac_double(want)
+    got = ladder_double4(pt)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+    px, py = _affine_batch(n)
+    neg = jnp.asarray([1, 0, 1, 0, 1, 0, 1, 0, 1], jnp.uint32)
+    nz = jnp.asarray([1, 1, 0, 1, 1, 1, 1, 0, 1], jnp.uint32)
+    y_t = select(neg, FP.neg(py), py)
+    added = jac_add_mixed(pt, px, y_t)
+    want = tuple(select(nz, a, o) for a, o in zip(added, pt))
+    got = ladder_add_mixed(pt, px, py, neg, nz)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+    # mod-N kernel on hardware
+    from eges_tpu.ops.bigint import N
+    ka = jnp.asarray(np.stack([int_to_limbs(rng.randrange(N))
+                               for _ in range(n)]))
+    kb = jnp.asarray(np.stack([int_to_limbs(rng.randrange(N))
+                               for _ in range(n)]))
+    np.testing.assert_array_equal(np.asarray(fn_mul_pallas(ka, kb)),
+                                  np.asarray(FN.mul(ka, kb)))
+
+    # full strauss_gR through the kernel dispatch vs the graph path:
+    # the two must be BIT-identical (the kernels mirror the graph ops)
+    rx, ry = _affine_batch(4)
+    u1 = jnp.asarray(np.stack([int_to_limbs(rng.randrange(N))
+                               for _ in range(4)]))
+    u2 = jnp.asarray(np.stack([int_to_limbs(rng.randrange(N))
+                               for _ in range(4)]))
+    base = strauss_gR(u1, u2, rx, ry)
+    monkeypatch.setattr(pk, "ladder_kernels_enabled", lambda: True)
+    kern = strauss_gR(u1, u2, rx, ry)
+    for g, w in zip(kern, base):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_k_fn_mul_matches_graph_path():
+    """The in-kernel mod-N multiply (numpy namespace) is bit-identical
+    to OrderN.mul — canonical outputs, random + extreme operands."""
+    from eges_tpu.ops.bigint import FN, N
+    from eges_tpu.ops.pallas_kernels import _k_fn_mul
+
+    vals = [0, 1, N - 1, N - 2, (1 << 256) // 3]
+    vals += [rng.randrange(N) for _ in range(11)]
+    va = [v % N for v in vals]
+    vb = list(reversed(va))
+    a = jnp.asarray(np.stack([int_to_limbs(v) for v in va]))
+    b = jnp.asarray(np.stack([int_to_limbs(v) for v in vb]))
+    want = np.asarray(FN.mul(a, b))
+    got = _untq(_k_fn_mul(_t(a), _t(b), xp=np))
+    np.testing.assert_array_equal(got, want)
+    for x, y, row in zip(va, vb, got):
+        assert limbs_to_int(row) == (x * y) % N
+
+
+def test_fn_mul_kernel_interpret():
+    """The mod-N kernel through pallas_call (interpret mode): covers
+    the kernel plumbing at a size XLA CPU can still compile."""
+    from eges_tpu.ops.bigint import FN, N
+    from eges_tpu.ops.pallas_kernels import fn_mul_pallas
+
+    n = 5
+    va = [rng.randrange(N) for _ in range(n)]
+    vb = [rng.randrange(N) for _ in range(n)]
+    a = jnp.asarray(np.stack([int_to_limbs(v) for v in va]))
+    b = jnp.asarray(np.stack([int_to_limbs(v) for v in vb]))
+    got = np.asarray(fn_mul_pallas(a, b, interpret=True))
+    want = np.asarray(FN.mul(a, b))
+    np.testing.assert_array_equal(got, want)
