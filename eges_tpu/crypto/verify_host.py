@@ -29,11 +29,13 @@ class NativeBatchVerifier:
     native C++ batch recover (``geec_ec_recover_batch`` — the cgo-batch
     analogue) or, failing that, the pure-Python model.
 
-    For nodes that cannot attach an accelerator; marks the same
-    ``verifier.rows``/``verifier.batches`` metrics so the batched-path
-    share is measured identically (the *device* is the host here — real
-    TPU deployments construct :func:`~eges_tpu.crypto.verifier.
-    default_verifier` instead)."""
+    For nodes that cannot attach an accelerator.  Marks its OWN metrics
+    (``verifier.native_rows``/``verifier.native_batches``): this is
+    host work, and counting it as device rows would fake the BASELINE
+    ">95% of verifies on TPU" share (round-3 verdict weak #3).  The
+    RPC's ``thw_metrics`` reports ``verifier.device_share`` from device
+    rows only, plus ``verifier.batched_share`` for the routing share
+    either batch path achieves."""
 
     def recover_addresses(self, sigs, hashes):
         import time
@@ -67,9 +69,9 @@ class NativeBatchVerifier:
                     ok[i] = True
                 except Exception:
                     pass
-        metrics.timer("verifier.device").update(time.monotonic() - t0)
-        metrics.meter("verifier.rows").mark(n)
-        metrics.counter("verifier.batches").inc()
+        metrics.timer("verifier.native").update(time.monotonic() - t0)
+        metrics.meter("verifier.native_rows").mark(n)
+        metrics.counter("verifier.native_batches").inc()
         return addrs, ok
 
     def ecrecover(self, sigs, hashes):

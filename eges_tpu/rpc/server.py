@@ -262,13 +262,23 @@ class RpcServer:
             from eges_tpu.utils.metrics import DEFAULT as metrics
             out = metrics.snapshot()
             # on-device verify share (BASELINE.md north star: > 95% of
-            # secp256k1 verifies on TPU): device rows vs host fallbacks
-            dev = out.get("verifier.rows", {})
-            dev = dev.get("count", 0) if isinstance(dev, dict) else dev
+            # secp256k1 verifies on TPU).  Three row classes: device
+            # (JAX batch verifier), native (C++ host batch — still host
+            # work, round-3 verdict weak #3), and per-call host
+            # fallbacks.  device_share counts DEVICE rows only;
+            # batched_share is the routing share either batch path hits.
+            def _rows(key):
+                v = out.get(key, {})
+                return v.get("count", 0) if isinstance(v, dict) else v
+
+            dev = _rows("verifier.rows")
+            native = _rows("verifier.native_rows")
             host = out.get("verifier.host_rows", 0)
-            total = dev + host
+            total = dev + native + host
             out["verifier.device_share"] = (
                 round(dev / total, 4) if total else None)
+            out["verifier.batched_share"] = (
+                round((dev + native) / total, 4) if total else None)
             if self.txpool is not None:
                 out["txpool"] = dict(self.txpool.stats,
                                      pending=len(self.txpool))
@@ -393,8 +403,25 @@ class RpcServer:
 
     def _logs_in_range(self, from_n: int, to_n: int, addresses,
                        topics) -> list:
+        """Logs matching a filter over ``[from_n, to_n]``.
+
+        Candidate blocks come from the chain's sectioned bloom index
+        (3 index rows per filter value, ref core/bloombits role) — not
+        a header walk; unindexed gaps (old stores) fall back to the
+        linear header-bloom scan.  Index false positives are filtered
+        by the per-header bloom, then the receipts themselves."""
+        from_n = max(0, from_n)
+        if to_n < from_n:
+            return []
+        idx = getattr(self.chain, "bloom_index", None)
+        if idx is None:
+            numbers, gaps = [], [(from_n, to_n)]
+        else:
+            numbers, gaps = idx.candidates(from_n, to_n, addresses, topics)
+        for lo, hi in gaps:
+            numbers.extend(range(lo, hi + 1))
         out = []
-        for n in range(max(0, from_n), to_n + 1):
+        for n in sorted(numbers):
             blk = self.chain.get_block_by_number(n)
             if blk is None:
                 continue

@@ -93,7 +93,9 @@ def test_mixed_batch_1024_validators_device_share():
     """BASELINE config 3/4 shape: ONE mixed batch carrying the proposer
     header signature, 1024 validator ACK votes and a block's txn
     senders, routed through a batch verifier; the thw_metrics
-    device-share must exceed 95% (north star: >95% of verifies batched).
+    batched-share must exceed 95% (north star: >95% of verifies batched;
+    the on-DEVICE share is reported separately from device rows only —
+    round-3 verdict weak #3).
 
     Uses the JAX-free NativeBatchVerifier so the fast suite measures the
     ROUTING share without a device compile; the device execution itself
@@ -106,7 +108,7 @@ def test_mixed_batch_1024_validators_device_share():
     )
     from eges_tpu.utils.metrics import DEFAULT as metrics
 
-    rows0 = metrics.meter("verifier.rows").count
+    rows0 = metrics.meter("verifier.native_rows").count
     host0 = metrics.counter("verifier.host_rows").value
 
     n_votes, n_txns = 1024, 1000
@@ -122,10 +124,10 @@ def test_mixed_batch_1024_validators_device_share():
     got = recover_signers(entries, bv)
     assert got == expected
 
-    dev_rows = metrics.meter("verifier.rows").count - rows0
+    native_rows = metrics.meter("verifier.native_rows").count - rows0
     host_rows = metrics.counter("verifier.host_rows").value - host0
-    assert dev_rows == len(entries)
-    share = dev_rows / (dev_rows + host_rows)
+    assert native_rows == len(entries)
+    share = native_rows / (native_rows + host_rows)
     assert share > 0.95, f"batched verify share {share:.3f}"
 
 
