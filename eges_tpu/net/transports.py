@@ -8,14 +8,16 @@ reference's two planes (SURVEY §2.3):
   binds ``--consensusPort``; replies dial ``ip:port`` from the request).
 * **Gossip plane** — persistent TCP connections to a static peer list
   with length-prefixed frames.  The reference runs RLPx-encrypted devp2p
-  here (p2p/rlpx.go); the RLPx-parity role in this permissioned design
-  is an authenticated handshake + per-frame keyed MAC (see
-  :class:`GossipPlane` with a ``secret``): nonce exchange derives
-  per-direction session keys from a network secret, every frame carries
-  a 16-byte keccak-MAC over (key, sequence, payload), and unauthentic
-  or replayed frames drop the connection.  Confidentiality is NOT
-  provided (consensus traffic is not secret in a permissioned
-  deployment); authenticity and network isolation are.
+  here (p2p/rlpx.go: ECDH handshake + AES-CTR framing + MAC); the
+  RLPx-parity layer here is :class:`_FrameAuth`: an ECDSA-signed ECDH
+  handshake derives per-direction session keys, every keyed frame is
+  ENCRYPTED with a per-frame SHAKE-256 keystream and carries a 16-byte
+  keccak-MAC over (key, sequence, ciphertext) — encrypt-then-MAC —
+  with a per-direction monotonic sequence, so tampered, replayed,
+  reordered, or readable-on-the-wire frames are all ruled out.  Three
+  generations interop (v3 encrypted / v2 MAC-only / v1 symmetric);
+  downgrades below the endpoint's best generation are rejected unless
+  explicitly allowed (mixed-mode upgrade flags).
 
 Everything runs on one asyncio loop; inbound messages call straight into
 the single-threaded :class:`~eges_tpu.consensus.node.GeecNode`, so the
@@ -81,49 +83,86 @@ class AuthError(Exception):
     """Peer failed the gossip-plane handshake or sent a bad MAC."""
 
 
+def _keystream(key: bytes, seq: int, n: int) -> bytes:
+    """Per-frame keystream: SHAKE-256 as a XOF keyed by
+    ``(enc_key, sequence)``.  One hashlib call emits the whole stream
+    for a frame of any size, and the per-direction monotonic sequence
+    guarantees the (key, nonce) pair is never reused — the stream-
+    cipher contract.  Fills the AES-CTR role of the reference's RLPx
+    framing (p2p/rlpx.go) with a primitive the stdlib provides."""
+    import hashlib
+
+    return hashlib.shake_256(key + seq.to_bytes(8, "big")).digest(n)
+
+
+def _xor(a: bytes, b: bytes) -> bytes:
+    n = len(a)
+    return (int.from_bytes(a, "big") ^ int.from_bytes(b, "big")).to_bytes(
+        n, "big") if n else b""
+
+
 class _FrameAuth:
-    """Per-connection frame authentication (the RLPx-parity layer).
+    """Per-connection frame authentication + encryption (the
+    p2p/rlpx.go-parity layer).
 
-    Two handshake generations:
+    Three handshake generations:
 
-    * **v2 (ECDH, default when a node key is present)** — each side
-      sends ``MAGIC2 || pubkey64 || nonce16 || sig65`` where ``sig``
-      signs ``keccak(MAGIC2 || pubkey || nonce)`` with the node key.
-      Session keys derive from the ECDH shared secret (keccak of the
-      shared x-coordinate) mixed with both nonces, so every connection
-      has fresh keys no other member can compute — closing the round-2
-      hole where any member holding the one symmetric network secret
-      could impersonate the plane to any other.  The peer's recovered
-      address is exposed as :attr:`peer_addr` for membership gating.
+    * **v3 (ECDH, encrypted — the default when a node key is present)**
+      — each side sends ``MAGIC3 || pubkey64 || nonce16 || sig65``
+      where ``sig`` signs ``keccak(MAGIC3 || pubkey || nonce)`` with
+      the node key.  MAC and encryption keys derive per-direction from
+      the ECDH shared secret mixed with both nonces, so every
+      connection has fresh keys no other member can compute.  Frames
+      are sealed ciphertext (encrypt-then-MAC): a wire observer sees
+      lengths and nothing else.  The peer's recovered address is
+      exposed as :attr:`peer_addr` for membership gating.
+    * **v2 (ECDH, MAC-only)** — same handshake body under ``MAGIC2``,
+      frames authenticated but plaintext.  Hellos cross simultaneously
+      (both sides write first), so a v3 endpoint's MAGIC3 hello is
+      already on the wire when a v2 hello arrives; mixed mode works
+      because v2 endpoints accept the higher-generation magic (same
+      body shape) while the v3 side accepts the v2 hello only with
+      ``allow_v2`` — both then derive the MAC-only keys.  The session
+      runs at the lower of the two offered generations, never silently
+      below what a flag allows.
     * **v1 (symmetric)** — ``MAGIC || nonce16`` with keys
-      ``keccak(secret || nonces)``; kept for keyless tooling.
+      ``keccak(secret || nonces)``; kept for keyless tooling, rejected
+      by keyed endpoints unless ``allow_downgrade``.
 
-    Every frame then carries ``keccak(key || seq_be8 || payload)[:16]``
-    with a per-direction monotonic sequence — tampered, replayed or
-    reordered frames fail.  (A keccak prefix-MAC is sound: sponges have
-    no length-extension weakness.)"""
+    Every frame carries ``keccak(key || seq_be8 || body)[:16]`` with a
+    per-direction monotonic sequence — tampered, replayed or reordered
+    frames fail.  (A keccak prefix-MAC is sound: sponges have no
+    length-extension weakness.)"""
 
     MAGIC = b"geec-gossip-v1\x00\x00"
     MAGIC2 = b"geec-gossip-v2\x00\x00"
+    MAGIC3 = b"geec-gossip-v3\x00\x00"
 
     def __init__(self, secret: bytes, keypair: tuple[bytes, bytes] | None = None,
-                 allow_downgrade: bool = False):
+                 allow_downgrade: bool = False, allow_v2: bool = False,
+                 version: int = 3):
         import secrets as _secrets
 
         self.secret = secret
-        self.keypair = keypair  # (priv32, pub64) -> v2 handshake
+        self.keypair = keypair  # (priv32, pub64) -> v2/v3 handshake
         # Round-3 advisor: a keyed side silently accepting a v1 hello
         # bypasses the authorize() membership gate (peer_addr never
         # set), and the default v1 secret is derivable from the public
         # genesis file.  Downgrade is therefore opt-in (mixed-mode
-        # deployments mid-upgrade), never the default.
+        # deployments mid-upgrade), never the default.  The same policy
+        # guards v3 -> v2 (losing confidentiality).
         self.allow_downgrade = allow_downgrade
+        self.allow_v2 = allow_v2
+        self.version = version if keypair is not None else 1
         self.my_nonce = _secrets.token_bytes(16)
         self.send_key = b""
         self.recv_key = b""
+        self.send_enc = b""      # v3: per-direction encryption keys
+        self.recv_enc = b""
+        self.encrypts = False
         self.send_seq = 0
         self.recv_seq = 0
-        self.peer_addr: bytes | None = None  # v2: authenticated identity
+        self.peer_addr: bytes | None = None  # v2/v3: authenticated identity
 
     def hello(self) -> bytes:
         if self.keypair is None:
@@ -132,32 +171,37 @@ class _FrameAuth:
         from eges_tpu.crypto.keccak import keccak256
 
         priv, pub = self.keypair
-        body = self.MAGIC2 + pub + self.my_nonce
+        magic = self.MAGIC3 if self.version >= 3 else self.MAGIC2
+        body = magic + pub + self.my_nonce
         sig = secp.ecdsa_sign(keccak256(body), priv)
         return body + sig
 
     def on_hello(self, data: bytes) -> None:
         """Derive session keys from the peer's hello.
 
-        Version negotiation: the connection runs v2 only when BOTH
-        hellos are v2 (each side knows what it sent and what it
-        received).  A keyed endpoint receiving a v1 hello falls back to
-        v1 symmetric keys, and a keyless endpoint can parse a v2 hello's
-        nonce and derive the same v1 keys — so mixed generations and
-        keyless tooling interop instead of mutually AuthError-ing —
-        but ONLY when ``allow_downgrade`` is set: by default a keyed
-        endpoint rejects v1 hellos, because the v1 secret may be
-        derivable (genesis-hash default) and a downgraded connection
-        has no authenticated identity for the membership gate."""
+        Version negotiation: both sides send their best generation
+        simultaneously; the session runs at the LOWER of the two — but
+        an endpoint only accepts a generation below its own when the
+        matching mixed-mode flag allows it (``allow_v2`` for
+        v3 endpoints meeting v2, ``allow_downgrade`` for keyed
+        endpoints meeting keyless v1).  A keyless endpoint can parse a
+        v2/v3 hello's nonce and derive the v1 keys, so keyless tooling
+        interops with a flagged keyed peer instead of mutually
+        AuthError-ing."""
         from eges_tpu.crypto.keccak import keccak256
 
         m2 = len(self.MAGIC2)
-        if data.startswith(self.MAGIC2) and len(data) == m2 + 64 + 16 + 65:
+        keyed = (data[:m2] in (self.MAGIC2, self.MAGIC3)
+                 and len(data) == m2 + 64 + 16 + 65)
+        if keyed:
+            peer_version = 3 if data[:m2] == self.MAGIC3 else 2
             peer_pub = data[m2 : m2 + 64]
             peer_nonce = data[m2 + 64 : m2 + 80]
             if self.keypair is not None:
                 from eges_tpu.crypto import secp256k1 as secp
 
+                if peer_version < 3 <= self.version and not self.allow_v2:
+                    raise AuthError("v2 hello rejected (downgrade)")
                 sig = data[m2 + 80 :]
                 body = data[: m2 + 80]
                 try:
@@ -176,9 +220,16 @@ class _FrameAuth:
                                           + self.my_nonce + peer_nonce)
                 self.recv_key = keccak256(shared + self.secret
                                           + peer_nonce + self.my_nonce)
+                if peer_version >= 3 and self.version >= 3:
+                    self.send_enc = keccak256(b"enc" + shared + self.secret
+                                              + self.my_nonce + peer_nonce)
+                    self.recv_enc = keccak256(b"enc" + shared + self.secret
+                                              + peer_nonce + self.my_nonce)
+                    self.encrypts = True
                 return
-            # keyless side of a mixed pair: v1 keys from the v2 nonce
-            # (the keyed peer sees our v1 hello and derives the same)
+            # keyless side of a mixed pair: v1 keys from the v2/v3
+            # hello's nonce (the keyed peer sees our v1 hello and,
+            # when flagged, derives the same)
         elif data.startswith(self.MAGIC) and len(data) == len(self.MAGIC) + 16:
             peer_nonce = data[len(self.MAGIC):]
             if self.keypair is not None:
@@ -194,6 +245,9 @@ class _FrameAuth:
     def seal(self, payload: bytes) -> bytes:
         from eges_tpu.crypto.keccak import keccak256
 
+        if self.encrypts:
+            payload = _xor(payload, _keystream(self.send_enc,
+                                               self.send_seq, len(payload)))
         mac = keccak256(self.send_key + self.send_seq.to_bytes(8, "big")
                         + payload)[:16]
         self.send_seq += 1
@@ -211,6 +265,9 @@ class _FrameAuth:
                         + payload)[:16]
         if not _hmac.compare_digest(mac, want):  # constant-time compare
             raise AuthError("bad frame MAC")
+        if self.encrypts:
+            payload = _xor(payload, _keystream(self.recv_enc,
+                                               self.recv_seq, len(payload)))
         self.recv_seq += 1
         return payload
 
@@ -221,8 +278,11 @@ class GossipPlane:
     Reconnects with backoff; sends are fire-and-forget like the
     reference's per-peer ``p2p.Send`` loops (eth/handler.go:1071-1080).
     With ``secret`` set, every connection runs the :class:`_FrameAuth`
-    handshake and per-frame MAC (the p2p/rlpx.go role); ``secret=None``
-    keeps the plaintext wire for tests/local rigs.
+    handshake — encrypted + MACed frames when keyed (the p2p/rlpx.go
+    role, v3) — while ``secret=None`` keeps the plaintext wire for
+    tests/local rigs.  ``version=2`` pins a keyed plane to the MAC-only
+    generation (mixed-mode upgrades; pair with ``allow_v2_peers`` on
+    the v3 side).
     """
 
     MAX_FRAME = 64 * 1024 * 1024
@@ -230,15 +290,18 @@ class GossipPlane:
     def __init__(self, bind_ip: str, bind_port: int, peers: list[tuple[str, int]],
                  on_gossip, secret: bytes | None = None,
                  keypair: tuple[bytes, bytes] | None = None,
-                 authorize=None, allow_v1_peers: bool = False):
+                 authorize=None, allow_v1_peers: bool = False,
+                 allow_v2_peers: bool = False, version: int = 3):
         self.bind_ip = bind_ip
         self.bind_port = bind_port
         self.peers = [p for p in peers if p != (bind_ip, bind_port)]
         self._on_gossip = on_gossip
         self.secret = secret
         self.keypair = keypair if secret is not None else None
-        self.authorize = authorize  # callable(addr20) -> bool, v2 only
+        self.authorize = authorize  # callable(addr20) -> bool, v2+ only
         self.allow_v1_peers = allow_v1_peers  # mixed-mode upgrades only
+        self.allow_v2_peers = allow_v2_peers  # accept MAC-only peers
+        self.version = version
         self._server: asyncio.AbstractServer | None = None
         self._writers: dict[tuple[str, int], tuple] = {}  # peer -> (writer, auth)
         self._tasks: list[asyncio.Task] = []
@@ -278,7 +341,9 @@ class GossipPlane:
         if self.secret is None:
             return None
         auth = _FrameAuth(self.secret, keypair=self.keypair,
-                          allow_downgrade=self.allow_v1_peers)
+                          allow_downgrade=self.allow_v1_peers,
+                          allow_v2=self.allow_v2_peers,
+                          version=self.version)
         writer.write(self._frame(auth.hello()))
         await writer.drain()
         auth.on_hello(await asyncio.wait_for(self._read_frame(reader),

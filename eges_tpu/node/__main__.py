@@ -77,6 +77,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="accept legacy v1 symmetric hellos on a keyed "
                         "node (mixed-mode upgrades; bypasses per-peer "
                         "identity, so off by default)")
+    p.add_argument("--allowV2Peers", action="store_true",
+                   help="accept MAC-only v2 hellos on a v3 node "
+                        "(mixed-mode upgrades; those links lose "
+                        "confidentiality, so off by default)")
+    p.add_argument("--gossipVersion", type=int, default=3, choices=[2, 3],
+                   help="gossip-plane generation: 3 = encrypted frames "
+                        "(default), 2 = MAC-only (staged upgrades)")
     return p
 
 
@@ -97,6 +104,8 @@ def main(argv=None) -> None:
         rpc_port=args.rpcPort, net_secret_hex=args.netSecret,
         plaintext_gossip=args.plaintextGossip,
         allow_v1_peers=args.allowV1Peers,
+        allow_v2_peers=args.allowV2Peers,
+        gossip_version=args.gossipVersion,
         gossip_allowlist=tuple(a for a in args.gossipAllowlist.split(",")
                                if a),
         bootnodes=parse_peers(args.bootnodes),

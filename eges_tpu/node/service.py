@@ -49,6 +49,13 @@ class ServiceConfig:
     #                                    mode upgrades only; bypasses
     #                                    per-peer identity, so never on
     #                                    by default
+    allow_v2_peers: bool = False       # accept MAC-only (unencrypted)
+    #                                    v2 hellos on v3 nodes — mixed-
+    #                                    mode upgrades only; loses
+    #                                    confidentiality on those links
+    gossip_version: int = 3            # pin the plane's generation
+    #                                    (2 = MAC-only, for staged
+    #                                    upgrades of a running network)
     gossip_allowlist: tuple[str, ...] = ()  # hex addresses; when set,
     #                                    gossip connections are admitted
     #                                    only for peers whose handshake
@@ -139,8 +146,9 @@ class NodeService:
         else:
             from eges_tpu.crypto.keccak import keccak256
             secret = keccak256(b"geec/net-secret" + genesis.hash)
-        # ECDH per-connection keys (v2 handshake) whenever auth is on:
-        # session keys no other member can compute, identity = node key.
+        # ECDH per-connection keys (v3 handshake) whenever auth is on:
+        # encrypted frames + session keys no other member can compute,
+        # identity = node key.
         # With an allowlist configured, that identity feeds the
         # membership gate: a peer must be explicitly listed or already a
         # registered member (joiners register THROUGH an allowlisted
@@ -172,6 +180,8 @@ class NodeService:
                                   secret=secret,
                                   keypair=(priv, secp.privkey_to_pubkey(priv)),
                                   allow_v1_peers=cfg.allow_v1_peers,
+                                  allow_v2_peers=cfg.allow_v2_peers,
+                                  version=cfg.gossip_version,
                                   authorize=authorize)
         self.node.transport = SocketTransport(self.gossip, self.direct)
 

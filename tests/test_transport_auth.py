@@ -38,6 +38,72 @@ def test_frame_auth_roundtrip_and_tamper():
         b.open(a.seal(b"z"))
 
 
+def _keyed(net=b"\x11" * 32, priv=b"\x07" * 32, **kw):
+    from eges_tpu.crypto import secp256k1 as secp
+
+    return _FrameAuth(net, keypair=(priv, secp.privkey_to_pubkey(priv)),
+                      **kw)
+
+
+def test_v3_frames_are_ciphertext():
+    """VERDICT r3 missing #3 (ref p2p/rlpx.go role): keyed connections
+    encrypt — the payload never appears on the wire, roundtrips intact,
+    and tamper/replay still fail."""
+    a = _keyed(priv=b"\x07" * 32)
+    b = _keyed(priv=b"\x08" * 32)
+    ha, hb = a.hello(), b.hello()
+    a.on_hello(hb)
+    b.on_hello(ha)
+    assert a.encrypts and b.encrypts
+    msg = b"secret-geec-payload" * 40
+    for _ in range(3):  # fresh keystream per sequence number
+        sealed = a.seal(msg)
+        assert msg not in sealed
+        assert b.open(sealed) == msg
+    # same plaintext, different sequence -> different ciphertext
+    c1, c2 = a.seal(b"same"), a.seal(b"same")
+    assert c1[16:] != c2[16:]
+    assert b.open(c1) == b"same"
+    with pytest.raises(AuthError):  # replay
+        b.open(c1)
+    sealed = a.seal(b"x")
+    with pytest.raises(AuthError):  # tamper
+        b.open(sealed[:-1] + bytes([sealed[-1] ^ 1]))
+
+
+def test_v3_rejects_v2_hello_unless_allowed():
+    """A MAC-only (v2) hello on a v3 endpoint is a confidentiality
+    downgrade: rejected by default, accepted with allow_v2 — and the
+    session then runs MAC-only plaintext that both sides agree on."""
+    old = _keyed(priv=b"\x08" * 32, version=2)
+    new = _keyed(priv=b"\x07" * 32)
+    with pytest.raises(AuthError):
+        new.on_hello(old.hello())
+
+    old = _keyed(priv=b"\x08" * 32, version=2)
+    new = _keyed(priv=b"\x07" * 32, allow_v2=True)
+    ho, hn = old.hello(), new.hello()
+    new.on_hello(ho)
+    old.on_hello(hn)  # v2 side reads a v3 hello fine (same body shape)
+    assert not new.encrypts and not old.encrypts
+    assert old.open(new.seal(b"mixed")) == b"mixed"
+    assert new.open(old.seal(b"back")) == b"back"
+    # identity still flows for the membership gate
+    assert new.peer_addr is not None and old.peer_addr is not None
+
+
+def test_v2_pinned_pair_stays_mac_only():
+    a = _keyed(priv=b"\x07" * 32, version=2)
+    b = _keyed(priv=b"\x08" * 32, version=2)
+    ha, hb = a.hello(), b.hello()
+    a.on_hello(hb)
+    b.on_hello(ha)
+    assert not a.encrypts and not b.encrypts
+    sealed = a.seal(b"plain-but-authentic")
+    assert b"plain-but-authentic" in sealed  # v2 semantics preserved
+    assert b.open(sealed) == b"plain-but-authentic"
+
+
 def _free_port():
     import socket
 
@@ -46,6 +112,41 @@ def _free_port():
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def test_gossip_plane_encrypts_end_to_end():
+    """Two keyed planes negotiate v3 on every connection: messages
+    deliver, and each live connection's auth reports encryption on."""
+
+    async def run():
+        from eges_tpu.crypto import secp256k1 as secp
+
+        secret = b"\xAA" * 32
+        got_a, got_b = [], []
+        pa, pb = _free_port(), _free_port()
+        ka, kb = b"\x07" * 32, b"\x08" * 32
+        a = GossipPlane("127.0.0.1", pa, [("127.0.0.1", pb)], got_a.append,
+                        secret=secret,
+                        keypair=(ka, secp.privkey_to_pubkey(ka)))
+        b = GossipPlane("127.0.0.1", pb, [("127.0.0.1", pa)], got_b.append,
+                        secret=secret,
+                        keypair=(kb, secp.privkey_to_pubkey(kb)))
+        await a.start()
+        await b.start()
+        await asyncio.sleep(0.6)
+        a.broadcast(b"enc-from-a")
+        b.broadcast(b"enc-from-b")
+        await asyncio.sleep(0.3)
+        assert got_b == [b"enc-from-a"]
+        assert got_a == [b"enc-from-b"]
+        for plane in (a, b):
+            assert plane._writers, "dial connection missing"
+            for _w, auth in plane._writers.values():
+                assert auth is not None and auth.encrypts
+        a.close()
+        b.close()
+
+    asyncio.run(run())
 
 
 def test_gossip_plane_auth_end_to_end():
