@@ -27,7 +27,7 @@ from dataclasses import dataclass, field, replace
 import numpy as np
 
 from eges_tpu.core import rlp
-from eges_tpu.core.trie import EMPTY_ROOT, secure_trie_root, derive_sha
+from eges_tpu.core.trie import EMPTY_ROOT, derive_sha
 from eges_tpu.crypto.keccak import keccak256
 
 EMPTY_CODE_HASH = keccak256(b"")
@@ -38,48 +38,97 @@ class StateError(Exception):
     """A transaction that cannot be applied (invalid block if rooted)."""
 
 
+class ContractStorage:
+    """Persistent contract-storage handle (the dirty-storage role of
+    ref: core/state/state_object.go, redesigned): slot->value lives in a
+    structure-sharing :class:`~eges_tpu.core.trie.SecureIncrementalTrie`,
+    so a transaction's write-set costs O(writes x trie depth), the
+    storage root re-hashes only the touched path (node encodings memoize
+    on shared immutable nodes), and every state snapshot holds the same
+    tree — the round-3 verdict's "tuple rebuild is quadratic for a
+    5k-slot contract" fix, with the same incremental treatment the
+    account trie already got."""
+
+    __slots__ = ("_trie", "_root")
+
+    def __init__(self, trie=None):
+        from eges_tpu.core.trie import SecureIncrementalTrie
+        self._trie = trie if trie is not None else SecureIncrementalTrie()
+        self._root: bytes | None = None
+
+    def get(self, slot: int) -> int:
+        raw = self._trie.get(slot.to_bytes(32, "big"))
+        return rlp.decode_uint(rlp.decode(raw)) if raw else 0
+
+    def with_writes(self, writes: dict) -> "ContractStorage":
+        t = self._trie
+        for slot, value in writes.items():
+            key = slot.to_bytes(32, "big")
+            t = t.update(key, rlp.encode(value)) if value else t.delete(key)
+        return ContractStorage(t)
+
+    def root(self) -> bytes:
+        if self._root is None:
+            self._root = self._trie.root()
+        return self._root
+
+    # Account is a frozen dataclass: equality/hash flow through fields,
+    # and a storage tree's identity IS its root commitment
+    def __eq__(self, other):
+        return (isinstance(other, ContractStorage)
+                and (self._trie is other._trie
+                     or self.root() == other.root()))
+
+    def __hash__(self):
+        return hash(self.root())
+
+    def __repr__(self):
+        return f"ContractStorage(root={self.root().hex()[:12]})"
+
+
+EMPTY_STORAGE = ContractStorage()
+
+
 @dataclass(frozen=True)
 class Account:
     """Account with optional contract code and storage (ref:
-    core/state/state_object.go).  ``storage`` is an immutable-by-
-    convention mapping slot->word; the EVM mutates via a per-transaction
-    write cache flushed as one new dict per touched account, so plain
+    core/state/state_object.go).  ``storage`` is a persistent
+    :class:`ContractStorage`; the EVM mutates via a per-transaction
+    write cache flushed as one trie delta per touched account, so plain
     value-transfer accounts never pay for it."""
 
     nonce: int = 0
     balance: int = 0
     code_hash: bytes = EMPTY_CODE_HASH
-    storage: tuple = ()  # sorted ((slot, value), ...) pairs
+    storage: ContractStorage = EMPTY_STORAGE
 
     def storage_root(self) -> bytes:
-        if not self.storage:
-            return EMPTY_ROOT
-        return secure_trie_root({
-            slot.to_bytes(32, "big"): rlp.encode(value)
-            for slot, value in self.storage})
+        return self.storage.root()
 
     def storage_value(self, slot: int) -> int:
-        import bisect
-        i = bisect.bisect_left(self.storage, (slot,))
-        if i < len(self.storage) and self.storage[i][0] == slot:
-            return self.storage[i][1]
-        return 0
+        return self.storage.get(slot)
 
     def to_rlp(self) -> list:
         return [self.nonce, self.balance, self.storage_root(),
                 self.code_hash]
 
 
+def bloom_bits(value: bytes) -> tuple[int, int, int]:
+    """The 3 bloom bit positions of a value (ref: core/types/bloom9.go —
+    the first three 11-bit big-endian pairs of the value's keccak).
+    The ONE copy of the schedule: header blooms, membership probes, and
+    the sectioned index (:mod:`eges_tpu.core.bloomindex`) all call it."""
+    h = keccak256(value)
+    return tuple(((h[i] << 8) | h[i + 1]) & 2047 for i in (0, 2, 4))
+
+
 def logs_bloom(logs) -> bytes:
-    """2048-bit log bloom (ref: core/types/bloom9.go): for each log
-    address and topic, set 3 bits chosen by the first three 11-bit
-    big-endian pairs of the value's keccak."""
+    """2048-bit log bloom (ref: core/types/bloom9.go): 3 bits per log
+    address and topic."""
     bits = 0
     for addr, topics, _data in logs:
         for value in (addr, *topics):
-            h = keccak256(value)
-            for i in (0, 2, 4):
-                bit = ((h[i] << 8) | h[i + 1]) & 2047
+            for bit in bloom_bits(value):
                 bits |= 1 << bit
     return bits.to_bytes(256, "big")
 
@@ -87,12 +136,7 @@ def logs_bloom(logs) -> bytes:
 def bloom_may_contain(bloom: bytes, value: bytes) -> bool:
     """Bloom membership probe (false positives possible, negatives not)."""
     bits = int.from_bytes(bloom, "big")
-    h = keccak256(value)
-    for i in (0, 2, 4):
-        bit = ((h[i] << 8) | h[i + 1]) & 2047
-        if not (bits >> bit) & 1:
-            return False
-    return True
+    return all((bits >> bit) & 1 for bit in bloom_bits(value))
 
 
 @dataclass(frozen=True)
@@ -258,18 +302,13 @@ class StateDB:
         return self.account(addr).storage_value(slot)
 
     def set_storage_many(self, addr: bytes, writes: dict[int, int]) -> None:
-        """Merge a transaction's storage write-set into ``addr`` (one new
-        sorted tuple per touched account per txn)."""
+        """Merge a transaction's storage write-set into ``addr`` (one
+        trie delta per touched account per txn — O(writes x depth),
+        structure-shared with every snapshot holding the old tree)."""
         if not writes:
             return
         a = self.account(addr)
-        merged = dict(a.storage)
-        for k, v in writes.items():
-            if v:
-                merged[k] = v
-            else:
-                merged.pop(k, None)
-        self._set(addr, replace(a, storage=tuple(sorted(merged.items()))))
+        self._set(addr, replace(a, storage=a.storage.with_writes(writes)))
 
     def absorb(self, child: "StateDB") -> None:
         """Merge a successful child overlay (``child._base is self``)
@@ -353,7 +392,8 @@ BLOCK_GAS_LIMIT = 30_000_000  # default block gas cap (params.GenesisGasLimit
 
 
 def apply_txn(state: StateDB, txn, sender: bytes, coinbase: bytes,
-              gas_so_far: int, *, ctx=None, verifier=None) -> Receipt:
+              gas_so_far: int, *, ctx=None, verifier=None,
+              tracer=None) -> Receipt:
     """Apply one signed transaction, mutating ``state``
     (ref: core/state_transition.go TransitionDb: nonce check, balance
     check, value transfer / EVM execution, fee to coinbase).
@@ -402,7 +442,7 @@ def apply_txn(state: StateDB, txn, sender: bytes, coinbase: bytes,
     state.bump_nonce(sender)
 
     e = _evm.EVM(state, ctx if ctx is not None else _evm.BlockCtx(
-        coinbase=coinbase), verifier=verifier)
+        coinbase=coinbase), verifier=verifier, tracer=tracer)
     exec_gas = gas_limit - intrinsic
     if is_create:
         res = e.create(sender, txn.value, data, exec_gas, txn.nonce)
