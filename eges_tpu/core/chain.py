@@ -258,6 +258,11 @@ class BlockChain:
         # pruned in step with the state snapshots
         self._tx_index: dict[bytes, tuple[int, int]] = {}
         self._txs_by_height: dict[int, list[bytes]] = {}
+        # sectioned bitsliced log-bloom index (core/bloombits role):
+        # getLogs reads 3 index rows per filter value instead of walking
+        # every header in range
+        from eges_tpu.core.bloomindex import BloomIndex
+        self.bloom_index = BloomIndex()
 
         head_hash = self.store.get_head()
         if head_hash is None:
@@ -277,6 +282,7 @@ class BlockChain:
         if self.genesis.header.root != gstate.root():
             raise ChainError("genesis state root does not match alloc")
         self._remember_state(self.genesis.hash, 0, gstate, ())
+        self.bloom_index.add(0, self.genesis.header.bloom)
         # restart: rebuild state snapshots by replaying the stored chain
         # (the reference replays into StateDB from LevelDB; here states
         # are in-memory and derived, SURVEY §5 checkpoint/resume)
@@ -286,6 +292,7 @@ class BlockChain:
             state, receipts, _ = self._process(blk, parent_state)
             self._remember_state(blk.hash, n, state, receipts)
             self._index_txns(blk, receipts)
+            self.bloom_index.add(n, blk.header.bloom)
 
     # -- reads ------------------------------------------------------------
 
@@ -598,8 +605,10 @@ class BlockChain:
                         or b.confirm is None):
                     return False
                 prev = b
-            # rewind + replay
+            # rewind + replay (the bloom index rewinds too; each insert
+            # re-adds its height with the replacement bloom)
             self._head = anchor
+            self.bloom_index.truncate(first.number)
             for b in blocks:
                 try:
                     self._insert(b)
@@ -627,6 +636,7 @@ class BlockChain:
         self._head = block
         self._remember_state(block.hash, block.number, state, receipts)
         self._index_txns(block, receipts)
+        self.bloom_index.add(block.number, block.header.bloom)
         metrics.timer("chain.insert").update(time.monotonic() - t0)
         metrics.counter("chain.blocks").inc()
         metrics.counter("chain.txns").inc(len(block.transactions))
