@@ -150,11 +150,14 @@ def _ripemd160(d: bytes) -> bytes:
 class EVM:
     """One instance per transaction execution (ref: vm.NewEVM)."""
 
-    def __init__(self, state, ctx: BlockCtx, *, verifier=None):
+    def __init__(self, state, ctx: BlockCtx, *, verifier=None, tracer=None):
         self.state = state        # the txn-level StateDB overlay
         self.ctx = ctx
         self.verifier = verifier
         self.logs: list = []
+        # per-opcode hook (ref: vm.Config.Tracer -> interpreter.Run's
+        # CaptureState) — see eges_tpu.core.tracer.StructLogTracer
+        self.tracer = tracer
 
     # -- precompiles (ref: core/vm/contracts.go) ------------------------
 
@@ -357,15 +360,24 @@ class EVM:
             frame = _Frame(code=code, addr=to, caller=caller, origin=origin,
                            value=value, data=data, gas=gas, static=static)
             out = self._run(frame, depth)
+            if self.tracer is not None:
+                self.tracer.on_frame_end(depth, frame.gas)
             frame_state.set_storage_many(to, frame.swrites)
             snapshot.absorb(frame_state)
             return ExecResult(True, gas - frame.gas, out)
         except Revert as r:
             del self.logs[log_mark:]
+            if self.tracer is not None:
+                self.tracer.on_fault(depth, getattr(r, "gas_left", 0),
+                                     "execution reverted")
+                if depth == 0:  # only the txn-level frame's revert data
+                    self.tracer.output = r.data  # is the trace's output
             return ExecResult(False, gas - getattr(r, "gas_left", 0),
                               r.data)
-        except (EvmError, StateError):
+        except (EvmError, StateError) as e:
             del self.logs[log_mark:]
+            if self.tracer is not None:
+                self.tracer.on_fault(depth, 0, str(e) or "evm error")
             return ExecResult(False, gas)  # all gas consumed
         finally:
             self.state = prev_state
@@ -397,6 +409,8 @@ class EVM:
                            origin=origin, value=value, data=b"", gas=gas,
                            static=False)
             out = self._run(frame, depth)
+            if self.tracer is not None:
+                self.tracer.on_frame_end(depth, frame.gas)
             deposit = G_CODE_DEPOSIT_BYTE * len(out)
             if frame.gas < deposit:
                 raise EvmError("oog:code deposit")
@@ -407,9 +421,16 @@ class EVM:
             return ExecResult(True, gas - frame.gas, b"", created=new_addr)
         except Revert as r:
             del self.logs[log_mark:]
+            if self.tracer is not None:
+                self.tracer.on_fault(depth, getattr(r, "gas_left", 0),
+                                     "execution reverted")
+                if depth == 0:  # constructor revert reason, as in call()
+                    self.tracer.output = r.data
             return ExecResult(False, gas - getattr(r, "gas_left", 0), r.data)
-        except (EvmError, StateError):
+        except (EvmError, StateError) as e:
             del self.logs[log_mark:]
+            if self.tracer is not None:
+                self.tracer.on_fault(depth, 0, str(e) or "evm error")
             return ExecResult(False, gas)
         finally:
             self.state = prev_state
@@ -469,6 +490,8 @@ class EVM:
             if f.pc >= len(code):
                 return b""
             op = code[f.pc]
+            if self.tracer is not None:
+                self.tracer.on_step(f.pc, op, f.gas, depth, f.stack)
             f.pc += 1
 
             # PUSH1..PUSH32

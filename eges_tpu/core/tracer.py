@@ -1,0 +1,120 @@
+"""EVM execution tracing (the eth/tracers + vm.Config.Tracer role).
+
+The reference hooks a ``Tracer`` into the interpreter loop
+(core/vm/interpreter.go calls tracer.CaptureState per opcode;
+eth/tracers/tracer.go + internal/ethapi expose it as
+``debug_traceTransaction``).  Same seam here: :class:`StructLogTracer`
+receives one callback per executed opcode from ``EVM._run`` and
+produces geth-shaped struct logs — pc, op name, remaining gas, gas cost,
+call depth, stack — so a failing contract call can be debugged from the
+RPC instead of by reading the interpreter.
+
+Gas cost per step is derived retroactively: a step's cost is its gas
+minus the gas at the NEXT step observed at the same depth (for CALL-family
+ops that spans the whole sub-call, which is what gas attribution at the
+call site means); the final pending step of each depth settles against
+the frame's end-of-run gas.
+"""
+
+from __future__ import annotations
+
+OPNAMES: dict[int, str] = {
+    0x00: "STOP", 0x01: "ADD", 0x02: "MUL", 0x03: "SUB", 0x04: "DIV",
+    0x05: "SDIV", 0x06: "MOD", 0x07: "SMOD", 0x08: "ADDMOD",
+    0x09: "MULMOD", 0x0A: "EXP", 0x0B: "SIGNEXTEND",
+    0x10: "LT", 0x11: "GT", 0x12: "SLT", 0x13: "SGT", 0x14: "EQ",
+    0x15: "ISZERO", 0x16: "AND", 0x17: "OR", 0x18: "XOR", 0x19: "NOT",
+    0x1A: "BYTE",
+    0x20: "SHA3",
+    0x30: "ADDRESS", 0x31: "BALANCE", 0x32: "ORIGIN", 0x33: "CALLER",
+    0x34: "CALLVALUE", 0x35: "CALLDATALOAD", 0x36: "CALLDATASIZE",
+    0x37: "CALLDATACOPY", 0x38: "CODESIZE", 0x39: "CODECOPY",
+    0x3A: "GASPRICE", 0x3B: "EXTCODESIZE", 0x3C: "EXTCODECOPY",
+    0x3D: "RETURNDATASIZE", 0x3E: "RETURNDATACOPY",
+    0x40: "BLOCKHASH", 0x41: "COINBASE", 0x42: "TIMESTAMP",
+    0x43: "NUMBER", 0x44: "DIFFICULTY", 0x45: "GASLIMIT",
+    0x50: "POP", 0x51: "MLOAD", 0x52: "MSTORE", 0x53: "MSTORE8",
+    0x54: "SLOAD", 0x55: "SSTORE", 0x56: "JUMP", 0x57: "JUMPI",
+    0x58: "PC", 0x59: "MSIZE", 0x5A: "GAS", 0x5B: "JUMPDEST",
+    0xF0: "CREATE", 0xF1: "CALL", 0xF2: "CALLCODE", 0xF3: "RETURN",
+    0xF4: "DELEGATECALL", 0xFA: "STATICCALL", 0xFD: "REVERT",
+    0xFE: "INVALID", 0xFF: "SELFDESTRUCT",
+}
+for _i in range(32):
+    OPNAMES[0x60 + _i] = f"PUSH{_i + 1}"
+for _i in range(16):
+    OPNAMES[0x80 + _i] = f"DUP{_i + 1}"
+    OPNAMES[0x90 + _i] = f"SWAP{_i + 1}"
+for _i in range(5):
+    OPNAMES[0xA0 + _i] = f"LOG{_i}"
+
+
+def op_name(op: int) -> str:
+    return OPNAMES.get(op, f"opcode {op:#x}")
+
+
+class StructLogTracer:
+    """Per-opcode struct logger (ref: core/vm/logger.go StructLogger).
+
+    ``on_step`` fires from the interpreter before each opcode executes;
+    ``on_fault`` tags the most recent step with the error that unwound
+    the frame; ``result`` settles pending gas costs and returns the
+    RPC-shaped trace."""
+
+    MAX_STEPS = 200_000  # bound adversarial traces (geth caps via timeout)
+
+    def __init__(self, with_stack: bool = True):
+        self.logs: list[dict] = []
+        self.with_stack = with_stack
+        self._pending: dict[int, dict] = {}  # depth -> unsettled entry
+        self.truncated = False
+        self.output = b""  # revert data / return data when the EVM has it
+
+    def on_step(self, pc: int, op: int, gas: int, depth: int,
+                stack: list) -> None:
+        if len(self.logs) >= self.MAX_STEPS:
+            self.truncated = True
+            return
+        # settle the previous entry at this depth: its cost is the gas
+        # drop to now (spans the sub-call for CALL-family ops); a depth
+        # we returned from deeper than this one settles on frame end
+        prev = self._pending.get(depth)
+        if prev is not None:
+            prev["gasCost"] = prev["gas"] - gas
+        for d in [d for d in self._pending if d > depth]:
+            del self._pending[d]
+        entry = {"pc": pc, "op": op_name(op), "gas": gas, "gasCost": 0,
+                 "depth": depth + 1}  # geth depth is 1-based
+        if self.with_stack:
+            entry["stack"] = [hex(v) for v in stack]  # bottom -> top
+        self.logs.append(entry)
+        self._pending[depth] = entry
+
+    def on_fault(self, depth: int, gas_left: int, error: str) -> None:
+        prev = self._pending.pop(depth, None)
+        if prev is not None:
+            prev["gasCost"] = prev["gas"] - gas_left
+            prev["error"] = error
+        elif self.logs:
+            self.logs[-1].setdefault("error", error)
+
+    def on_frame_end(self, depth: int, gas_left: int) -> None:
+        """Settle the frame's terminal opcode (RETURN/STOP/implicit end)
+        against the gas the frame finished with — on_step can only
+        settle a step once a LATER step at the same depth arrives."""
+        prev = self._pending.pop(depth, None)
+        if prev is not None:
+            prev["gasCost"] = prev["gas"] - gas_left
+
+    def result(self, *, gas_used: int, failed: bool,
+               output: bytes) -> dict:
+        self._pending.clear()
+        out = {
+            "gas": gas_used,
+            "failed": failed,
+            "returnValue": (output or self.output).hex(),
+            "structLogs": self.logs,
+        }
+        if self.truncated:
+            out["truncated"] = True
+        return out

@@ -553,7 +553,43 @@ class RpcServer:
             return self._debug_ctl.stacks()
         if method == "debug_stats":
             return self._debug_ctl.stats()
+        if method == "debug_traceTransaction":
+            return self._trace_transaction(params[0], *params[1:2])
         raise RpcError(-32601, f"method {method} not found")
+
+    def _trace_transaction(self, txh_hex: str, config: dict | None = None):
+        """Replay a mined transaction against its parent state with the
+        struct-log tracer attached (ref: eth/tracers/tracer.go +
+        internal/ethapi TraceTransaction): preceding txns of the block
+        re-execute untraced to reconstruct the exact pre-state, then the
+        target runs with per-opcode capture."""
+        from eges_tpu.core.state import apply_txn, block_ctx, recover_senders
+        from eges_tpu.core.tracer import StructLogTracer
+
+        found = self.chain.lookup_txn(bytes.fromhex(txh_hex[2:]))
+        if found is None:
+            raise RpcError(-32000, "transaction not found")
+        blk, index, _receipt = found
+        parent_state = self.chain.state_at(blk.header.parent_hash)
+        if parent_state is None:
+            raise RpcError(-32000, "parent state pruned; restart replays "
+                                   "it or trace a more recent transaction")
+        senders = recover_senders(blk.transactions, self.chain.verifier)
+        state = parent_state.copy()
+        ctx = block_ctx(blk.header)
+        gas = 0
+        for i in range(index):
+            r = apply_txn(state, blk.transactions[i], senders[i],
+                          blk.header.coinbase, gas, ctx=ctx,
+                          verifier=self.chain.verifier)
+            gas = r.cumulative_gas_used
+        tracer = StructLogTracer(
+            with_stack=not (config or {}).get("disableStack", False))
+        r = apply_txn(state, blk.transactions[index], senders[index],
+                      blk.header.coinbase, gas, ctx=ctx,
+                      verifier=self.chain.verifier, tracer=tracer)
+        return tracer.result(gas_used=r.cumulative_gas_used - gas,
+                             failed=r.status == 0, output=b"")
 
     # -- JSON-RPC plumbing ------------------------------------------------
 

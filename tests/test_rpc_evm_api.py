@@ -125,3 +125,75 @@ def test_get_transaction_by_hash_and_chain_id():
     assert rpc.dispatch("eth_getTransactionByHash",
                         ["0x" + bytes(32).hex()]) is None
     assert int(rpc.dispatch("eth_chainId", []), 16) == 930412
+
+
+def test_debug_trace_transaction_struct_logs():
+    """VERDICT r3 #8 (ref eth/tracers/tracer.go role): replaying a mined
+    txn yields geth-shaped struct logs; a reverting call traces as
+    failed with the fault tagged on its last step."""
+    chain, caddr = _chain_with_contract()
+    rpc = RpcServer(chain)
+    blk = chain.get_block_by_number(1)
+
+    # txn 2 is the SECOND contract call: its pre-state must include txn
+    # 1's increment, proving the preceding-txns replay
+    trace = rpc.dispatch("debug_traceTransaction",
+                         ["0x" + blk.transactions[2].hash.hex()])
+    assert trace["failed"] is False and trace["gas"] > 21_000
+    ops = [s["op"] for s in trace["structLogs"]]
+    assert ops == ["PUSH1", "SLOAD", "PUSH1", "ADD", "DUP1", "PUSH1",
+                   "SSTORE", "PUSH1", "MSTORE", "PUSH1", "PUSH1", "PUSH1",
+                   "LOG1", "PUSH1", "PUSH1", "RETURN"]
+    # SLOAD sees txn 1's write: stack top after SLOAD (step 2's stack
+    # holds the loaded value at its top) == 1
+    assert trace["structLogs"][2]["stack"][-1] == "0x1"
+    assert all(s["depth"] == 1 for s in trace["structLogs"])
+    # every non-terminal step settles positive; RETURN's base cost is a
+    # legitimate 0 — but the costs must telescope to the frame's
+    # execution gas exactly (txn gas minus the 21k intrinsic), which
+    # only holds when the terminal step settled too (on_frame_end)
+    assert all(s["gasCost"] > 0 for s in trace["structLogs"][:-1])
+    assert sum(s["gasCost"] for s in trace["structLogs"]) \
+        == trace["gas"] - 21_000
+
+    # a frame-terminal opcode with REAL cost (RETURN that expands
+    # memory) settles via on_frame_end, not as a leftover zero
+    from eges_tpu.core.evm import EVM, BlockCtx
+    from eges_tpu.core.state import Account, StateDB
+    from eges_tpu.core.tracer import StructLogTracer
+    st = StateDB({ADDR: Account(balance=ETH)})
+    expander = b"\x42" * 20
+    st.set_code(expander, bytes.fromhex("60206000f3"))  # RETURN(0, 32)
+    tr = StructLogTracer()
+    res = EVM(st, BlockCtx(coinbase=bytes(20)), tracer=tr).call(
+        ADDR, expander, 0, b"", 100_000)
+    assert res.success and len(res.output) == 32
+    last = tr.result(gas_used=res.gas_used, failed=False,
+                     output=res.output)["structLogs"][-1]
+    assert last["op"] == "RETURN" and last["gasCost"] == 3  # 1-word grow
+
+    # a failing call: deploy PUSH1 0 PUSH1 0 REVERT and call it
+    revert_rt = bytes.fromhex("60006000fd")
+    init = (bytes([0x60, len(revert_rt), 0x60, 0x0C, 0x60, 0x00, 0x39,
+                   0x60, len(revert_rt), 0x60, 0x00, 0xF3]) + revert_rt)
+    from eges_tpu.core.state import contract_address as _ca
+    raddr = _ca(ADDR, 3)
+    txs = [_signed(3, None, init), _signed(4, raddr)]
+    kept, root, rroot, gas, bloom = chain.execute_preview(
+        txs, coinbase=bytes(20))
+    head = chain.head()
+    blk2 = new_block(Header(parent_hash=head.hash, number=2,
+                            time=head.header.time + 1, root=root,
+                            receipt_hash=rroot, gas_used=gas,
+                            bloom=bloom), txs=kept)
+    assert chain.offer(blk2), chain.last_error
+    trace = rpc.dispatch("debug_traceTransaction",
+                         ["0x" + blk2.transactions[1].hash.hex()])
+    assert trace["failed"] is True
+    ops = [s["op"] for s in trace["structLogs"]]
+    assert ops == ["PUSH1", "PUSH1", "REVERT"]
+    assert trace["structLogs"][-1]["error"] == "execution reverted"
+
+    # unknown hash is a clean RPC error
+    with pytest.raises(RpcError):
+        rpc.dispatch("debug_traceTransaction", ["0x" + "ab" * 32])
