@@ -1,0 +1,22 @@
+"""Multi-host (DCN) communication backend (SURVEY §2.3 last row).
+
+The dry run spawns REAL OS processes that rendezvous through
+``jax.distributed`` and form one global mesh — exercising the
+coordination service and cross-process collectives, not a single-process
+simulation.  Ref analogue: the host plane that scatters batches between
+machines (eth/handler.go:1058-1103); here the scatter is a sharding and
+the gather is a psum riding DCN.
+"""
+
+import pytest
+
+
+@pytest.mark.slow
+def test_dryrun_multihost_two_processes():
+    """Two processes x 2 virtual CPU devices -> one 4-device global mesh;
+    sharded verify's psum tally must come back correct and replicated in
+    BOTH processes (each worker asserts it, plus its local address rows,
+    and prints OK; the launcher raises otherwise)."""
+    from eges_tpu.parallel.multihost import dryrun_multihost
+
+    dryrun_multihost(num_processes=2, devices_per_proc=2, timeout=1500)
