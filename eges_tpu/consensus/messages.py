@@ -21,7 +21,9 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from eges_tpu.core import rlp
-from eges_tpu.core.types import Block, ConfirmBlockMsg, QueryBlockMsg, Registration
+from eges_tpu.core.types import (
+    Block, ConfirmBlockMsg, Header, QueryBlockMsg, Registration,
+)
 from eges_tpu.crypto.keccak import keccak256
 
 # Direct-plane (UDP envelope) codes (ref: core/geecCore/Types.go:59-63)
@@ -30,6 +32,8 @@ UDP_ELECT = 0x02
 UDP_QUERY_REPLY = 0x03
 UDP_BLOCKS = 0x04      # backfill reply (this build; see BlockFetchReq)
 UDP_GET_BLOCKS = 0x05  # peer-directed backfill request (sync protocol)
+UDP_GET_HEADERS = 0x06  # header-first skeleton request (same req shape)
+UDP_HEADERS = 0x07      # header+cert reply (see HeadersReply)
 
 # Election sub-codes (ref: consensus/geec/election/election_go.go:15-18)
 MSG_ELECT = 0x01
@@ -49,6 +53,10 @@ GOSSIP_BLOCKS_REPLY = 0x18  # bulk backfill reply over TCP — block
 #   over devp2p TCP too, eth/handler.go:562-590 body exchange)
 GOSSIP_TXNS = 0x17  # transaction gossip (ref: TxMsg, eth/protocol.go:38 +
 #                     eth/handler.go:742-759 -> TxPool.AddRemotes)
+GOSSIP_GET_HEADERS = 0x19  # header-first skeleton request (broadcast
+#                            fallback, cf. GetBlockHeadersMsg
+#                            eth/protocol.go:67)
+GOSSIP_HEADERS_REPLY = 0x1A  # header+cert batches over TCP
 
 
 @dataclass(frozen=True)
@@ -246,6 +254,31 @@ class BlocksReply:
 
 
 @dataclass(frozen=True)
+class HeadersReply:
+    """Header-first sync payload: ``(header, confirm)`` pairs with no
+    bodies (the reference's header skeleton,
+    eth/downloader/downloader.go:931, with bodies filled by separate
+    lanes, queue.go:65-67).  Quorum certificates ride along so a joiner
+    batch-verifies the WHOLE gap's signatures in a few large device
+    batches before any body arrives — bodies then only need to hash
+    onto the pinned skeleton."""
+
+    headers: tuple  # of (Header, ConfirmBlockMsg | None)
+
+    def to_rlp(self) -> list:
+        return [[[h.to_rlp(), [] if c is None else c.to_rlp()]
+                 for h, c in self.headers]]
+
+    @classmethod
+    def from_rlp(cls, item: list) -> "HeadersReply":
+        (pairs,) = item
+        return cls(headers=tuple(
+            (Header.from_rlp(h),
+             ConfirmBlockMsg.from_rlp(c) if c else None)
+            for h, c in pairs))
+
+
+@dataclass(frozen=True)
 class TxnsMsg:
     """Transaction gossip payload (ref: TxMsg eth/protocol.go:38)."""
 
@@ -286,6 +319,8 @@ _DIRECT_BODY = {
     UDP_QUERY_REPLY: QueryReply,
     UDP_BLOCKS: BlocksReply,
     UDP_GET_BLOCKS: BlockFetchReq,
+    UDP_GET_HEADERS: BlockFetchReq,
+    UDP_HEADERS: HeadersReply,
 }
 
 
@@ -309,6 +344,8 @@ _GOSSIP_BODY = {
     GOSSIP_GET_BLOCKS: BlockFetchReq,
     GOSSIP_BLOCKS_REPLY: BlocksReply,
     GOSSIP_TXNS: TxnsMsg,
+    GOSSIP_GET_HEADERS: BlockFetchReq,
+    GOSSIP_HEADERS_REPLY: HeadersReply,
 }
 
 

@@ -118,6 +118,12 @@ class GeecNode:
         # out-of-order window wait here (the downloader queue role,
         # ref: eth/downloader/queue.go — bounded, lowest numbers kept)
         self._sync_stash: dict[int, Block] = {}
+        # header-first skeleton (ref: eth/downloader/downloader.go:931):
+        # number -> header hash whose quorum certificate batch-verified
+        # ahead of its body; bodies hashing onto a pin skip per-reply
+        # certificate verification, mismatches drop
+        self._sync_skel: dict[int, bytes] = {}
+        self._skel_req_upto = 0  # header-request watermark
         self.geec_txn_sink = None  # app-layer callback for confirmed geec txns
         self.txpool = None  # optional TxPool; proposals drain it
 
@@ -236,6 +242,10 @@ class GeecNode:
             self._serve_block_fetch(msg)
         elif code == M.GOSSIP_BLOCKS_REPLY:
             self._handle_blocks_reply(msg)
+        elif code == M.GOSSIP_GET_HEADERS:
+            self._serve_header_fetch(msg)
+        elif code == M.GOSSIP_HEADERS_REPLY:
+            self._handle_headers_reply(msg)
         elif code == M.GOSSIP_TXNS:
             self._handle_txns(msg)
 
@@ -254,6 +264,10 @@ class GeecNode:
             self._handle_blocks_reply(msg)
         elif code == M.UDP_GET_BLOCKS:
             self._serve_block_fetch(msg)
+        elif code == M.UDP_GET_HEADERS:
+            self._serve_header_fetch(msg)
+        elif code == M.UDP_HEADERS:
+            self._handle_headers_reply(msg)
 
     def on_geec_txn(self, payload: bytes) -> None:
         """UDP txn ingest (ref: consensus/geec/geec_api.go:28-41)."""
@@ -942,6 +956,11 @@ class GeecNode:
     SYNC_MAX_STALL = 8     # fruitless retries before giving up
     SYNC_FANOUT = 3        # concurrent ranged requests to distinct peers
     SYNC_STASH_MAX = 2048  # fetched-ahead blocks held for the funnel
+    HDR_BATCH = 256        # headers per skeleton request (headers+certs
+    #                        are ~50x smaller than 1000-txn bodies)
+    HDR_FANOUT = 2         # concurrent header lanes
+    SKEL_AHEAD = 4096      # skeleton prefetch horizon past the head
+    SKEL_MAX = 16384       # pinned hashes cap (32B each)
 
     def _request_backfill(self, target: int, start: int | None = None) -> None:
         """Start (or extend) a sync toward ``target``.
@@ -961,6 +980,8 @@ class GeecNode:
         height = self.chain.height()
         if height >= self._sync_target:
             self._cancel_timer("backfill")
+            self._sync_skel.clear()
+            self._skel_req_upto = 0
             return
         if self._sync_progress:
             retry = 0  # a reply delivered blocks: reset the stall budget
@@ -973,6 +994,8 @@ class GeecNode:
             self._cancel_timer("backfill")
             self._sync_target = 0
             self._sync_stash.clear()
+            self._sync_skel.clear()
+            self._skel_req_upto = 0
             return
         if start is None:
             # overlap a few blocks behind our head so the reply exposes
@@ -1004,6 +1027,35 @@ class GeecNode:
                 # for peers outside the membership
                 self.transport.gossip(
                     M.pack_gossip(M.GOSSIP_GET_BLOCKS, req))
+        # header-first skeleton prefetch (ref: downloader.go:931): pull
+        # the gap's headers+certificates ahead of bodies so the whole
+        # range's signatures batch-verify on the device at once and the
+        # body lanes skip per-reply verification (they hash onto pins).
+        # Watermark-gated: lost header replies just mean those numbers
+        # fall back to the certified body path — no retry machinery.
+        for n in [k for k in self._sync_skel if k <= height]:
+            del self._sync_skel[n]
+        if self._signing and len(self._sync_skel) < self.SKEL_MAX:
+            want_hi = min(self._sync_target, height + self.SKEL_AHEAD)
+            hdr_start = max(height + 1, self._skel_req_upto + 1)
+            for lane in range(self.HDR_FANOUT):
+                lane_start = hdr_start + lane * self.HDR_BATCH
+                if lane_start > want_hi:
+                    break
+                count = min(want_hi - lane_start + 1, self.HDR_BATCH)
+                hreq = M.BlockFetchReq(start=lane_start, count=count,
+                                       ip=self.cfg.consensus_ip,
+                                       port=self.cfg.consensus_port)
+                peer = self._pick_sync_peer(retry + 7 * lane + 3)
+                if peer is not None:
+                    self.transport.send_direct(
+                        peer.ip, peer.port,
+                        M.pack_direct(M.UDP_GET_HEADERS, self.coinbase,
+                                      hreq))
+                else:
+                    self.transport.gossip(
+                        M.pack_gossip(M.GOSSIP_GET_HEADERS, hreq))
+                self._skel_req_upto = lane_start + count - 1
         self._set_timer("backfill", self.ccfg.validate_timeout_ms / 1e3,
                         lambda: self._sync_tick(None, retry + 1))
 
@@ -1015,6 +1067,42 @@ class GeecNode:
         self._sync_rr = getattr(self, "_sync_rr", 0) + 1
         return peers[(self._sync_rr + retry) % len(peers)]
 
+    # UDP datagrams cap near 64 KB; a batch of blocks at the 1000-txn
+    # operating point is far larger (the in-process sim has no MTU,
+    # which hid this — a real-socket joiner stalled at height 0 while
+    # its peers' replies were silently dropped).  Small chunks go
+    # direct; anything bigger rides the TCP gossip plane (receivers
+    # that are not syncing dedupe via chain.offer).
+    UDP_BUDGET = 40_000
+
+    def _send_chunked(self, req, items, enc_len, make_reply,
+                      udp_code, gossip_code, max_items: int) -> None:
+        """Chunk sync reply ``items`` under the UDP budget — shared by
+        the block and header serve paths so the MTU handling can never
+        drift between the planes.  A single item too big for any
+        datagram rides the TCP gossip plane alone."""
+        chunk: list = []
+        size = 0
+        for it in items + [None]:
+            enc = enc_len(it) if it is not None else 0
+            if chunk and (it is None or size + enc > self.UDP_BUDGET
+                          or len(chunk) >= max_items):
+                reply = make_reply(tuple(chunk))
+                packed = M.pack_direct(udp_code, self.coinbase, reply)
+                if len(packed) <= self.UDP_BUDGET + 1024:
+                    self.transport.send_direct(req.ip, req.port, packed)
+                else:
+                    self.transport.gossip(
+                        M.pack_gossip(gossip_code, reply))
+                chunk, size = [], 0
+            if it is not None:
+                if enc > self.UDP_BUDGET:
+                    self.transport.gossip(M.pack_gossip(
+                        gossip_code, make_reply((it,))))
+                else:
+                    chunk.append(it)
+                    size += enc
+
     def _serve_block_fetch(self, req: M.BlockFetchReq) -> None:
         blocks = []
         for n in range(req.start, req.start + min(req.count,
@@ -1025,52 +1113,38 @@ class GeecNode:
             blocks.append(b)
         if not blocks:
             return
-        # UDP datagrams cap near 64 KB; a batch of blocks at the
-        # 1000-txn operating point is far larger (the in-process sim
-        # has no MTU, which hid this — a real-socket joiner stalled at
-        # height 0 while its peers' replies were silently dropped).
-        # Small chunks go direct; anything bigger rides the TCP gossip
-        # plane (receivers that are not syncing dedupe via chain.offer).
-        UDP_BUDGET = 40_000
-        chunk: list = []
-        size = 0
-        for b in blocks + [None]:
-            enc = len(b.encode()) if b is not None else 0
-            if chunk and (b is None or size + enc > UDP_BUDGET
-                          or len(chunk) >= 32):
-                reply = M.BlocksReply(blocks=tuple(chunk))
-                packed = M.pack_direct(M.UDP_BLOCKS, self.coinbase, reply)
-                if len(packed) <= UDP_BUDGET + 1024:
-                    self.transport.send_direct(req.ip, req.port, packed)
-                else:
-                    self.transport.gossip(
-                        M.pack_gossip(M.GOSSIP_BLOCKS_REPLY, reply))
-                chunk, size = [], 0
-            if b is not None:
-                if enc > UDP_BUDGET:
-                    # a single oversized block: TCP, alone
-                    self.transport.gossip(M.pack_gossip(
-                        M.GOSSIP_BLOCKS_REPLY,
-                        M.BlocksReply(blocks=(b,))))
-                else:
-                    chunk.append(b)
-                    size += enc
+        self._send_chunked(
+            req, blocks, lambda b: len(b.encode()),
+            lambda t: M.BlocksReply(blocks=t),
+            M.UDP_BLOCKS, M.GOSSIP_BLOCKS_REPLY, max_items=32)
 
-    def _filter_certified(self, blocks) -> list:
-        """Drop backfilled blocks whose quorum confirm doesn't verify —
-        a sync peer must not be able to hand us fabricated "confirmed"
-        history.  Locally-forced empty blocks (confidence 0) are
-        legitimately uncertified, and are exactly the blocks
-        replace_suffix may later displace.  All certificates across the
-        reply are recovered in ONE verifier batch."""
+    def _certified_mask(self, items) -> list[bool]:
+        """For ``(number, obj_hash, confirm)`` triples: True when the
+        quorum certificate verifies AND actually certifies the object in
+        hand (or none is required — confidence-0 local empties carry
+        none legitimately).  The binding matters as much as the
+        signatures: a replayed GENUINE certificate paired with a
+        fabricated header/block must fail here, so the confirm's claimed
+        number and hash are checked against the object before any
+        signature work.  The one certificate shape that cannot bind a
+        hash — version>0 empty-block recovery, whose supporters signed
+        the zero hash — is handled by the callers (bodies must be empty;
+        headers are never pinned on it).  All certificates across the
+        batch are recovered in ONE verifier batch — during catch-up this
+        is where a whole gap's signatures land on the device together."""
         need = self.membership.validate_threshold()
-        spans = []          # (block_index, entry_span) needing verification
+        spans = []          # (item_index, entry_span) needing verification
         all_entries = []
-        keep = [True] * len(blocks)
-        for i, b in enumerate(blocks):
-            if b.confirm is None or b.confirm.confidence == 0:
+        keep = [True] * len(items)
+        for i, (number, obj_hash, confirm) in enumerate(items):
+            if confirm is None or confirm.confidence == 0:
                 continue
-            entries = self._confirm_cert_entries(b.confirm)
+            if confirm.block_number != number or (
+                    confirm.hash != obj_hash
+                    and self._cert_binds_hash(confirm)):
+                keep[i] = False  # certificate is for a different object
+                continue
+            entries = self._confirm_cert_entries(confirm)
             if entries is None:
                 keep[i] = False
                 continue
@@ -1081,13 +1155,91 @@ class GeecNode:
             valid = [a for a in recovered[start:start + n] if a is not None]
             ok = len(valid) >= need
             if ok:
-                seed = self.seed_for(blocks[i].number)
+                seed = self.seed_for(items[i][0])
                 if seed is not None and sum(
                         1 for a in valid
                         if self.membership.is_acceptor(a, seed)) < need:
                     ok = False
             keep[i] = ok
-        return [b for i, b in enumerate(blocks) if keep[i]]
+        return keep
+
+    @staticmethod
+    def _cert_binds_hash(confirm) -> bool:
+        """False for the one certificate shape whose supporter
+        signatures do not cover a block hash: version>0 empty-block
+        recovery signs the zero hash — it certifies "empty at N", not
+        any particular bytes."""
+        return not (confirm.version > 0 and confirm.empty_block)
+
+    def _serve_header_fetch(self, req: M.BlockFetchReq) -> None:
+        """Serve a header-skeleton request: (header, confirm) pairs, no
+        bodies (ref: eth/handler.go GetBlockHeadersMsg role).  Chunked
+        like block replies: small chunks ride UDP back to the asker,
+        oversized ones the TCP gossip plane."""
+        from eges_tpu.core import rlp as rlp_mod
+
+        pairs = []
+        for n in range(req.start, req.start + min(req.count,
+                                                  2 * self.HDR_BATCH)):
+            b = self.chain.get_block_by_number(n)
+            if b is None:
+                break
+            pairs.append((b.header, b.confirm))
+        if not pairs:
+            return
+        self._send_chunked(
+            req, pairs,
+            lambda p: (len(rlp_mod.encode(p[0].to_rlp()))
+                       + (len(rlp_mod.encode(p[1].to_rlp()))
+                          if p[1] else 1)),
+            lambda t: M.HeadersReply(headers=t),
+            M.UDP_HEADERS, M.GOSSIP_HEADERS_REPLY, max_items=128)
+
+    def _handle_headers_reply(self, reply: M.HeadersReply) -> None:
+        """Pin the verified skeleton: batch-verify every certificate in
+        the reply (one device batch for the lot) and remember the header
+        hashes, so arriving bodies only need to hash onto a pin.
+        Uncertified headers (local empties, or certs that fail) are NOT
+        pinned — their bodies take the fully-verified path."""
+        pairs = [(h, c) for h, c in reply.headers
+                 if h.number > self.chain.height()]
+        if not pairs or not self._signing:
+            return  # without signed votes there is nothing to pre-verify
+        if len(self._sync_skel) + len(pairs) > self.SKEL_MAX:
+            pairs = pairs[:max(0, self.SKEL_MAX - len(self._sync_skel))]
+            if not pairs:
+                return
+        mask = self._certified_mask([(h.number, h.hash, c)
+                                     for h, c in pairs])
+        for (h, c), ok in zip(pairs, mask):
+            # pin only hash-binding certificates: the mask has already
+            # checked c.hash == h.hash for these, so the pin IS what the
+            # quorum signed.  Recovery empties (sigs over the zero hash)
+            # can't bind bytes and are never pinned.
+            if (ok and c is not None and c.confidence > 0
+                    and self._cert_binds_hash(c)):
+                self._sync_skel[h.number] = h.hash
+
+    def _filter_certified(self, blocks) -> list:
+        """Drop backfilled blocks whose quorum confirm doesn't verify or
+        doesn't certify THIS block — a sync peer must not be able to
+        hand us fabricated "confirmed" history, including a fabricated
+        block wearing a replayed genuine certificate.  Locally-forced
+        empty blocks (confidence 0) are legitimately uncertified, and
+        are exactly the blocks replace_suffix may later displace."""
+        keep = self._certified_mask(
+            [(b.number, b.hash, b.confirm) for b in blocks])
+        out = []
+        for b, k in zip(blocks, keep):
+            if not k:
+                continue
+            c = b.confirm
+            if (c is not None and c.confidence > 0
+                    and not self._cert_binds_hash(c)
+                    and (b.transactions or b.geec_txns or b.fake_txns)):
+                continue  # recovery cert proves only "empty at N"
+            out.append(b)
+        return out
 
     def _handle_blocks_reply(self, reply: M.BlocksReply) -> None:
         """Backfilled canonical blocks: heal a local-empty-block fork via
@@ -1095,7 +1247,25 @@ class GeecNode:
         reply's overlap, re-request further back (doubling window)."""
         blocks = sorted(reply.blocks, key=lambda b: b.number)
         if self._signing:
-            blocks = self._filter_certified(blocks)
+            # header-first fast path: a body hashing onto a pinned
+            # (pre-verified) skeleton entry needs no certificate work.
+            # A body CONTRADICTING its pin falls back to full
+            # certificate verification — and if its hash-bound
+            # certificate verifies, the pin was wrong (equivocation or
+            # poisoning upstream) and is evicted, so one bad pin can
+            # never starve a height and wedge the sync.
+            pinned, rest = [], []
+            for b in blocks:
+                pin = self._sync_skel.get(b.number)
+                if pin is not None and b.hash == pin:
+                    pinned.append(b)
+                else:
+                    rest.append(b)
+            verified = self._filter_certified(rest)
+            for b in verified:
+                if self._sync_skel.get(b.number) not in (None, b.hash):
+                    del self._sync_skel[b.number]
+            blocks = sorted(pinned + verified, key=lambda b: b.number)
         if not blocks:
             return
         head = self.chain.height()
@@ -1144,6 +1314,11 @@ class GeecNode:
                 and self.chain.height() < getattr(self, "_sync_target", 0)):
             self._cancel_timer("backfill")
             self._sync_tick(start=None, retry=0)
+        elif self.chain.height() >= getattr(self, "_sync_target", 0):
+            # target reached in this very reply: drop the skeleton now
+            # rather than waiting for the timer's completion tick
+            self._sync_skel.clear()
+            self._skel_req_upto = 0
 
     # ------------------------------------------------------------------
     # chain listener (ref: handleNewBlock geec_state.go:964-1018 +
