@@ -68,3 +68,117 @@ def test_geec_engine_minimal_header_rule():
                           time=parent.header.time + 1,
                           root=parent.header.root))
     assert chain.offer(ok), chain.last_error
+
+
+def test_pow_difficulty_retarget():
+    from eges_tpu.consensus.engine import PowEngine
+
+    parent = Header(number=5, time=100, difficulty=10_000)
+    # on-pace block: slight rise (the rule's bias at exactly setpoint)
+    fast = PowEngine.calc_difficulty(parent, 100 + 5)
+    slow = PowEngine.calc_difficulty(parent, 100 + 60)
+    assert fast > parent.difficulty > slow
+    # floor holds
+    tiny = Header(number=5, time=100, difficulty=1)
+    assert PowEngine.calc_difficulty(tiny, 100 + 600) == 1
+
+
+def test_pow_engine_device_sweep_seals_and_chain_verifies():
+    """The ethash-role engine end-to-end: device-batched nonce sweep
+    (batched Keccak graph) finds a seal, the chain's engine seam
+    verifies it, tampering and wrong difficulty are rejected."""
+    from eges_tpu.consensus.engine import PowEngine
+
+    eng = PowEngine(sweep_batch=128)
+    chain = BlockChain(genesis=make_genesis(), engine=eng)
+    for _ in range(3):
+        eng.mine_next(chain)
+    assert chain.height() == 3
+    assert eng.use_device, "device sweep silently fell back to host"
+    b = chain.get_block_by_number(2)
+    assert eng.pow_value(eng.seal_hash(b.header), b.header.nonce) \
+        <= (1 << 256) // b.header.difficulty
+
+    # wrong difficulty fails the retarget check
+    bad2 = dataclasses.replace(b.header, difficulty=b.header.difficulty + 5)
+    with pytest.raises(EngineError, match="retarget"):
+        eng.verify_header(chain, bad2)
+    # nonzero mix_digest rejected
+    bad3 = dataclasses.replace(b.header, mix_digest=b"\x01" + bytes(31))
+    with pytest.raises(EngineError, match="mix_digest"):
+        eng.verify_header(chain, bad3)
+
+    # at a REAL difficulty (genesis-chain difficulty is ~1, where half
+    # of all nonces win) the seal check has teeth: a sealed header
+    # verifies, a tampered nonce fails.  number=999 has no parent in
+    # the chain, so retarget is skipped and the seal check is isolated.
+    hdr = Header(number=999, time=50, difficulty=4096,
+                 parent_hash=b"\x77" * 32)
+    sealed = eng.seal(chain, new_block(hdr)).header
+    eng.verify_header(chain, sealed)
+    target = (1 << 256) // 4096
+    sh = eng.seal_hash(sealed)
+    n = int.from_bytes(sealed.nonce, "big")
+    while True:  # deterministic: find a nonce that genuinely fails
+        n = (n + 1) % (1 << 64)
+        tampered = n.to_bytes(8, "big")
+        if eng.pow_value(sh, tampered) > target:
+            break
+    with pytest.raises(EngineError, match="seal below difficulty"):
+        eng.verify_header(chain,
+                          dataclasses.replace(sealed, nonce=tampered))
+
+
+def test_pow_host_fallback_agrees_with_device_path():
+    from eges_tpu.consensus.engine import PowEngine
+
+    host = PowEngine(sweep_batch=64, use_device=False)
+    chain = BlockChain(genesis=make_genesis(), engine=host)
+    blk = host.mine_next(chain)
+    # a fresh device-path engine accepts the host-sealed header
+    dev = PowEngine(sweep_batch=64)
+    dev.verify_header(chain, blk.header)
+
+
+def test_pow_timestamp_rules_block_difficulty_grinding():
+    from eges_tpu.consensus.engine import PowEngine
+
+    eng = PowEngine(sweep_batch=64, use_device=False)
+    chain = BlockChain(genesis=make_genesis(), engine=eng)
+    blk = eng.mine_next(chain)
+    # not after parent
+    import dataclasses as dc
+    stale = dc.replace(blk.header, time=chain.genesis.header.time)
+    with pytest.raises(EngineError, match="after parent"):
+        eng.verify_header(chain, stale)
+    # a far-future timestamp (the difficulty-grinding vector) rejected
+    import time as _t
+    future = dc.replace(blk.header, time=int(_t.time()) + 3600)
+    with pytest.raises(EngineError, match="future"):
+        eng.verify_header(chain, future)
+
+
+def test_pow_mine_next_previews_under_sealed_header_ctx():
+    """A contract reading TIMESTAMP must commit the same root the
+    validators recompute from block_ctx(header) — the preview must run
+    under the sealed header's exact time/difficulty."""
+    from eges_tpu.consensus.engine import PowEngine
+    from eges_tpu.core.state import contract_address
+
+    priv = bytes([7]) * 32
+    addr = secp.pubkey_to_address(secp.privkey_to_pubkey(priv))
+    eng = PowEngine(sweep_batch=64, use_device=False)
+    chain = BlockChain(genesis=make_genesis(alloc={addr: 10**18}),
+                       alloc={addr: 10**18}, engine=eng)
+    runtime = bytes.fromhex("42600055")  # SSTORE(0, TIMESTAMP)
+    init = (bytes([0x60, len(runtime), 0x60, 0x0C, 0x60, 0x00, 0x39,
+                   0x60, len(runtime), 0x60, 0x00, 0xF3]) + runtime)
+    t0 = Transaction(nonce=0, gas_price=0, gas_limit=300_000, to=None,
+                     value=0, payload=init).signed(priv)
+    caddr = contract_address(addr, 0)
+    t1 = Transaction(nonce=1, gas_price=0, gas_limit=200_000, to=caddr,
+                     value=0).signed(priv)
+    eng.mine_next(chain, txs=[t0, t1], coinbase=addr)
+    assert chain.height() == 1  # would be rejected on a ctx mismatch
+    head = chain.head()
+    assert chain.head_state().storage_at(caddr, 0) == head.header.time
