@@ -132,6 +132,7 @@ def test_leader_crash_recovers_via_empty_block():
     assert h1 >= h0 + 5, f"chain stalled after partition: {h0} -> {h1}"
 
 
+@pytest.mark.slow
 def test_deterministic_replay():
     def run_once():
         c = SimCluster(3, txn_per_block=2, seed=11)

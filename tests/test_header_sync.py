@@ -8,6 +8,8 @@ per-reply certificate verification — and a body contradicting its pin
 is discarded no matter how plausible its own certificate looks.
 """
 
+import pytest
+
 from eges_tpu.consensus import messages as M
 from eges_tpu.sim.cluster import SimCluster
 
@@ -30,6 +32,7 @@ def test_headers_reply_wire_roundtrip():
         assert got.headers[1][1] is None
 
 
+@pytest.mark.slow
 def test_skeleton_pins_and_bodies_bypass_certificates():
     """End-to-end in the signed sim: a late joiner pins a verified
     skeleton during catch-up, and bodies hashing onto pins skip the

@@ -233,6 +233,7 @@ def test_k_fn_mul_matches_graph_path():
         assert limbs_to_int(row) == (x * y) % N
 
 
+@pytest.mark.slow
 def test_fn_mul_kernel_interpret():
     """The mod-N kernel through pallas_call (interpret mode): covers
     the kernel plumbing at a size XLA CPU can still compile."""

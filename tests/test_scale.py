@@ -43,6 +43,7 @@ def test_window_semantics_at_64():
     assert m.election_threshold(8) == (8 + 1 + 1) // 2 - 1
 
 
+@pytest.mark.slow
 def test_64_node_cluster_liveness():
     """64 real state machines confirm blocks in lockstep."""
     c = SimCluster(64, n_candidates=8, n_acceptors=16, txn_per_block=2,

@@ -2,6 +2,8 @@
 (ref: eth/handler.go:742-759 TxMsg; eth/downloader/downloader.go:931),
 plus the state-backed RPC methods."""
 
+import pytest
+
 from eges_tpu.core.state import INTRINSIC_GAS
 from eges_tpu.core.txpool import TxPool
 from eges_tpu.core.types import Transaction
@@ -21,6 +23,7 @@ def _signed(nonce, value=1, gas_price=0):
                        value=value).signed(PRIV, chain_id=1)
 
 
+@pytest.mark.slow
 def test_tx_gossip_reaches_every_pool_and_executes():
     """A txn submitted at ONE node propagates to every pool via gossip
     and is executed by whichever proposer includes it."""
@@ -40,6 +43,7 @@ def test_tx_gossip_reaches_every_pool_and_executes():
         assert len(sn.node.txpool) == 0  # included -> removed everywhere
 
 
+@pytest.mark.slow
 def test_fresh_node_syncs_long_chain():
     """test-sync.py parity at VERDICT's operating point: a node that
     missed 1000+ blocks catches up to the quorum head via the ranged,
@@ -64,6 +68,7 @@ def test_fresh_node_syncs_long_chain():
             == survivors[0].chain.get_block_by_number(target).hash)
 
 
+@pytest.mark.slow
 def test_sync_gives_up_on_phantom_target():
     """A forged far-future confirm number must not leave the node
     polling forever: the stall budget abandons the target."""
@@ -99,6 +104,7 @@ def test_rpc_state_methods():
     assert rpc.dispatch("eth_getTransactionReceipt", ["0x" + "ab" * 32]) is None
 
 
+@pytest.mark.slow
 def test_concurrent_lanes_fill_stash_and_catch_up():
     """A node 400+ blocks behind issues multiple concurrent ranged
     requests (downloader fetchParts role); fetched-ahead blocks stage in
