@@ -17,9 +17,18 @@ Wire format (UDP, RLP):
                  expiry])) — identity = address(pubkey)
     GET_PEERS = [0x02, nonce8]
     PEERS     = [0x03, nonce8, [[addr20, gip, gport, cip, cport], ...]]
+    ENR_ANNOUNCE = [0x04, record]      signed node record (net/enr.py)
+    GET_RECORDS  = [0x05, nonce8]
+    RECORDS      = [0x06, nonce8, [record, ...]]
 
 Bootnodes verify announce signatures and expiry, evict stale entries,
-and never relay more than ``SAMPLE`` peers per query.
+and never relay more than ``SAMPLE`` peers per query.  The record path
+(codes 4-6) is the upgrade of the ad-hoc signed tuple: the bootnode
+keeps the highest-``seq`` record per identity and lookups return the
+peer's own signed statement, so a compromised bootnode cannot forge
+endpoints — it can only withhold (ref: p2p/enr, p2p/discover/v4_udp.go
+ENRRequest).  Both announce paths run endpoint sanity + per-subnet
+caps from net/netutil.py (ref: p2p/netutil).
 """
 
 from __future__ import annotations
@@ -29,10 +38,15 @@ import time
 
 from eges_tpu.core import rlp
 from eges_tpu.crypto.keccak import keccak256
+from eges_tpu.net import enr as enrlib
+from eges_tpu.net import netutil
 
 ANNOUNCE = 1
 GET_PEERS = 2
 PEERS = 3
+ENR_ANNOUNCE = 4
+GET_RECORDS = 5
+RECORDS = 6
 
 ANNOUNCE_TTL_S = 60.0
 SAMPLE = 16
@@ -63,40 +77,58 @@ class BootnodeService:
     """
 
     def __init__(self, bind_ip: str, port: int, *,
-                 authorize=None, clock=time.time):
+                 authorize=None, clock=time.time,
+                 subnet_limit: int = 16):
         self.bind_ip = bind_ip
         self.port = port
         self.authorize = authorize  # callable(addr20) -> bool
         self.clock = clock
         # addr -> (gip, gport, cip, cport, expires_at)
         self.registry: dict[bytes, tuple] = {}
+        # addr -> highest-seq verified Record for ENR announcers
+        self.records: dict[bytes, enrlib.Record] = {}
+        self._netset = netutil.DistinctNetSet(24, subnet_limit)
         self._transport = None
 
     # -- message handling (transport-independent, sim-testable) ----------
 
     def handle(self, data: bytes, reply) -> None:
         """``reply(bytes)`` sends back to the datagram source."""
+        # one hostile datagram must never take down the registry, even
+        # for direct (transportless) embeddings of handle(): the whole
+        # dispatch is guarded, not just the RLP parse
         try:
             item = rlp.decode(data)
             code = rlp.decode_uint(item[0])
+            now = self.clock()
+            if code == ANNOUNCE:
+                self._on_announce(item, now)
+            elif code == ENR_ANNOUNCE and len(item) >= 2:
+                self._on_enr_announce(bytes(item[1]), now)
+            elif code == GET_PEERS and len(item) >= 2:
+                self._evict(now)
+                peers = [[a, gip.encode(), gp, cip.encode(), cp]
+                         for a, (gip, gp, cip, cp, _) in
+                         self._sample(self.registry)]
+                reply(rlp.encode([PEERS, bytes(item[1]), peers]))
+            elif code == GET_RECORDS and len(item) >= 2:
+                self._evict(now)
+                recs = [r.encode() for _, r in self._sample(self.records)]
+                reply(rlp.encode([RECORDS, bytes(item[1]), recs]))
         except Exception:
             return
-        now = self.clock()
-        if code == ANNOUNCE:
-            self._on_announce(item, now)
-        elif code == GET_PEERS and len(item) >= 2:
-            import random
 
-            self._evict(now)
-            entries = list(self.registry.items())
-            if len(entries) > SAMPLE:
-                # a RANDOM sample, not the first insertion-ordered slice:
-                # otherwise members past the first SAMPLE are never
-                # advertised and late joiners only ever learn one subset
-                entries = random.sample(entries, SAMPLE)
-            peers = [[a, gip.encode(), gp, cip.encode(), cp]
-                     for a, (gip, gp, cip, cp, _) in entries]
-            reply(rlp.encode([PEERS, bytes(item[1]), peers]))
+    @staticmethod
+    def _sample(table: dict) -> list:
+        import random
+
+        entries = list(table.items())
+        if len(entries) > SAMPLE:
+            # a RANDOM sample, not the first insertion-ordered slice:
+            # otherwise members past the first SAMPLE are never
+            # advertised and late joiners only ever learn one subset
+            entries = random.sample(entries, SAMPLE)
+        return entries
 
     def _on_announce(self, item: list, now: float) -> None:
         from eges_tpu.crypto import secp256k1 as secp
@@ -119,15 +151,54 @@ class BootnodeService:
             return
         if signer != secp.pubkey_to_address(pub):
             return
-        if self.authorize is not None and not self.authorize(signer):
+        self._admit(signer, gip, gport, cip, cport, now)
+
+    def _on_enr_announce(self, data: bytes, now: float) -> None:
+        try:
+            rec = enrlib.Record.decode(data)
+        except enrlib.ENRError:
             return
-        self.registry[signer] = (gip, gport, cip, cport,
-                                 now + ANNOUNCE_TTL_S)
+        prev = self.records.get(rec.addr)
+        if prev is not None:
+            if rec.seq < prev.seq:
+                return  # stale record
+            if rec.seq == prev.seq and rec != prev:
+                return  # conflicting content under one seq: keep first
+            # identical record re-announced: fall through, refresh TTL
+        gep, cep = rec.gossip_endpoint(), rec.consensus_endpoint()
+        if gep is None or cep is None:
+            return
+        if self._admit(rec.addr, gep[0], gep[1], cep[0], cep[1], now):
+            self.records[rec.addr] = rec
+
+    def _admit(self, addr: bytes, gip: str, gport: int,
+               cip: str, cport: int, now: float) -> bool:
+        if not (netutil.good_endpoint(gip, gport)
+                and netutil.good_endpoint(cip, cport)):
+            return False
+        if self.authorize is not None and not self.authorize(addr):
+            return False
+        old = self.registry.get(addr)
+        if old is None or old[0] != gip:
+            # release the identity's old slot BEFORE claiming the new
+            # one: a node moving within an at-cap /24 must not be
+            # bounced by its own old address (restore on failure)
+            if old is not None:
+                self._netset.remove(old[0])
+            if not self._netset.add(gip):
+                if old is not None:
+                    self._netset.add(old[0])
+                return False  # this /24 already holds its share
+        self.registry[addr] = (gip, gport, cip, cport,
+                               now + ANNOUNCE_TTL_S)
+        return True
 
     def _evict(self, now: float) -> None:
         for a, rec in list(self.registry.items()):
             if rec[4] < now:
+                self._netset.remove(rec[0])
                 del self.registry[a]
+                self.records.pop(a, None)
 
     # -- asyncio UDP server ----------------------------------------------
 
@@ -174,17 +245,41 @@ class DiscoveryClient:
         self.pub = secp.privkey_to_pubkey(priv)
         self.me = secp.pubkey_to_address(self.pub)
         self.endpoint = (gip, gport, cip, cport)
+        # the node's own signed record.  seq must outrank every record
+        # this identity ever announced before — a restart with a new
+        # endpoint would otherwise be rejected as stale forever — so
+        # without persistent state, wall-clock seconds is the seq (ref:
+        # p2p/enr seq counters are persisted; geth's discv4 uses the
+        # same timestamp trick for endpoint proofs)
+        self.record = enrlib.Record.sign(
+            priv, int(time.time()), ip=gip, tcp=gport, udp=cport, cip=cip)
         self.on_peer = on_peer
         self.interval_s = interval_s
         self.known: dict[bytes, tuple] = {}
+        self.known_seq: dict[bytes, int] = {}
         self._transport = None
         self._task = None
 
     def _on_datagram(self, data: bytes) -> None:
         try:
             item = rlp.decode(data)
-            if rlp.decode_uint(item[0]) != PEERS:
+            code = rlp.decode_uint(item[0])
+        except Exception:
+            return
+        if code == RECORDS:
+            try:
+                recs = item[2]
+            except Exception:
                 return
+            for raw in recs:
+                try:
+                    self._on_record(bytes(raw))
+                except Exception:
+                    continue  # one bad record must not shadow the rest
+            return
+        if code != PEERS:
+            return
+        try:
             peers = item[2]
         except Exception:
             return
@@ -195,11 +290,36 @@ class DiscoveryClient:
                 cip, cport = bytes(p[3]).decode(), rlp.decode_uint(p[4])
             except Exception:
                 continue
-            if addr == self.me or addr in self.known:
-                continue
-            self.known[addr] = (gip, gport, cip, cport)
-            if self.on_peer is not None:
-                self.on_peer(addr, (gip, gport), (cip, cport))
+            self._learn(addr, gip, gport, cip, cport, seq=0)
+
+    def _on_record(self, raw: bytes) -> None:
+        try:
+            rec = enrlib.Record.decode(raw)
+        except enrlib.ENRError:
+            return
+        gep, cep = rec.gossip_endpoint(), rec.consensus_endpoint()
+        if gep is None or cep is None:
+            return
+        self._learn(rec.addr, gep[0], gep[1], cep[0], cep[1],
+                    seq=rec.seq)
+
+    def _learn(self, addr: bytes, gip: str, gport: int,
+               cip: str, cport: int, *, seq: int) -> None:
+        if addr == self.me:
+            return
+        if not (netutil.good_endpoint(gip, gport)
+                and netutil.good_endpoint(cip, cport)):
+            return
+        if addr in self.known:
+            # a signed record with a higher seq may move a known peer's
+            # endpoint; the unsigned legacy tuple (seq=0) never does
+            if seq <= self.known_seq.get(addr, 0) \
+                    or self.known[addr] == (gip, gport, cip, cport):
+                return
+        self.known[addr] = (gip, gport, cip, cport)
+        self.known_seq[addr] = seq
+        if self.on_peer is not None:
+            self.on_peer(addr, (gip, gport), (cip, cport))
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -214,10 +334,16 @@ class DiscoveryClient:
         while True:
             gip, gport, cip, cport = self.endpoint
             ann = encode_announce(self.priv, self.pub, gip, gport, cip, cport)
+            enr_ann = rlp.encode([ENR_ANNOUNCE, self.record.encode()])
             query = rlp.encode([GET_PEERS, _secrets.token_bytes(8)])
+            rquery = rlp.encode([GET_RECORDS, _secrets.token_bytes(8)])
             for bn in self.bootnodes:
                 try:
+                    # both generations: records are preferred, the
+                    # legacy tuple keeps mixed clusters converging
+                    self._transport.sendto(enr_ann, bn)
                     self._transport.sendto(ann, bn)
+                    self._transport.sendto(rquery, bn)
                     self._transport.sendto(query, bn)
                 except Exception:
                     pass

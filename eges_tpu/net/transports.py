@@ -324,6 +324,21 @@ class GossipPlane:
         self.peers.append(peer)
         self._tasks.append(asyncio.create_task(self._dial_loop(peer)))
 
+    def remove_peer(self, peer: tuple[str, int]) -> None:
+        """Stop dialing an endpoint a peer no longer lives at (a
+        higher-seq discovery record re-homed it); closes any live
+        connection so the dial loop winds down instead of redialing a
+        dead address forever."""
+        if peer not in self.peers:
+            return
+        self.peers.remove(peer)
+        held = self._writers.pop(peer, None)
+        if held is not None:
+            try:
+                held[0].close()
+            except Exception:
+                pass
+
     @staticmethod
     async def _read_frame(reader) -> bytes:
         hdr = await reader.readexactly(4)
@@ -388,7 +403,7 @@ class GossipPlane:
     async def _dial_loop(self, peer: tuple[str, int]) -> None:
         backoff = 0.2
         quick_closes = 0
-        while not self._closed:
+        while not self._closed and peer in self.peers:
             rejected = False
             held = None
             try:
@@ -403,7 +418,8 @@ class GossipPlane:
                 t0 = time.monotonic()
                 try:
                     # hold the connection; writer errors surface on send
-                    while not writer.is_closing() and not self._closed:
+                    while not writer.is_closing() and not self._closed \
+                            and peer in self.peers:
                         await asyncio.sleep(0.5)
                 finally:
                     held = time.monotonic() - t0

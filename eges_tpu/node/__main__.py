@@ -50,6 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gossipIP", default="127.0.0.1")
     p.add_argument("--gossipPort", type=int, default=6190)
     p.add_argument("--peers", default="", help="ip:port,ip:port gossip peers")
+    p.add_argument("--nat", default="none",
+                   help="advertised-address policy for discovery: "
+                        "none | auto | extip:<ip> (ref p2p/nat)")
     p.add_argument("--bootnodes", default="",
                    help="ip:port,... discovery bootnodes (makes --peers "
                         "optional)")
@@ -109,6 +112,7 @@ def main(argv=None) -> None:
         gossip_allowlist=tuple(a for a in args.gossipAllowlist.split(",")
                                if a),
         bootnodes=parse_peers(args.bootnodes),
+        nat=args.nat,
         verifier_mode=args.verifier)
 
     loop = asyncio.new_event_loop()

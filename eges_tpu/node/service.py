@@ -66,6 +66,10 @@ class ServiceConfig:
     bootnodes: tuple[tuple[str, int], ...] = ()  # discovery; makes
     #                                    --peers optional (ref:
     #                                    p2p/discover + cmd/bootnode)
+    nat: str = "none"                  # advertised-address policy for
+    #                                    discovery announces: none /
+    #                                    auto / extip:<ip> (ref:
+    #                                    p2p/nat/nat.go Parse)
 
 
 def load_genesis_config(path: str) -> tuple[ChainGeecConfig, dict]:
@@ -187,12 +191,27 @@ class NodeService:
 
         self.discovery = None
         if cfg.bootnodes:
+            from eges_tpu.net import nat as natlib
             from eges_tpu.net.discovery import DiscoveryClient
+            # announce the NAT-resolved address, bind the configured one
+            adv_gip = natlib.resolve(cfg.nat, cfg.gossip_ip)
+            adv_cip = natlib.resolve(cfg.nat, ncfg.consensus_ip)
+            disc_eps: dict[bytes, tuple[str, int]] = {}
+
+            def _on_disc_peer(addr, gep, cep):
+                # a higher-seq record can re-home a peer: retire the
+                # dial loop on the old endpoint before adding the new
+                old = disc_eps.get(addr)
+                if old is not None and old != gep:
+                    self.gossip.remove_peer(old)
+                disc_eps[addr] = gep
+                self.gossip.add_peer(gep)
+
             self.discovery = DiscoveryClient(
                 list(cfg.bootnodes), priv,
-                cfg.gossip_ip, cfg.gossip_port,
-                ncfg.consensus_ip, ncfg.consensus_port,
-                on_peer=lambda addr, gep, cep: self.gossip.add_peer(gep))
+                adv_gip, cfg.gossip_port,
+                adv_cip, ncfg.consensus_port,
+                on_peer=_on_disc_peer)
 
         self.txn_service = None
         if ncfg.geec_txn_port:

@@ -1,0 +1,230 @@
+"""Signed node records, endpoint sanity, and NAT policy
+(ref roles: p2p/enr/enr.go, p2p/netutil/net.go, p2p/nat/nat.go)."""
+
+import pytest
+
+from eges_tpu.core import rlp
+from eges_tpu.crypto import secp256k1 as secp
+from eges_tpu.net import nat, netutil
+from eges_tpu.net.discovery import (
+    ANNOUNCE_TTL_S, BootnodeService, DiscoveryClient, ENR_ANNOUNCE,
+    GET_RECORDS, RECORDS,
+)
+from eges_tpu.net.enr import ENRError, Record
+
+
+def kp(i: int):
+    priv = bytes([i]) * 32
+    pub = secp.privkey_to_pubkey(priv)
+    return priv, pub, secp.pubkey_to_address(pub)
+
+
+# -- records ---------------------------------------------------------------
+
+def test_record_roundtrip_and_accessors():
+    priv, _, addr = kp(1)
+    rec = Record.sign(priv, 3, ip="10.0.0.9", tcp=6190, udp=8100,
+                      cip="10.0.0.10")
+    out = Record.decode(rec.encode())
+    assert out == rec
+    assert out.addr == addr
+    assert out.seq == 3
+    assert out.gossip_endpoint() == ("10.0.0.9", 6190)
+    assert out.consensus_endpoint() == ("10.0.0.10", 8100)
+    # cip omitted when it equals ip; consensus falls back to ip
+    rec2 = Record.sign(priv, 1, ip="10.0.0.9", tcp=1, udp=2,
+                       cip="10.0.0.9")
+    assert b"cip" not in rec2.pairs
+    assert Record.decode(rec2.encode()).consensus_endpoint() == \
+        ("10.0.0.9", 2)
+
+
+def test_record_rejects_tampering_and_malformed():
+    priv, pub, _ = kp(2)
+    rec = Record.sign(priv, 1, ip="10.0.0.9", tcp=6190, udp=8100)
+    items = rlp.decode(rec.encode())
+
+    # flip the port value after signing -> signer changes or recovery
+    # fails; either way the claimed pairs are no longer what was signed
+    bad = [bytes(x) for x in items]
+    i = [bytes(x) for x in items].index(b"tcp") + 1
+    bad[i] = (9999).to_bytes(2, "big")
+    try:
+        forged = Record.decode(rlp.encode(bad))
+    except ENRError:
+        forged = None
+    assert forged is None or forged.addr != rec.addr
+
+    # unsorted keys are non-canonical
+    shuffled = [bytes(items[0]), bytes(items[1]),
+                b"tcp", bad[i], b"id", b"gv4"]
+    with pytest.raises(ENRError):
+        Record.decode(rlp.encode(shuffled))
+
+    # unknown identity scheme
+    with pytest.raises(ENRError):
+        Record.decode(rlp.encode([bytes(items[0]), bytes(items[1]),
+                                  b"id", b"v9"]))
+
+    # a redundant secp256k1 pair must match the recovered signer
+    other_pub = secp.privkey_to_pubkey(kp(3)[0])
+    lying = Record.sign(priv, 1, ip="10.0.0.9", tcp=1, udp=2,
+                        extra={b"secp256k1": other_pub})
+    with pytest.raises(ENRError):
+        Record.decode(lying.encode())
+
+    # size cap
+    with pytest.raises(ENRError):
+        Record.sign(priv, 1, extra={b"zz": b"x" * 400})
+
+
+# -- netutil ---------------------------------------------------------------
+
+def test_classify_and_good_endpoint():
+    assert netutil.classify("127.0.0.1") == "loopback"
+    assert netutil.classify("10.1.2.3") == "lan"
+    assert netutil.classify("192.168.0.5") == "lan"
+    assert netutil.classify("169.254.1.1") == "lan"
+    assert netutil.classify("224.0.0.1") == "special"
+    assert netutil.classify("0.0.0.0") == "special"
+    assert netutil.classify("255.255.255.255") == "special"
+    assert netutil.classify("not-an-ip") == "special"
+    assert netutil.classify("8.8.8.8") == "routable"
+    assert netutil.good_endpoint("8.8.8.8", 30303)
+    assert not netutil.good_endpoint("8.8.8.8", 0)
+    assert not netutil.good_endpoint("224.0.0.1", 30303)
+
+
+def test_distinct_net_set_caps_one_subnet():
+    ns = netutil.DistinctNetSet(24, 2)
+    assert ns.add("10.0.0.1") and ns.add("10.0.0.2")
+    assert not ns.add("10.0.0.3")        # /24 full
+    assert ns.add("10.0.1.1")            # different /24 fine
+    ns.remove("10.0.0.1")
+    assert ns.add("10.0.0.3")            # slot freed
+    # loopback exempt: dev clusters stack everything on 127.0.0.1
+    for _ in range(10):
+        assert ns.add("127.0.0.1")
+    assert len(ns) == 3
+
+
+# -- nat -------------------------------------------------------------------
+
+def test_nat_parse_and_resolve():
+    assert nat.resolve("none", "10.0.0.7") == "10.0.0.7"
+    assert nat.resolve("extip:198.51.100.9", "10.0.0.7") == "198.51.100.9"
+    auto = nat.resolve("auto", "10.0.0.7")
+    assert auto and auto != "0.0.0.0"
+    with pytest.raises(nat.NATError):
+        nat.parse("extip:999.1.1.1")
+    with pytest.raises(nat.NATError):
+        nat.parse("upnp")
+    with pytest.raises(nat.NATError):
+        nat.parse("carrier-pigeon")
+
+
+# -- bootnode record path --------------------------------------------------
+
+def _announce(bn, rec):
+    bn.handle(rlp.encode([ENR_ANNOUNCE, rec.encode()]), lambda d: None)
+
+
+def _records(bn):
+    replies = []
+    bn.handle(rlp.encode([GET_RECORDS, b"n0n0n0n0"]), replies.append)
+    item = rlp.decode(replies[0])
+    assert rlp.decode_uint(item[0]) == RECORDS
+    return [Record.decode(bytes(r)) for r in item[2]]
+
+
+def test_bootnode_stores_and_serves_records():
+    now = [100.0]
+    bn = BootnodeService("0.0.0.0", 0, clock=lambda: now[0])
+    priv, _, addr = kp(4)
+    rec = Record.sign(priv, 1, ip="10.0.0.4", tcp=6194, udp=8104)
+    _announce(bn, rec)
+    assert bn.records[addr] == rec
+    # the record feeds the legacy table too so old clients see it
+    assert bn.registry[addr][:4] == ("10.0.0.4", 6194, "10.0.0.4", 8104)
+    assert _records(bn) == [rec]
+
+    # stale seq ignored; higher seq moves the endpoint
+    _announce(bn, Record.sign(priv, 1, ip="10.0.0.99", tcp=1, udp=2))
+    assert bn.records[addr].gossip_endpoint() == ("10.0.0.4", 6194)
+    newer = Record.sign(priv, 2, ip="10.0.0.5", tcp=6195, udp=8105)
+    _announce(bn, newer)
+    assert bn.records[addr] == newer
+    assert bn.registry[addr][0] == "10.0.0.5"
+
+    # expiry evicts records alongside the legacy entries
+    now[0] += ANNOUNCE_TTL_S + 1
+    assert _records(bn) == []
+    assert addr not in bn.records
+
+
+def test_bootnode_rejects_bad_endpoints_and_floods():
+    bn = BootnodeService("0.0.0.0", 0, subnet_limit=2)
+    # special-network endpoint never admitted
+    _announce(bn, Record.sign(kp(5)[0], 1, ip="224.0.0.1", tcp=1, udp=2))
+    assert not bn.records
+    # third identity from one /24 bounced
+    for i, seed in enumerate((6, 7, 8)):
+        _announce(bn, Record.sign(kp(seed)[0], 1, ip=f"10.9.9.{i+1}",
+                                  tcp=1, udp=2))
+    assert len(bn.records) == 2
+
+
+def test_client_learns_and_moves_peers_from_records():
+    seen = []
+    client = DiscoveryClient([], kp(9)[0], "127.0.0.1", 1, "127.0.0.1", 2,
+                             on_peer=lambda a, g, c: seen.append((a, g, c)))
+    priv, _, addr = kp(10)
+    client._on_record(Record.sign(priv, 1, ip="10.0.0.10", tcp=61,
+                                  udp=81).encode())
+    assert seen == [(addr, ("10.0.0.10", 61), ("10.0.0.10", 81))]
+
+    # same record again: no duplicate callback
+    client._on_record(Record.sign(priv, 1, ip="10.0.0.10", tcp=61,
+                                  udp=81).encode())
+    assert len(seen) == 1
+
+    # higher-seq record moves the endpoint and re-fires
+    client._on_record(Record.sign(priv, 5, ip="10.0.0.11", tcp=62,
+                                  udp=82).encode())
+    assert seen[-1] == (addr, ("10.0.0.11", 62), ("10.0.0.11", 82))
+
+    # an unsigned legacy tuple can never move a record-backed peer
+    client._learn(addr, "10.0.0.66", 6, "10.0.0.66", 6, seq=0)
+    assert client.known[addr] == ("10.0.0.11", 62, "10.0.0.11", 82)
+
+    # the client's own announce record is well-formed, with a
+    # wall-clock seq so a restarted node outranks its old records
+    own = Record.decode(client.record.encode())
+    assert own.addr == client.me and own.seq > 1_500_000_000
+
+
+def test_gossip_plane_rehomes_moved_peer():
+    """A re-homed peer's old dial loop must wind down, not redial a
+    dead endpoint forever (net/transports.py remove_peer)."""
+    import asyncio
+
+    from eges_tpu.net.transports import GossipPlane
+
+    async def run():
+        plane = GossipPlane("127.0.0.1", 0, [], lambda d: None)
+        old, new = ("10.0.0.1", 6190), ("10.0.0.2", 6190)
+        plane.add_peer(old)
+        assert old in plane.peers
+        plane.remove_peer(old)
+        plane.add_peer(new)
+        assert plane.peers == [new]
+        # the old dial task observes its eviction and exits; the new
+        # one keeps running (retrying the unreachable address)
+        await asyncio.sleep(0.6)
+        tasks = [t for t in plane._tasks if not t.done()]
+        assert len(tasks) == 1
+        plane._closed = True
+        for t in plane._tasks:
+            t.cancel()
+
+    asyncio.run(run())
