@@ -126,3 +126,30 @@ def decode(data: bytes):
     if end != len(data):
         raise RLPError("trailing bytes")
     return item
+
+
+def peek_first_uint(data: bytes) -> int | None:
+    """First element of an RLP ``[uint, ...]`` frame, WITHOUT decoding
+    the body — the message-code peek the gossip mux runs on every
+    inbound frame (a full :func:`decode` of a megabyte block reply just
+    to route it would double the parse cost of the hot path).  Returns
+    None for anything that isn't a list opening with a small canonical
+    uint."""
+    data = bytes(data)
+    if not data or data[0] < 0xC0:
+        return None
+    pos = 1 if data[0] < 0xF8 else 1 + (data[0] - 0xF7)
+    if pos >= len(data):
+        return None
+    h = data[pos]
+    if h < 0x80:
+        # raw single byte; 0x00 is the non-canonical zero (canonical
+        # zero is the empty string 0x80), mirroring decode_uint
+        return h if h else None
+    if h < 0xB8:
+        v = data[pos + 1 : pos + 1 + (h - 0x80)]
+        if len(v) != h - 0x80 or v[:1] == b"\x00" \
+                or (len(v) == 1 and v[0] < 0x80):
+            return None
+        return int.from_bytes(v, "big")
+    return None

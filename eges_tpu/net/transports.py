@@ -272,6 +272,74 @@ class _FrameAuth:
         return payload
 
 
+class Protocol:
+    """A named message-code space on the gossip plane (the p2p.Protocol
+    / eth ProtocolManager role, ref: p2p/peer.go matchProtocols,
+    eth/protocol.go:38-44 eth/62+63).
+
+    ``versions`` is the full list this endpoint can speak; capability
+    negotiation picks the highest version both ends offer — exactly how
+    eth/62 and eth/63 co-exist in the reference.  ``codes`` is the set
+    of frame codes the protocol owns; the mux refuses codes outside
+    every negotiated protocol and scores the sender (ref: p2p/peer.go
+    handle → DiscProtocolError)."""
+
+    def __init__(self, name: str, versions: tuple[int, ...],
+                 codes: frozenset[int] | set[int], handler):
+        self.name = name
+        self.versions = tuple(sorted(versions))
+        self.codes = frozenset(codes)
+        self.handler = handler
+
+
+CAPS_MAGIC = b"geec-caps\x00"
+
+
+def encode_caps(protocols: list[Protocol]) -> bytes:
+    from eges_tpu.core import rlp
+
+    return CAPS_MAGIC + rlp.encode(
+        [[p.name.encode(), list(p.versions)] for p in protocols])
+
+
+def decode_caps(data: bytes) -> dict[str, tuple[int, ...]]:
+    from eges_tpu.core import rlp
+
+    out: dict[str, tuple[int, ...]] = {}
+    for entry in rlp.decode(data[len(CAPS_MAGIC):]):
+        name = bytes(entry[0]).decode()
+        out[name] = tuple(rlp.decode_uint(bytes(v)) for v in entry[1])
+    return out
+
+
+def shared_caps(mine: list[Protocol],
+                theirs: dict[str, tuple[int, ...]]) -> dict[str, int]:
+    """Highest mutually-offered version per protocol name."""
+    shared: dict[str, int] = {}
+    for p in mine:
+        common = set(p.versions) & set(theirs.get(p.name, ()))
+        if common:
+            shared[p.name] = max(common)
+    return shared
+
+
+class _Session:
+    """Per-connection state: auth layer, negotiated capabilities, and
+    the misbehavior score (ref: p2p/peer.go per-peer protocol state)."""
+
+    __slots__ = ("writer", "auth", "shared", "score", "dropped", "born")
+
+    def __init__(self, writer, auth):
+        self.writer = writer
+        self.auth = auth
+        self.shared: dict[str, int] | None = None  # None until caps
+        #                                            frame (legacy peer:
+        #                                            never arrives)
+        self.score = 0
+        self.dropped = False
+        self.born = time.monotonic()
+
+
 class GossipPlane:
     """Static-peer-list TCP gossip with 4-byte length-prefixed frames.
 
@@ -283,15 +351,28 @@ class GossipPlane:
     tests/local rigs.  ``version=2`` pins a keyed plane to the MAC-only
     generation (mixed-mode upgrades; pair with ``allow_v2_peers`` on
     the v3 side).
+
+    With ``protocols`` set the plane runs the devp2p protocol-mux role:
+    right after the transport handshake each side sends a capability
+    frame listing its protocols' offered versions; frames then route by
+    code to the owning protocol's handler, frames for un-negotiated or
+    unknown codes raise the connection's misbehavior score, and a peer
+    crossing :data:`MISBEHAVIOR_LIMIT` is disconnected (the reference's
+    DiscProtocolError path).  Cap-less legacy peers interop: they are
+    muxed against the full registered code set.
     """
 
     MAX_FRAME = 64 * 1024 * 1024
+    MISBEHAVIOR_LIMIT = 100   # four strikes: protocol violations are
+    #                           either a broken build or an attack, but
+    #                           a one-off corrupt relay shouldn't sever
 
     def __init__(self, bind_ip: str, bind_port: int, peers: list[tuple[str, int]],
                  on_gossip, secret: bytes | None = None,
                  keypair: tuple[bytes, bytes] | None = None,
                  authorize=None, allow_v1_peers: bool = False,
-                 allow_v2_peers: bool = False, version: int = 3):
+                 allow_v2_peers: bool = False, version: int = 3,
+                 protocols: list[Protocol] | None = None):
         self.bind_ip = bind_ip
         self.bind_port = bind_port
         self.peers = [p for p in peers if p != (bind_ip, bind_port)]
@@ -302,11 +383,19 @@ class GossipPlane:
         self.allow_v1_peers = allow_v1_peers  # mixed-mode upgrades only
         self.allow_v2_peers = allow_v2_peers  # accept MAC-only peers
         self.version = version
+        self.protocols = protocols
+        self._code_to_proto: dict[int, Protocol] = {}
+        for p in protocols or []:
+            for c in p.codes:
+                if c in self._code_to_proto:
+                    raise ValueError("code %#x claimed twice" % c)
+                self._code_to_proto[c] = p
         self._server: asyncio.AbstractServer | None = None
-        self._writers: dict[tuple[str, int], tuple] = {}  # peer -> (writer, auth)
+        self._writers: dict[tuple[str, int], _Session] = {}
         self._tasks: list[asyncio.Task] = []
         self._closed = False
         self.auth_failures = 0
+        self.peer_drops = 0       # misbehavior disconnects
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -322,6 +411,10 @@ class GossipPlane:
         if peer in self.peers:
             return
         self.peers.append(peer)
+        # prune finished dial loops so re-homing churn (remove_peer /
+        # add_peer cycles from discovery records) can't grow the task
+        # list without bound over a long-lived node
+        self._tasks = [t for t in self._tasks if not t.done()]
         self._tasks.append(asyncio.create_task(self._dial_loop(peer)))
 
     def remove_peer(self, peer: tuple[str, int]) -> None:
@@ -332,10 +425,10 @@ class GossipPlane:
         if peer not in self.peers:
             return
         self.peers.remove(peer)
-        held = self._writers.pop(peer, None)
-        if held is not None:
+        sess = self._writers.pop(peer, None)
+        if sess is not None:
             try:
-                held[0].close()
+                sess.writer.close()
             except Exception:
                 pass
 
@@ -351,35 +444,87 @@ class GossipPlane:
     def _frame(data: bytes) -> bytes:
         return struct.pack("<I", len(data)) + data
 
-    async def _handshake(self, reader, writer):
-        """Returns a ready _FrameAuth, or None in plaintext mode."""
-        if self.secret is None:
-            return None
-        auth = _FrameAuth(self.secret, keypair=self.keypair,
-                          allow_downgrade=self.allow_v1_peers,
-                          allow_v2=self.allow_v2_peers,
-                          version=self.version)
-        writer.write(self._frame(auth.hello()))
-        await writer.drain()
-        auth.on_hello(await asyncio.wait_for(self._read_frame(reader),
-                                             timeout=5.0))
-        if (auth.peer_addr is not None and self.authorize is not None
-                and not self.authorize(auth.peer_addr)):
-            raise AuthError("peer not authorized")
-        return auth
+    async def _handshake(self, reader, writer) -> _Session:
+        """Transport handshake + capability announcement; returns the
+        connection's session (auth is None in plaintext mode)."""
+        auth = None
+        if self.secret is not None:
+            auth = _FrameAuth(self.secret, keypair=self.keypair,
+                              allow_downgrade=self.allow_v1_peers,
+                              allow_v2=self.allow_v2_peers,
+                              version=self.version)
+            writer.write(self._frame(auth.hello()))
+            await writer.drain()
+            auth.on_hello(await asyncio.wait_for(self._read_frame(reader),
+                                                 timeout=5.0))
+            if (auth.peer_addr is not None and self.authorize is not None
+                    and not self.authorize(auth.peer_addr)):
+                raise AuthError("peer not authorized")
+        sess = _Session(writer, auth)
+        if self.protocols is not None:
+            # first frame each way is the capability list (the devp2p
+            # protocol handshake, ref: p2p/peer.go Hello/matchProtocols)
+            caps = encode_caps(self.protocols)
+            writer.write(self._frame(
+                auth.seal(caps) if auth is not None else caps))
+        return sess
+
+    def _misbehave(self, sess: _Session, points: int) -> None:
+        sess.score += points
+        if sess.score >= self.MISBEHAVIOR_LIMIT and not sess.dropped:
+            sess.dropped = True        # count ONE drop per connection,
+            self.peer_drops += 1       # and stop dispatching its
+            try:                       # already-buffered frames
+                sess.writer.close()
+            except Exception:
+                pass
+
+    def _dispatch(self, sess: _Session, data: bytes) -> None:
+        """Route one opened frame: caps handshake, then per-code mux."""
+        if sess.dropped:
+            return  # connection is being cut; drain without dispatching
+        if data.startswith(CAPS_MAGIC):
+            try:
+                sess.shared = shared_caps(self.protocols or [],
+                                          decode_caps(data))
+            except Exception:
+                self._misbehave(sess, 25)
+            return
+        if self.protocols is None:
+            try:
+                self._on_gossip(data)
+            except Exception:
+                pass
+            return
+        from eges_tpu.core import rlp
+
+        proto = self._code_to_proto.get(rlp.peek_first_uint(data))
+        if proto is None:
+            # a code outside every protocol we registered: out of
+            # contract, score it (ref: p2p/peer.go invalid msg code)
+            self._misbehave(sess, 25)
+            return
+        if sess.shared is not None and proto.name not in sess.shared:
+            # a protocol WE speak but this pair didn't negotiate.  The
+            # sender may legitimately not have our caps yet (its burst
+            # can be in flight before our caps frame crosses), so this
+            # is dropped, never scored — the negotiation race must not
+            # cut honest mixed-version peers.
+            return
+        try:
+            proto.handler(data)
+        except Exception:
+            pass
 
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
         try:
-            auth = await self._handshake(reader, writer)
+            sess = await self._handshake(reader, writer)
             while True:
                 frame = await self._read_frame(reader)
-                if auth is not None:
-                    frame = auth.open(frame)
-                try:
-                    self._on_gossip(frame)
-                except Exception:
-                    pass
+                if sess.auth is not None:
+                    frame = sess.auth.open(frame)
+                self._dispatch(sess, frame)
         except (asyncio.IncompleteReadError, ConnectionError,
                 asyncio.TimeoutError):
             pass
@@ -409,18 +554,41 @@ class GossipPlane:
             try:
                 reader, writer = await asyncio.open_connection(*peer)
                 try:
-                    auth = await self._handshake(reader, writer)
+                    sess = await self._handshake(reader, writer)
                 except AuthError:
                     self.auth_failures += 1
                     rejected = True
                     raise ConnectionError
-                self._writers[peer] = (writer, auth)
+                self._writers[peer] = sess
                 t0 = time.monotonic()
                 try:
-                    # hold the connection; writer errors surface on send
+                    # hold the connection, reading the acceptor's side
+                    # of the stream: its capability frame arrives here
+                    # (writer errors still surface on send).  The
+                    # timeout wraps ONLY the 4-byte header read —
+                    # readexactly is buffer-atomic, so a timed-out
+                    # header consumes nothing, while a timeout spanning
+                    # header+body could cancel between them and
+                    # permanently desync the framing.  Once a header
+                    # is committed the body read runs untimed; a stall
+                    # mid-frame ends via remove_peer/close() closing
+                    # the transport under it.
                     while not writer.is_closing() and not self._closed \
                             and peer in self.peers:
-                        await asyncio.sleep(0.5)
+                        try:
+                            hdr = await asyncio.wait_for(
+                                reader.readexactly(4), timeout=0.5)
+                        except asyncio.TimeoutError:
+                            continue
+                        (n,) = struct.unpack("<I", hdr)
+                        if n > self.MAX_FRAME:
+                            raise AuthError("oversized frame")
+                        frame = await reader.readexactly(n)
+                        if sess.auth is not None:
+                            frame = sess.auth.open(frame)
+                        self._dispatch(sess, frame)
+                except (asyncio.IncompleteReadError, AuthError):
+                    pass  # remote closed or broke framing: reconnect
                 finally:
                     held = time.monotonic() - t0
             except (ConnectionError, OSError, asyncio.TimeoutError):
@@ -435,11 +603,33 @@ class GossipPlane:
                 else backoff)
             backoff = min(backoff * 2, 5.0)
 
+    CAPS_GRACE_S = 1.0  # how long a fresh session may lack the peer's
+    #                     caps frame before we treat it as legacy.  In
+    #                     devp2p no protocol msg flows before the Hello
+    #                     exchange completes; holding broadcasts for
+    #                     this window is the same ordering, and keeps a
+    #                     mixed-version peer from scoring our burst as
+    #                     misbehavior before it could tell us its caps.
+
     def broadcast(self, data: bytes) -> None:
-        for peer, (writer, auth) in list(self._writers.items()):
+        proto = None
+        if self.protocols is not None:
+            from eges_tpu.core import rlp
+
+            proto = self._code_to_proto.get(rlp.peek_first_uint(data))
+        now = time.monotonic()
+        for peer, sess in list(self._writers.items()):
+            if proto is not None and sess.shared is None \
+                    and now - sess.born < self.CAPS_GRACE_S:
+                continue  # caps still in flight; gossip retries cover it
+            if (proto is not None and sess.shared is not None
+                    and proto.name not in sess.shared):
+                continue  # peer never negotiated this protocol — the
+                #           reference sends eth msgs only to eth peers
             try:
-                payload = auth.seal(data) if auth is not None else data
-                writer.write(self._frame(payload))
+                payload = (sess.auth.seal(data)
+                           if sess.auth is not None else data)
+                sess.writer.write(self._frame(payload))
             except Exception:
                 self._writers.pop(peer, None)
 
@@ -447,8 +637,8 @@ class GossipPlane:
         self._closed = True
         for t in self._tasks:
             t.cancel()
-        for w, _ in self._writers.values():
-            w.close()
+        for sess in self._writers.values():
+            sess.writer.close()
         if self._server is not None:
             self._server.close()
 

@@ -179,6 +179,26 @@ class NodeService:
                 allowed.add(raw)
             authorize = (lambda addr: addr in allowed
                          or addr in self.node.membership)
+        # the gossip plane's protocol table (the eth/62+63 capability
+        # split, ref: eth/protocol.go:38-44): consensus control msgs,
+        # chain sync, and txn exchange negotiate independently, so a
+        # future sync-v2 peer still exchanges geec msgs with a sync-v1
+        # one.  All handlers funnel into the node's single-threaded
+        # dispatch — the mux contributes negotiation + misbehavior
+        # scoring, not concurrency.
+        from eges_tpu.consensus import messages as M
+        from eges_tpu.net.transports import Protocol
+        protocols = [
+            Protocol("geec", (1,),
+                     {M.GOSSIP_VALIDATE_REQ, M.GOSSIP_QUERY,
+                      M.GOSSIP_REGISTER_REQ, M.GOSSIP_CONFIRM_BLOCK},
+                     self.node.on_gossip),
+            Protocol("sync", (1,),
+                     {M.GOSSIP_GET_BLOCKS, M.GOSSIP_BLOCKS_REPLY,
+                      M.GOSSIP_GET_HEADERS, M.GOSSIP_HEADERS_REPLY},
+                     self.node.on_gossip),
+            Protocol("txn", (1,), {M.GOSSIP_TXNS}, self.node.on_gossip),
+        ]
         self.gossip = GossipPlane(cfg.gossip_ip, cfg.gossip_port,
                                   list(cfg.peers), self.node.on_gossip,
                                   secret=secret,
@@ -186,7 +206,8 @@ class NodeService:
                                   allow_v1_peers=cfg.allow_v1_peers,
                                   allow_v2_peers=cfg.allow_v2_peers,
                                   version=cfg.gossip_version,
-                                  authorize=authorize)
+                                  authorize=authorize,
+                                  protocols=protocols)
         self.node.transport = SocketTransport(self.gossip, self.direct)
 
         self.discovery = None

@@ -1,0 +1,158 @@
+"""Capability negotiation + protocol mux + misbehavior scoring on the
+gossip plane (ref roles: p2p/peer.go matchProtocols/handle,
+eth/protocol.go eth/62+63 co-existence)."""
+
+import asyncio
+
+import pytest
+
+from eges_tpu.core import rlp
+from eges_tpu.net.transports import (
+    CAPS_MAGIC, GossipPlane, Protocol, decode_caps, encode_caps,
+    shared_caps,
+)
+
+
+# -- code peek -------------------------------------------------------------
+
+def test_peek_first_uint():
+    assert rlp.peek_first_uint(rlp.encode([0x11, b"payload"])) == 0x11
+    assert rlp.peek_first_uint(rlp.encode([0, b"x"])) == 0
+    assert rlp.peek_first_uint(rlp.encode([0x1234, b"x"])) == 0x1234
+    big = rlp.encode([0x15, b"y" * 100_000])
+    assert rlp.peek_first_uint(big) == 0x15
+    # non-lists, non-uint heads, junk
+    assert rlp.peek_first_uint(rlp.encode(b"just bytes")) is None
+    assert rlp.peek_first_uint(b"") is None
+    assert rlp.peek_first_uint(b"\xc2\x00\x01") is None  # leading zero
+    # peek agrees with a full decode on every frame shape we ship
+    for item in ([0x17, [b"a", b"b"]], [199], [0x11, b"", 5]):
+        enc = rlp.encode(item)
+        assert rlp.peek_first_uint(enc) == rlp.decode_uint(
+            bytes(rlp.decode(enc)[0]))
+
+
+# -- capability negotiation ------------------------------------------------
+
+def test_caps_roundtrip_and_shared():
+    protos = [Protocol("geec", (1,), {0x11}, None),
+              Protocol("sync", (1, 2, 3), {0x16}, None)]
+    offered = decode_caps(encode_caps(protos))
+    assert offered == {"geec": (1,), "sync": (1, 2, 3)}
+
+    # highest mutual version wins, name-disjoint protocols drop out
+    theirs = {"sync": (2, 3, 4), "whisper": (9,)}
+    assert shared_caps(protos, theirs) == {"sync": 3}
+    assert shared_caps(protos, {"geec": (2,)}) == {}  # no common version
+
+    with pytest.raises(Exception):
+        decode_caps(CAPS_MAGIC + b"\xf9junk")
+
+
+def test_duplicate_code_claim_rejected():
+    with pytest.raises(ValueError):
+        GossipPlane("127.0.0.1", 0, [], lambda d: None, protocols=[
+            Protocol("a", (1,), {0x11}, None),
+            Protocol("b", (1,), {0x11}, None)])
+
+
+# -- live mux --------------------------------------------------------------
+
+GEEC, TXN, ALIEN = 0x11, 0x17, 0x7F
+
+
+def _plane(port, seen, names):
+    table = {"geec": Protocol("geec", (1,), {GEEC},
+                              lambda d: seen.append(("geec", d))),
+             "txn": Protocol("txn", (1,), {TXN},
+                             lambda d: seen.append(("txn", d)))}
+    return GossipPlane("127.0.0.1", port, [], lambda d: None,
+                       protocols=[table[n] for n in names])
+
+
+async def _wait(cond, timeout=5.0):
+    t0 = asyncio.get_event_loop().time()
+    while not cond():
+        if asyncio.get_event_loop().time() - t0 > timeout:
+            raise AssertionError("condition never held")
+        await asyncio.sleep(0.05)
+
+
+def test_mux_routes_and_filters_by_negotiated_caps():
+    async def run():
+        seen_b = []
+        a = _plane(0, [], ["geec", "txn"])
+        b = _plane(0, seen_b, ["geec"])  # b never offers txn
+        await a.start()
+        await b.start()
+        b_port = b._server.sockets[0].getsockname()[1]
+        a.add_peer(("127.0.0.1", b_port))
+        # dialer learns the acceptor's caps over the same connection
+        await _wait(lambda: any(
+            s.shared is not None for s in a._writers.values()))
+        assert list(a._writers.values())[0].shared == {"geec": 1}
+
+        a.broadcast(rlp.encode([GEEC, b"validate"]))
+        await _wait(lambda: seen_b)
+        assert seen_b[0][0] == "geec"
+
+        # txn frames are never sent to a peer that didn't negotiate txn
+        a.broadcast(rlp.encode([TXN, b"tx"]))
+        a.broadcast(rlp.encode([GEEC, b"again"]))
+        await _wait(lambda: len(seen_b) >= 2)
+        assert [kind for kind, _ in seen_b] == ["geec", "geec"]
+        assert b.peer_drops == 0
+        a.close(), b.close()
+
+    asyncio.run(run())
+
+
+def test_unnegotiated_but_known_protocol_dropped_without_score():
+    """The negotiation race must not cut honest mixed-version peers:
+    frames for a protocol we speak but the pair didn't negotiate are
+    dropped silently, never scored."""
+    async def run():
+        seen = []
+        b = _plane(0, seen, ["geec", "txn"])
+        await b.start()
+        port = b._server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        # offer only geec, then send a txn frame anyway
+        writer.write(GossipPlane._frame(
+            encode_caps([Protocol("geec", (1,), {GEEC}, None)])))
+        writer.write(GossipPlane._frame(rlp.encode([TXN, b"early"])))
+        writer.write(GossipPlane._frame(rlp.encode([GEEC, b"ok"])))
+        await writer.drain()
+        await _wait(lambda: seen)
+        assert seen == [("geec", rlp.encode([GEEC, b"ok"]))]
+        assert b.peer_drops == 0
+        writer.close()
+        b.close()
+
+    asyncio.run(run())
+
+
+def test_misbehaving_peer_scored_and_dropped():
+    async def run():
+        seen = []
+        b = _plane(0, seen, ["geec", "txn"])
+        await b.start()
+        port = b._server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+
+        # legacy cap-less peer: a registered code is still delivered
+        writer.write(GossipPlane._frame(rlp.encode([GEEC, b"legacy"])))
+        await writer.drain()
+        await _wait(lambda: seen)
+
+        # four out-of-contract frames cross MISBEHAVIOR_LIMIT -> cut
+        for _ in range(4):
+            writer.write(GossipPlane._frame(rlp.encode([ALIEN, b"?"])))
+        await writer.drain()
+        assert await asyncio.wait_for(reader.read(), 5.0) is not None \
+            or True  # EOF (or caps frame then EOF) — either way closed
+        await _wait(lambda: b.peer_drops == 1)
+        assert len(seen) == 1
+        b.close()
+
+    asyncio.run(run())

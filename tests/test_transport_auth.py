@@ -141,8 +141,8 @@ def test_gossip_plane_encrypts_end_to_end():
         assert got_a == [b"enc-from-b"]
         for plane in (a, b):
             assert plane._writers, "dial connection missing"
-            for _w, auth in plane._writers.values():
-                assert auth is not None and auth.encrypts
+            for sess in plane._writers.values():
+                assert sess.auth is not None and sess.auth.encrypts
         a.close()
         b.close()
 
