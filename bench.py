@@ -125,7 +125,11 @@ def _child(deadline: float, max_batch: int) -> None:
             lats.append(time.monotonic() - t1)
             n_iters += 1
             el = time.monotonic() - t0
-            if (n_iters >= 6 and el > 2.0) or n_iters >= 200 \
+            # a graph that takes seconds per call is measured well
+            # enough by 3 calls; don't burn the big-batch budget on
+            # statistical overkill
+            min_iters = 3 if lats[0] > 5.0 else 6
+            if (n_iters >= min_iters and el > 2.0) or n_iters >= 200 \
                     or el > min(30.0, max(left() - 15, 2.0)):
                 break
         dt = time.monotonic() - t0
@@ -139,8 +143,12 @@ def _child(deadline: float, max_batch: int) -> None:
         if batch == 1024 and left() > 20:
             # p50/p99 at the BASELINE.md 1k-validator operating point;
             # per-iteration deadline check so the loop degrades to
-            # fewer samples instead of dying with none
-            for i in range(24):
+            # fewer samples instead of dying with none.  On a graph
+            # that takes seconds per call the timing loop above already
+            # sampled enough — extra iterations would eat the budget
+            # the 4096/16384 stages need.
+            extra = 0 if lats[0] > 2.0 else 24
+            for i in range(extra):
                 if left() < 10:
                     break
                 a = jnp.asarray(np.roll(sigs, i + 10, axis=0))
@@ -155,10 +163,15 @@ def _child(deadline: float, max_batch: int) -> None:
                                            int(len(lats) * 0.99))] * 1e3, 3)
             emit(res)
 
-        if res["per_sec"] < 500:
-            # clearly a CPU-class backend (the fallback child): larger
-            # batches change nothing about the number and each one costs
-            # a fresh compile — don't gamble the remaining budget
+        if res["per_sec"] < 500 and "CPU" in device.upper():
+            # CPU-class fallback backend: larger batches change nothing
+            # about the number and each one costs a fresh compile —
+            # don't gamble the remaining budget.  A slow REAL device is
+            # the opposite case: the graph's op count is batch-
+            # independent, so per-op dispatch overhead dominates small
+            # batches and throughput grows ~linearly with rows — the
+            # big buckets are exactly where its number lives
+            # (measured r4: 20/s at 256 on TPU v5e, op-bound).
             break
 
 

@@ -303,10 +303,29 @@ class Mod:
 
     def inv_batched(self, a: jnp.ndarray) -> jnp.ndarray:
         """Shape-polymorphic front door for :meth:`batch_inv`: flattens
-        leading dims; falls back to Fermat for unbatched inputs."""
+        leading dims; falls back to Fermat for unbatched inputs.
+
+        Under the fused-kernel variant (EGES_TPU_PALLAS=ladder, TPU
+        backend) this routes to the streamed pow kernel instead: a
+        direct per-row Fermat inverse costs more field muls than the
+        Montgomery scan trick, but runs as ONE kernel launch where the
+        scan + rolled pow pay thousands of tiny dispatches — and launch
+        overhead, not arithmetic, bounds this backend (BENCH r4)."""
         if a.ndim < 2:
             return self.inv(a)
         flat = a.reshape(-1, NLIMBS)
+        from eges_tpu.ops.pallas_kernels import (
+            ladder_kernels_enabled, pow_mod_pallas,
+        )
+        if ladder_kernels_enabled() and self.m in (P, N):
+            out = pow_mod_pallas(flat, self.m - 2,
+                                 "p" if self.m == P else "n")
+            if self.m == P:
+                # batch_inv canonicalizes; match it bit-for-bit so the
+                # fused variant stays differential-testable against the
+                # graph path (the mod-N kernel is canonical already)
+                out = self.canon(out)
+            return out.reshape(a.shape)
         return self.batch_inv(flat).reshape(a.shape)
 
     def const(self, x: int, like: jnp.ndarray) -> jnp.ndarray:
@@ -492,8 +511,19 @@ class FieldP(Mod):
         return eq(self.canon(a), self.canon(b))
 
     def sqrt(self, a: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-        """Square root via ``a^((P+1)/4)``.  Returns (root, exists_flag)."""
-        r = self.pow_const(a, (P + 1) // 4)
+        """Square root via ``a^((P+1)/4)``.  Returns (root, exists_flag).
+
+        Fused-kernel variant: the rolled 254-bit pow ladder becomes one
+        streamed kernel launch (callers canonicalize the root before
+        consuming its bits, so the two paths' relaxed encodings may
+        differ while the residue — and every downstream bit — agrees)."""
+        from eges_tpu.ops.pallas_kernels import (
+            ladder_kernels_enabled, pow_mod_pallas,
+        )
+        if ladder_kernels_enabled() and a.ndim == 2:
+            r = pow_mod_pallas(a, (P + 1) // 4, "p")
+        else:
+            r = self.pow_const(a, (P + 1) // 4)
         ok = self.eq_mod(self.sqr(r), a)
         return r, ok
 

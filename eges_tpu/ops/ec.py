@@ -346,15 +346,11 @@ def _g_lam_table16() -> tuple[np.ndarray, np.ndarray]:
     return ltx, ty.copy()
 
 
-def strauss_gR(u1: jnp.ndarray, u2: jnp.ndarray, rx: jnp.ndarray, ry: jnp.ndarray):
-    """GLV/Strauss ``u1*G + u2*R``: both scalars split by the lambda
-    endomorphism, then one 33-window ladder over FOUR table operands
-    (±G, ±lam*G, ±R, ±lam*R) — half the doublings of the plain 64-window
-    ladder for the same adds (ref role: libsecp256k1 ecmult with endo).
-
-    R is affine per-row; the lam*R table is the R table with beta-scaled
-    x.  Negative half-scalars negate the looked-up point's y per row.
-    """
+def _strauss_prelude(u1, u2, rx, ry):
+    """Shared front half of the GLV/Strauss ladder: scalar split,
+    window digits, and the four operand tables.  Factored out so the
+    streamed-kernel path, the XLA loop path, and the differential
+    tests all consume identical inputs."""
     # one traced decomposition over both scalars (stacked leading axis —
     # the split subgraph is sizeable and must not appear twice)
     k1s, n1s, k2s, n2s = _glv_decompose(jnp.stack([u1, u2]))
@@ -371,6 +367,38 @@ def strauss_gR(u1: jnp.ndarray, u2: jnp.ndarray, rx: jnp.ndarray, ry: jnp.ndarra
     tlx, tly = jnp.asarray(tlx_np), jnp.asarray(tly_np)
     trx, try_ = _build_affine_table(rx, ry)
     tlrx = FP.mul(trx, FP.const(GLV_BETA, trx))  # beta * x per entry
+    return ((d_g1, d_g2, d_r1, d_r2), (n1g, n2g, n1r, n2r),
+            (tgx, tgy), (tlx, tly), (trx, try_, tlrx))
+
+
+def strauss_gR(u1: jnp.ndarray, u2: jnp.ndarray, rx: jnp.ndarray, ry: jnp.ndarray):
+    """GLV/Strauss ``u1*G + u2*R``: both scalars split by the lambda
+    endomorphism, then one 33-window ladder over FOUR table operands
+    (±G, ±lam*G, ±R, ±lam*R) — half the doublings of the plain 64-window
+    ladder for the same adds (ref role: libsecp256k1 ecmult with endo).
+
+    R is affine per-row; the lam*R table is the R table with beta-scaled
+    x.  Negative half-scalars negate the looked-up point's y per row.
+    """
+    (d_g1, d_g2, d_r1, d_r2), (n1g, n2g, n1r, n2r), \
+        (tgx, tgy), (tlx, tly), (trx, try_, tlrx) = \
+        _strauss_prelude(u1, u2, rx, ry)
+
+    # EGES_TPU_PALLAS=ladder: the ENTIRE 33-window loop runs as one
+    # streamed Mosaic kernel — operands for every window are gathered
+    # and sign-folded here in a handful of vectorized XLA ops, then the
+    # kernel's grid walks the windows with the accumulator resident in
+    # VMEM (ops/pallas_kernels.py strauss_stream).  One kernel launch
+    # per batch; measured r4: launch overhead, not arithmetic, is what
+    # dominates this backend.
+    from eges_tpu.ops.pallas_kernels import (
+        ladder_kernels_enabled, strauss_stream,
+    )
+    if ladder_kernels_enabled() and rx.ndim == 2:
+        opx, opy, nzp = pack_strauss_operands(
+            (d_g1, d_g2, d_r1, d_r2), (n1g, n2g, n1r, n2r),
+            (tgx, tgy), (tlx, tly), (trx, try_, tlrx))
+        return strauss_stream(opx, opy, nzp, rx.shape[0])
 
     acc = infinity(rx)
     negs = jnp.stack([jnp.broadcast_to(n1g, d_g1.shape[:-1]),
@@ -378,23 +406,10 @@ def strauss_gR(u1: jnp.ndarray, u2: jnp.ndarray, rx: jnp.ndarray, ry: jnp.ndarra
                       jnp.broadcast_to(n1r, d_g1.shape[:-1]),
                       jnp.broadcast_to(n2r, d_g1.shape[:-1])])
 
-    # EGES_TPU_PALLAS=ladder: the window step (4 doublings + 4
-    # conditional adds) runs as two fused Mosaic kernels instead of the
-    # XLA subgraphs — same math, VMEM-resident accumulator, and a
-    # compiled graph measured in hundreds of ops instead of tens of
-    # thousands (ops/pallas_kernels.py; TPU backend only)
-    from eges_tpu.ops.pallas_kernels import (
-        ladder_add_mixed, ladder_double4, ladder_kernels_enabled,
-    )
-    use_kernels = ladder_kernels_enabled() and rx.ndim == 2
-
     def body(i, acc):
         j = GLV_WINDOWS - 1 - i
-        if use_kernels:
-            acc = ladder_double4(acc)
-        else:
-            acc = jax.lax.fori_loop(0, WINDOW,
-                                    lambda _, a: jac_double(a), acc)
+        acc = jax.lax.fori_loop(0, WINDOW,
+                                lambda _, a: jac_double(a), acc)
         dj = [jax.lax.dynamic_index_in_dim(d, j, axis=-1, keepdims=False)
               for d in (d_g1, d_g2, d_r1, d_r2)]
         # stacked operands so the conditional mixed add traces ONCE
@@ -409,8 +424,6 @@ def strauss_gR(u1: jnp.ndarray, u2: jnp.ndarray, rx: jnp.ndarray, ry: jnp.ndarra
         nzs = jnp.stack([(d != 0).astype(jnp.uint32) for d in dj])
 
         def add_step(t, a):
-            if use_kernels:
-                return ladder_add_mixed(a, xs[t], ys[t], negs[t], nzs[t])
             y_t = select(negs[t], FP.neg(ys[t]), ys[t])
             added = jac_add_mixed(a, xs[t], y_t)
             return tuple(select(nzs[t], n, o) for n, o in zip(added, a))
@@ -418,6 +431,58 @@ def strauss_gR(u1: jnp.ndarray, u2: jnp.ndarray, rx: jnp.ndarray, ry: jnp.ndarra
         return jax.lax.fori_loop(0, 4, add_step, acc)
 
     return jax.lax.fori_loop(0, GLV_WINDOWS, body, acc)
+
+
+def pack_strauss_operands(digits, negs, g_tab, lam_tab, r_tab):
+    """Gather + sign-fold the four table operands for EVERY window of
+    the Strauss ladder at once (the streamed kernel's diet: XLA does
+    the vectorized lookups it is good at, the kernel does the field
+    arithmetic it is good at).
+
+    Returns ``(opx, opy, nz)`` shaped ``[W, 64, Bpad]`` / ``[W, 64,
+    Bpad]`` / ``[W, 8, Bpad]`` in window-processing order (MSD first):
+    operand ``t``'s limbs live in rows ``16t..16t+15``.
+    """
+    from eges_tpu.ops.pallas_kernels import LANE_BLOCK
+
+    # digits are LSD-first; the ladder consumes MSD-first
+    d_g1, d_g2, d_r1, d_r2 = [d[..., ::-1] for d in digits]
+    n1g, n2g, n1r, n2r = negs
+    tgx, tgy = g_tab
+    tlx, tly = lam_tab
+    trx, try_, tlrx = r_tab
+    B, W = d_g1.shape
+
+    gx, gy = jnp.take(tgx, d_g1, axis=0), jnp.take(tgy, d_g1, axis=0)
+    lx, ly = jnp.take(tlx, d_g2, axis=0), jnp.take(tly, d_g2, axis=0)
+
+    def row_gather(tab, d):
+        # tab [16, B, 16] (entry, row, limb) -> out[b, w, k] = tab[d[b,w], b, k]
+        return jnp.take_along_axis(jnp.moveaxis(tab, 0, 1),
+                                   d[:, :, None], axis=1)
+
+    rxo, ryo = row_gather(trx, d_r1), row_gather(try_, d_r1)
+    lrxo, lryo = row_gather(tlrx, d_r2), row_gather(try_, d_r2)
+
+    xs = [gx, lx, rxo, lrxo]
+    ys = []
+    for y, n in ((gy, n1g), (ly, n2g), (ryo, n1r), (lryo, n2r)):
+        flag = jnp.broadcast_to(n[:, None], (B, W))
+        ys.append(select(flag, FP.neg(y), y))
+
+    def pack(parts):
+        # 4 x [B, W, 16] -> [W, 4*16, Bpad]
+        a = jnp.stack(parts)                      # [4, B, W, 16]
+        a = jnp.transpose(a, (2, 0, 3, 1))        # [W, 4, 16, B]
+        a = a.reshape(W, 4 * NLIMBS, B)
+        pad = (-B) % LANE_BLOCK
+        return jnp.pad(a, ((0, 0), (0, 0), (0, pad)))
+
+    nz = jnp.stack([(d != 0).astype(jnp.uint32)
+                    for d in (d_g1, d_g2, d_r1, d_r2)])   # [4, B, W]
+    nz = jnp.transpose(nz, (2, 0, 1))                     # [W, 4, B]
+    nz = jnp.pad(nz, ((0, 0), (0, 4), (0, (-B) % LANE_BLOCK)))
+    return pack(xs), pack(ys), nz
 
 
 def strauss_gR_plain(u1: jnp.ndarray, u2: jnp.ndarray, rx: jnp.ndarray, ry: jnp.ndarray):

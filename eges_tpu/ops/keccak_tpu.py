@@ -151,6 +151,17 @@ def keccak256_fixed(data: jnp.ndarray) -> jnp.ndarray:
     words = b32.reshape(*batch, nblocks, RATE // 4, 4)
     lanes = (words[..., 0] | (words[..., 1] << 8) | (words[..., 2] << 16)
              | (words[..., 3] << 24))  # [..., nblocks, 34] LE 32-bit words
+    # fused-kernel variant: the single-block case (the ecrecover
+    # address tail) runs the whole permutation as one Mosaic kernel
+    from eges_tpu.ops.pallas_kernels import (
+        keccak_block_pallas, ladder_kernels_enabled,
+    )
+    if nblocks == 1 and len(batch) == 1 and ladder_kernels_enabled():
+        out_words = keccak_block_pallas(lanes[..., 0, :])
+        shifts = jnp.asarray([0, 8, 16, 24], jnp.uint32)
+        out = ((out_words[..., :, None] >> shifts) & 0xFF).astype(jnp.uint8)
+        return out.reshape(*batch, 32)
+
     for blk in range(nblocks):
         w = lanes[..., blk, :]  # [..., 34]
         blo = w[..., 0::2]      # 17 lanes' low words

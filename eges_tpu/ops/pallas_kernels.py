@@ -4,16 +4,21 @@
 The XLA graph form of the verifier (ops/bigint.py, ops/ec.py) already
 keeps everything fused on-device, but it pays twice for being a graph:
 ~66k StableHLO ops (45-85 s compiles) and per-op dispatch granularity.
-These kernels collapse the Strauss ladder's window step — the ~4000
-field multiplies per recovered signature — into TWO hand-tiled Mosaic
-kernels:
+Round-4 measurement on the live chip showed dispatch is the WHOLE
+story on this backend (~40-100 us per executed kernel): the plain
+graph ran 20 verifies/s at 256 rows, and a first 2-kernel-per-window
+variant only 3.5x that.  So these kernels fuse entire LOOPS, not
+steps, each a single ``pallas_call`` whose grid streams per-iteration
+operands while the carried state stays resident in VMEM/output refs:
 
-* ``ladder_double4``: four chained Jacobian doublings (the per-window
-  doubling run) with the accumulator resident in VMEM throughout.
-* ``ladder_add_mixed``: one conditional mixed add — table operand,
-  per-row y-negation (GLV sign), the branchless exceptional cases of
-  ``ec.jac_add_mixed`` (infinity/double/opposite) and the digit!=0
-  select, all fused.
+* ``strauss_stream``: the whole 33-window GLV/Strauss ladder (4
+  doublings + 4 conditional mixed adds per window), operands
+  pre-gathered and sign-folded by XLA in a handful of vectorized ops.
+* ``pow_mod_pallas``: constant-exponent windowed pow (a^e mod P or
+  mod N) — covers FP.sqrt, FP inverse and FN inverse, replacing three
+  rolled 256-bit square-and-multiply ladders.
+* ``keccak_block_pallas``: the single-block Keccak-f[1600] of the
+  address-derivation tail, all 24 rounds in one kernel.
 
 Layout: the graph stores a field element as ``[B, 16]`` u32 limbs (rows
 on sublanes).  Kernels TRANSPOSE to ``[16, B]`` — 16 limbs land exactly
@@ -33,10 +38,10 @@ A/Bs them the moment the tunnel answers.
 
 Dispatch: ``EGES_TPU_PALLAS=1`` keeps the historical per-multiply
 kernel hook in ``FieldP.mul``; ``EGES_TPU_PALLAS=ladder`` routes the
-``strauss_gR`` window step through the fused kernels — on the TPU
-backend only (interpret mode lowers kernels back to per-block HLO,
-which would re-explode the CPU graph the rolled loops were built to
-avoid).
+ladder, the three pow ladders and the keccak tail through the fused
+kernels — on the TPU backend only (interpret mode lowers kernels back
+to per-block HLO, which would re-explode the CPU graph the rolled
+loops were built to avoid).
 
 Ref role: crypto/secp256k1/libsecp256k1/src/ecmult_impl.h (the windowed
 ladder the reference runs in C); consumed by secp256.go:105.
@@ -245,32 +250,6 @@ def _fp_mul_kernel(a_ref, b_ref, out_ref):
     _write16(out_ref, _k_mul(_read16(a_ref), _read16(b_ref)))
 
 
-def _double4_kernel(x_ref, y_ref, z_ref, ox_ref, oy_ref, oz_ref):
-    """Four chained Jacobian doublings — the WINDOW=4 doubling run of a
-    Strauss window step — with the point resident in VMEM throughout."""
-    X, Y, Z = _read16(x_ref), _read16(y_ref), _read16(z_ref)
-    for _ in range(4):
-        X, Y, Z = _k_jac_double(X, Y, Z)
-    _write16(ox_ref, X)
-    _write16(oy_ref, Y)
-    _write16(oz_ref, Z)
-
-
-def _add_mixed_kernel(x_ref, y_ref, z_ref, px_ref, py_ref,
-                      neg_ref, nz_ref, ox_ref, oy_ref, oz_ref):
-    """One fused conditional table add: y-negation by the GLV sign flag,
-    the full branchless mixed add, then the digit!=0 select."""
-    X, Y, Z = _read16(x_ref), _read16(y_ref), _read16(z_ref)
-    px, py = _read16(px_ref), _read16(py_ref)
-    neg = neg_ref[0, :]
-    nz = nz_ref[0, :]
-    py = _k_select(neg, _k_neg(py), py)
-    AX, AY, AZ = _k_jac_add_mixed(X, Y, Z, px, py)
-    _write16(ox_ref, _k_select(nz, AX, X))
-    _write16(oy_ref, _k_select(nz, AY, Y))
-    _write16(oz_ref, _k_select(nz, AZ, Z))
-
-
 # ---------------------------------------------------------------------------
 # wrappers: [B, 16] graph layout <-> [16, B] kernel tiles
 # ---------------------------------------------------------------------------
@@ -314,31 +293,335 @@ def fp_mul_pallas(a: jnp.ndarray, b: jnp.ndarray, *,
     return out.T[:B]
 
 
-def ladder_double4(pt, *, interpret: bool | None = None):
-    """Four doublings of a Jacobian point batch ``(X, Y, Z)`` each
-    ``[B, 16]``; bit-identical to four ``ec.jac_double`` calls."""
-    if interpret is None:
-        # axon is the tunnel's TPU platform — real Mosaic, not interpret
-        interpret = jax.default_backend() not in ("tpu", "axon")
-    B = pt[0].shape[0]
-    ats, _, nb = _as_tiles(list(pt), [], B)
-    out = _pallas(_double4_kernel, ats, [], nb, 3, interpret)
-    return tuple(o.T[:B] for o in out)
+# ---------------------------------------------------------------------------
+# streamed full-ladder kernel: the WHOLE 33-window Strauss loop as ONE
+# pallas_call.  Measured r4 on the live chip: the 2-kernel-per-window
+# variant still paid ~165 kernel launches + interleaved XLA gathers per
+# batch, and launch overhead on this backend is tens of microseconds —
+# the ladder ran at 70.7 verifies/s at 256 rows.  Here the grid's last
+# dimension IS the window loop: per-window operands (already looked up
+# and sign-folded by XLA in one vectorized gather) stream HBM->VMEM via
+# the Pallas pipeline, and the accumulator lives in the output refs
+# across grid steps (the classic matmul-K-loop carry pattern).  One
+# launch per batch, zero interstitial XLA.
+# ---------------------------------------------------------------------------
+
+STRAUSS_OPS = 4  # ±G, ±lam*G, ±R, ±lam*R
 
 
-def ladder_add_mixed(pt, px, py, neg, nz, *,
-                     interpret: bool | None = None):
-    """Fused conditional mixed add: ``pt + (px, ±py)`` where the sign is
-    ``neg`` per row, rows with ``nz == 0`` keep ``pt``.  Bit-identical
-    to the select/neg/``ec.jac_add_mixed`` composition in
-    ``strauss_gR``'s add step."""
+def _strauss_stream_kernel(opx_ref, opy_ref, nz_ref, ox_ref, oy_ref, oz_ref):
+    """Grid ``(batch_blocks, GLV_WINDOWS)``; one step = one window:
+    4 doublings + 4 conditional mixed adds, MSD window first."""
+    w = pl.program_id(1)
+
+    @pl.when(w == 0)
+    def _init():  # accumulator = infinity (Z == 0, Y = 1)
+        zero = jnp.zeros((LANE_BLOCK,), jnp.uint32)
+        one = jnp.ones((LANE_BLOCK,), jnp.uint32)
+        for k in range(NLIMBS):
+            ox_ref[k, :] = zero
+            oy_ref[k, :] = one if k == 0 else zero
+            oz_ref[k, :] = zero
+
+    X, Y, Z = _read16(ox_ref), _read16(oy_ref), _read16(oz_ref)
+    for _ in range(4):
+        X, Y, Z = _k_jac_double(X, Y, Z)
+    for t in range(STRAUSS_OPS):
+        px = [opx_ref[0, 16 * t + k, :] for k in range(NLIMBS)]
+        py = [opy_ref[0, 16 * t + k, :] for k in range(NLIMBS)]
+        nz = nz_ref[0, t, :]
+        AX, AY, AZ = _k_jac_add_mixed(X, Y, Z, px, py)
+        X = _k_select(nz, AX, X)
+        Y = _k_select(nz, AY, Y)
+        Z = _k_select(nz, AZ, Z)
+    _write16(ox_ref, X)
+    _write16(oy_ref, Y)
+    _write16(oz_ref, Z)
+
+
+try:  # pl is needed at module level only for the streaming kernel
+    from jax.experimental import pallas as pl
+except Exception:  # pragma: no cover - pallas always ships with jax
+    pl = None
+
+
+def strauss_stream(opx: jnp.ndarray, opy: jnp.ndarray, nz: jnp.ndarray,
+                   batch: int, *, interpret: bool | None = None):
+    """Run the full ladder over pre-gathered operands.
+
+    ``opx``/``opy``: ``[W, 64, Bpad]`` u32 — x/y limbs of the four
+    table operands per window, window-processing order (MSD first),
+    y already sign-folded.  ``nz``: ``[W, 8, Bpad]`` u32 0/1 (rows 0-3
+    used).  Returns Jacobian ``(X, Y, Z)`` each ``[batch, 16]``.
+    """
     if interpret is None:
-        # axon is the tunnel's TPU platform — real Mosaic, not interpret
         interpret = jax.default_backend() not in ("tpu", "axon")
-    B = pt[0].shape[0]
-    ats, fts, nb = _as_tiles(list(pt) + [px, py], [neg, nz], B)
-    out = _pallas(_add_mixed_kernel, ats, fts, nb, 3, interpret)
-    return tuple(o.T[:B] for o in out)
+    W, _, wide = opx.shape
+    nb = wide // LANE_BLOCK
+    outs = pl.pallas_call(
+        _strauss_stream_kernel,
+        out_shape=tuple(jax.ShapeDtypeStruct((NLIMBS, wide), jnp.uint32)
+                        for _ in range(3)),
+        grid=(nb, W),
+        in_specs=[
+            pl.BlockSpec((1, STRAUSS_OPS * NLIMBS, LANE_BLOCK),
+                         lambda b, w: (w, 0, b)),
+            pl.BlockSpec((1, STRAUSS_OPS * NLIMBS, LANE_BLOCK),
+                         lambda b, w: (w, 0, b)),
+            pl.BlockSpec((1, 8, LANE_BLOCK), lambda b, w: (w, 0, b)),
+        ],
+        out_specs=tuple(
+            pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda b, w: (0, b))
+            for _ in range(3)),
+        interpret=interpret,
+    )(opx, opy, nz)
+    return tuple(o.T[:batch] for o in outs)
+
+
+def strauss_stream_np(opx: np.ndarray, opy: np.ndarray, nz: np.ndarray):
+    """Numpy twin of the streaming kernel's math (same uint32 wrap
+    semantics), for differential tests on hosts without a TPU."""
+    W, _, wide = opx.shape
+    X = [np.zeros(wide, np.uint32) for _ in range(NLIMBS)]
+    Y = [np.zeros(wide, np.uint32) for _ in range(NLIMBS)]
+    Y[0] = np.ones(wide, np.uint32)
+    Z = [np.zeros(wide, np.uint32) for _ in range(NLIMBS)]
+    for w in range(W):
+        for _ in range(4):
+            X, Y, Z = _k_jac_double(X, Y, Z, np)
+        for t in range(STRAUSS_OPS):
+            px = [opx[w, 16 * t + k, :] for k in range(NLIMBS)]
+            py = [opy[w, 16 * t + k, :] for k in range(NLIMBS)]
+            f = nz[w, t, :]
+            AX, AY, AZ = _k_jac_add_mixed(X, Y, Z, px, py, np)
+            X = _k_select(f, AX, X, np)
+            Y = _k_select(f, AY, Y, np)
+            Z = _k_select(f, AZ, Z, np)
+    return X, Y, Z
+
+
+# ---------------------------------------------------------------------------
+# streamed windowed-pow kernel: a^e for a constant exponent, one launch.
+# Covers the three remaining launch-heavy loops of the recover graph —
+# FP.sqrt (e = (P+1)/4), FP inverse (P-2) and FN inverse (N-2): each is
+# a 256-bit square-and-multiply that the XLA path runs as a rolled
+# fori_loop of tiny ops (~2k launches per pow on this backend).  Here
+# the grid's last dim walks 64 4-bit windows; the per-row power table
+# a^0..a^15 (a^0 = 1, so digit 0 needs no conditional) is built once
+# per batch block into VMEM scratch at w == 0, and the window digit —
+# a compile-time constant — arrives as a tiny one-hot block shared by
+# every batch block.
+# ---------------------------------------------------------------------------
+
+POW_WINDOWS = 64
+
+
+def _make_pow_kernel(mul_fn):
+    def kernel(sel_ref, a_ref, o_ref, tab_ref):
+        w = pl.program_id(1)
+
+        @pl.when(w == 0)
+        def _init():
+            A = _read16(a_ref)
+            one0 = jnp.ones_like(A[0])
+            zero = jnp.zeros_like(A[0])
+            for k in range(NLIMBS):
+                tab_ref[k, :] = one0 if k == 0 else zero        # a^0 = 1
+                tab_ref[NLIMBS + k, :] = A[k]                   # a^1
+                o_ref[k, :] = one0 if k == 0 else zero          # acc = 1
+            cur = A
+            for e in range(2, 16):
+                cur = mul_fn(cur, A)
+                for k in range(NLIMBS):
+                    tab_ref[NLIMBS * e + k, :] = cur[k]
+
+        acc = _read16(o_ref)
+        for _ in range(4):
+            acc = mul_fn(acc, acc)
+        sel = [sel_ref[0, e, :] for e in range(16)]
+        op = []
+        for k in range(NLIMBS):
+            s = sel[0] * tab_ref[k, :]
+            for e in range(1, 16):
+                s = s + sel[e] * tab_ref[NLIMBS * e + k, :]
+            op.append(s)
+        acc = mul_fn(acc, op)
+        _write16(o_ref, acc)
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=2)
+def _pow_kernel_for(modulus: str):
+    # lazy: _k_fn_mul is defined in the order-N section below
+    return _make_pow_kernel(_k_mul if modulus == "p" else _k_fn_mul)
+
+
+@functools.lru_cache(maxsize=None)
+def _pow_onehot(e: int) -> np.ndarray:
+    """[64, 16, LANE_BLOCK] u32 one-hot of e's 4-bit digits, MSD first."""
+    sel = np.zeros((POW_WINDOWS, 16, LANE_BLOCK), np.uint32)
+    for w in range(POW_WINDOWS):
+        d = (e >> (4 * (POW_WINDOWS - 1 - w))) & 0xF
+        sel[w, d, :] = 1
+    return sel
+
+
+def pow_mod_pallas(a: jnp.ndarray, e: int, modulus: str, *,
+                   interpret: bool | None = None) -> jnp.ndarray:
+    """``[B, 16] -> [B, 16]``: per-row ``a^e`` mod P (relaxed) or mod N
+    (canonical), matching ``FieldP.pow_const`` / ``OrderN.pow_const``
+    outputs up to the field's representation contract."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    assert e.bit_length() <= 4 * POW_WINDOWS
+    B = a.shape[0]
+    pad = (-B) % LANE_BLOCK
+    at = jnp.pad(a, ((0, pad), (0, 0))).T
+    wide = at.shape[1]
+    sel = jnp.asarray(_pow_onehot(e))
+    out = pl.pallas_call(
+        _pow_kernel_for(modulus),
+        out_shape=jax.ShapeDtypeStruct((NLIMBS, wide), jnp.uint32),
+        grid=(wide // LANE_BLOCK, POW_WINDOWS),
+        in_specs=[
+            pl.BlockSpec((1, 16, LANE_BLOCK), lambda b, w: (w, 0, 0)),
+            pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda b, w: (0, b)),
+        ],
+        out_specs=pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda b, w: (0, b)),
+        scratch_shapes=[pltpu.VMEM((16 * NLIMBS, LANE_BLOCK), jnp.uint32)],
+        interpret=interpret,
+    )(sel, at)
+    return out.T[:B]
+
+
+def pow_mod_np(a: np.ndarray, e: int, modulus: str) -> np.ndarray:
+    """Numpy twin of the pow kernel's math for differential tests."""
+    mul = _k_mul if modulus == "p" else _k_fn_mul
+    A = [a[:, k].copy() for k in range(NLIMBS)]
+    one0 = np.ones_like(A[0])
+    zero = np.zeros_like(A[0])
+    tab = [[one0 if k == 0 else zero for k in range(NLIMBS)], A]
+    cur = A
+    for _ in range(2, 16):
+        cur = mul(cur, A, np)
+        tab.append(cur)
+    acc = [one0 if k == 0 else zero for k in range(NLIMBS)]
+    for w in range(POW_WINDOWS):
+        d = (e >> (4 * (POW_WINDOWS - 1 - w))) & 0xF
+        for _ in range(4):
+            acc = mul(acc, acc, np)
+        acc = mul(acc, tab[d], np)
+    return np.stack(acc, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# keccak-f[1600] kernel: the address-derivation tail of ecrecover
+# (keccak256(x||y)[12:]).  The XLA form is already a rolled 24-round
+# fori_loop (~1.5k executed ops per batch, ops/keccak_tpu.py); once the
+# ladder and pow loops are fused that tail becomes a visible share of
+# the launch bill, so the single-block permutation gets a kernel too.
+# In-kernel the 25x2 u32 state is a Python list of [B]-vectors: every
+# theta/rho/pi/chi index is a compile-time constant, so there are no
+# gathers at all — just vector xor/and/shift.  Rounds unroll at trace
+# time (24 x ~150 vector ops: well inside Mosaic's comfort zone).
+# ---------------------------------------------------------------------------
+
+_KECCAK_RC = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+_KECCAK_ROT = [[0, 36, 3, 41, 18], [1, 44, 10, 45, 2],
+               [62, 6, 43, 15, 61], [28, 55, 25, 21, 56],
+               [27, 20, 39, 8, 14]]  # [x][y], lane l = x + 5y
+
+
+def _k_rot64(lo, hi, r: int, xp=jnp):
+    r %= 64
+    if r == 0:
+        return lo, hi
+    if r >= 32:
+        lo, hi = hi, lo
+        r -= 32
+        if r == 0:
+            return lo, hi
+    rs, inv = xp.uint32(r), xp.uint32(32 - r)
+    return ((lo << rs) | (hi >> inv)), ((hi << rs) | (lo >> inv))
+
+
+def _k_keccak_words(w, xp=jnp):
+    """34 LE u32 words (one padded 136-byte block) -> 8 digest words.
+    State lanes as (lo, hi) u32 pairs, all indices constant."""
+    zero = xp.zeros_like(w[0])
+    lo = [w[2 * l] for l in range(17)] + [zero] * 8
+    hi = [w[2 * l + 1] for l in range(17)] + [zero] * 8
+    for rnd in range(24):
+        # theta
+        clo = [lo[x] ^ lo[x + 5] ^ lo[x + 10] ^ lo[x + 15] ^ lo[x + 20]
+               for x in range(5)]
+        chi_ = [hi[x] ^ hi[x + 5] ^ hi[x + 10] ^ hi[x + 15] ^ hi[x + 20]
+                for x in range(5)]
+        for x in range(5):
+            rl, rh = _k_rot64(clo[(x + 1) % 5], chi_[(x + 1) % 5], 1, xp)
+            dlo, dhi = clo[(x + 4) % 5] ^ rl, chi_[(x + 4) % 5] ^ rh
+            for y in range(5):
+                lo[x + 5 * y] = lo[x + 5 * y] ^ dlo
+                hi[x + 5 * y] = hi[x + 5 * y] ^ dhi
+        # rho + pi
+        blo, bhi = [None] * 25, [None] * 25
+        for x in range(5):
+            for y in range(5):
+                dl = y + 5 * ((2 * x + 3 * y) % 5)
+                blo[dl], bhi[dl] = _k_rot64(lo[x + 5 * y], hi[x + 5 * y],
+                                            _KECCAK_ROT[x][y], xp)
+        # chi
+        for y in range(5):
+            row_l = [blo[x + 5 * y] for x in range(5)]
+            row_h = [bhi[x + 5 * y] for x in range(5)]
+            for x in range(5):
+                lo[x + 5 * y] = row_l[x] ^ (~row_l[(x + 1) % 5]
+                                            & row_l[(x + 2) % 5])
+                hi[x + 5 * y] = row_h[x] ^ (~row_h[(x + 1) % 5]
+                                            & row_h[(x + 2) % 5])
+        # iota
+        lo[0] = lo[0] ^ xp.uint32(_KECCAK_RC[rnd] & 0xFFFFFFFF)
+        hi[0] = hi[0] ^ xp.uint32(_KECCAK_RC[rnd] >> 32)
+    return [lo[0], hi[0], lo[1], hi[1], lo[2], hi[2], lo[3], hi[3]]
+
+
+def _keccak_kernel(w_ref, o_ref):
+    out = _k_keccak_words([w_ref[k, :] for k in range(34)])
+    for k in range(8):
+        o_ref[k, :] = out[k]
+
+
+def keccak_block_pallas(words: jnp.ndarray, *,
+                        interpret: bool | None = None) -> jnp.ndarray:
+    """``[B, 34]`` LE u32 words of one padded block -> ``[B, 8]``
+    digest words (matches keccak_tpu's squeeze order)."""
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    B = words.shape[0]
+    pad = (-B) % LANE_BLOCK
+    wt = jnp.pad(words, ((0, pad), (0, 0))).T  # [34, wide]
+    wide = wt.shape[1]
+    out = pl.pallas_call(
+        _keccak_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, wide), jnp.uint32),
+        grid=(wide // LANE_BLOCK,),
+        in_specs=[pl.BlockSpec((34, LANE_BLOCK), lambda b: (0, b))],
+        out_specs=pl.BlockSpec((8, LANE_BLOCK), lambda b: (0, b)),
+        interpret=interpret,
+    )(wt)
+    return out.T[:B]
 
 
 # ---------------------------------------------------------------------------

@@ -166,30 +166,11 @@ def test_ladder_kernels_on_tpu(monkeypatch):
     strauss_gR wiring (digit indexing, neg/nz rows, the kernel-path
     dispatch), which is what the watcher treats this test as proving."""
     from eges_tpu.ops import pallas_kernels as pk
-    from eges_tpu.ops.bigint import FN, select
-    from eges_tpu.ops.ec import jac_add_mixed, jac_double, strauss_gR
-    from eges_tpu.ops.pallas_kernels import (
-        fn_mul_pallas, ladder_add_mixed, ladder_double4,
-    )
+    from eges_tpu.ops.bigint import FN
+    from eges_tpu.ops.ec import strauss_gR
+    from eges_tpu.ops.pallas_kernels import fn_mul_pallas
 
     n = 9
-    pt = _rand_point_batch(n)
-    want = pt
-    for _ in range(4):
-        want = jac_double(want)
-    got = ladder_double4(pt)
-    for g, w in zip(got, want):
-        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
-
-    px, py = _affine_batch(n)
-    neg = jnp.asarray([1, 0, 1, 0, 1, 0, 1, 0, 1], jnp.uint32)
-    nz = jnp.asarray([1, 1, 0, 1, 1, 1, 1, 0, 1], jnp.uint32)
-    y_t = select(neg, FP.neg(py), py)
-    added = jac_add_mixed(pt, px, y_t)
-    want = tuple(select(nz, a, o) for a, o in zip(added, pt))
-    got = ladder_add_mixed(pt, px, py, neg, nz)
-    for g, w in zip(got, want):
-        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
 
     # mod-N kernel on hardware
     from eges_tpu.ops.bigint import N
@@ -200,18 +181,127 @@ def test_ladder_kernels_on_tpu(monkeypatch):
     np.testing.assert_array_equal(np.asarray(fn_mul_pallas(ka, kb)),
                                   np.asarray(FN.mul(ka, kb)))
 
+    # pow kernels on hardware: same residues as the rolled ladders
+    # (canonical compare for F_P, bit compare for canonical mod-N)
+    from eges_tpu.ops.pallas_kernels import pow_mod_pallas
+
+    fa = jnp.asarray(np.stack([int_to_limbs(rng.randrange(P))
+                               for _ in range(n)]))
+    np.testing.assert_array_equal(
+        np.asarray(FP.canon(pow_mod_pallas(fa, P - 2, "p"))),
+        np.asarray(FP.canon(FP.pow_const(fa, P - 2))))
+    np.testing.assert_array_equal(
+        np.asarray(pow_mod_pallas(ka, N - 2, "n")),
+        np.asarray(FN.pow_const(ka, N - 2)))
+
+    # keccak kernel on hardware vs the host golden
+    from eges_tpu.crypto.keccak import keccak256
+    from eges_tpu.ops.keccak_tpu import RATE
+    from eges_tpu.ops.pallas_kernels import keccak_block_pallas
+
+    msgs = [bytes(range(64)), rng.randbytes(64), b"\xff" * 64]
+    words = np.zeros((len(msgs), 34), np.uint32)
+    for i, m in enumerate(msgs):
+        buf = bytearray(RATE)
+        buf[: len(m)] = m
+        buf[len(m)] ^= 0x01
+        buf[RATE - 1] ^= 0x80
+        words[i] = np.frombuffer(bytes(buf), "<u4")
+    dig = np.asarray(keccak_block_pallas(jnp.asarray(words))) \
+        .astype("<u4").view(np.uint8).reshape(len(msgs), 32)
+    for i, m in enumerate(msgs):
+        assert bytes(dig[i]) == keccak256(m)
+
     # full strauss_gR through the kernel dispatch vs the graph path:
-    # the two must be BIT-identical (the kernels mirror the graph ops)
+    # the two must be BIT-identical (the kernels mirror the graph ops,
+    # and the fused inversions canonicalize to match batch_inv)
     rx, ry = _affine_batch(4)
     u1 = jnp.asarray(np.stack([int_to_limbs(rng.randrange(N))
                                for _ in range(4)]))
     u2 = jnp.asarray(np.stack([int_to_limbs(rng.randrange(N))
                                for _ in range(4)]))
-    base = strauss_gR(u1, u2, rx, ry)
+    # jit each variant (fresh wrappers: tracing happens under the
+    # patched flag) — eager per-op dispatch over the tunnel would take
+    # longer than the compiles
+    base = jax.jit(strauss_gR)(u1, u2, rx, ry)
     monkeypatch.setattr(pk, "ladder_kernels_enabled", lambda: True)
-    kern = strauss_gR(u1, u2, rx, ry)
+    kern = jax.jit(strauss_gR)(u1, u2, rx, ry)
     for g, w in zip(kern, base):
         np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_strauss_stream_math_matches_graph_path():
+    """The streamed full-ladder kernel's math + operand packing must be
+    bit-identical to the XLA strauss_gR loop: the numpy twin of the
+    kernel body consumes exactly what pack_strauss_operands feeds the
+    real kernel (window order, sign folds, nz rows, lane padding)."""
+    from eges_tpu.ops import ec
+    from eges_tpu.ops.bigint import N
+    from eges_tpu.ops.pallas_kernels import strauss_stream_np
+
+    n = 4
+    rx, ry = _affine_batch(n)
+    u1_l = [0, 1, rng.randrange(N), rng.randrange(N)]  # incl. zero scalar
+    u2_l = [rng.randrange(N), 0, 1, rng.randrange(N)]
+    u1 = jnp.asarray(np.stack([int_to_limbs(v) for v in u1_l]))
+    u2 = jnp.asarray(np.stack([int_to_limbs(v) for v in u2_l]))
+
+    prelude = ec._strauss_prelude(u1, u2, rx, ry)
+    opx, opy, nz = ec.pack_strauss_operands(*prelude)
+    got = strauss_stream_np(np.asarray(opx), np.asarray(opy),
+                            np.asarray(nz))
+    want = ec.strauss_gR(u1, u2, rx, ry)  # plain XLA path
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(_untq(g)[:n], np.asarray(w))
+
+
+def test_pow_kernel_math_matches_graph():
+    """The windowed-pow kernel math (numpy twin) computes the same
+    residues as the rolled pow_const ladders: relaxed encodings may
+    differ for F_P (different algorithm), canonical mod-N is bit-equal."""
+    from eges_tpu.ops.bigint import FN, N
+    from eges_tpu.ops.pallas_kernels import pow_mod_np
+
+    vals = [0, 1, 2, P - 1, P, rng.randrange(P), rng.randrange(P)]
+    a = np.stack([int_to_limbs(v) for v in vals]).astype(np.uint32)
+
+    for e in (P - 2, (P + 1) // 4):
+        got = pow_mod_np(a, e, "p")
+        for v, row in zip(vals, got):
+            assert limbs_to_int(row) % P == pow(v % P, e, P)
+
+    kvals = [0, 1, N - 1, rng.randrange(N), rng.randrange(N)]
+    k = np.stack([int_to_limbs(v) for v in kvals]).astype(np.uint32)
+    got = pow_mod_np(k, N - 2, "n")
+    want = np.asarray(FN.pow_const(jnp.asarray(k), N - 2))
+    np.testing.assert_array_equal(got, want)  # canonical: bit-equal
+    for v, row in zip(kvals, got):
+        assert limbs_to_int(row) == pow(v, N - 2, N)
+
+
+def test_keccak_kernel_math_matches_golden():
+    """The in-kernel keccak permutation (numpy twin) must reproduce the
+    host golden keccak256 for single-block messages of both ecrecover-
+    relevant lengths (64-byte pubkey, 32-byte scalar)."""
+    from eges_tpu.crypto.keccak import keccak256
+    from eges_tpu.ops.keccak_tpu import RATE
+    from eges_tpu.ops.pallas_kernels import _k_keccak_words
+
+    msgs = [bytes(range(64)), b"\x00" * 64, b"\xff" * 64,
+            rng.randbytes(64), rng.randbytes(32), b""]
+    B = len(msgs)
+    words = np.zeros((B, 34), np.uint32)
+    for i, m in enumerate(msgs):
+        buf = bytearray(RATE)
+        buf[: len(m)] = m
+        buf[len(m)] ^= 0x01
+        buf[RATE - 1] ^= 0x80
+        words[i] = np.frombuffer(bytes(buf), "<u4")
+    out = _k_keccak_words([words[:, k].copy() for k in range(34)], np)
+    digests = np.stack(out, axis=-1).astype("<u4").view(np.uint8) \
+        .reshape(B, 32)
+    for i, m in enumerate(msgs):
+        assert bytes(digests[i]) == keccak256(m), f"msg {i}"
 
 
 def test_k_fn_mul_matches_graph_path():
