@@ -251,15 +251,23 @@ def _g_table16() -> tuple[np.ndarray, np.ndarray]:
 def _build_point_table(px: jnp.ndarray, py: jnp.ndarray):
     """Per-row variable-base table ``d * P`` for d in 0..15, Jacobian,
     stacked ``[16, B..., 16]`` (15 mixed adds via one `lax.scan` so the
-    add body compiles once, not 14 times)."""
+    add body compiles once, not 14 times).  Fused-kernel variant: the
+    scan (the last multi-thousand-launch loop on that path) runs as one
+    streamed kernel (pallas_kernels.point_table_pallas)."""
     inf = infinity(px)
     one = (px, py, _const(1, px))
 
-    def step(cur, _):
-        nxt = jac_add_mixed(cur, px, py)
-        return nxt, nxt
+    from eges_tpu.ops.pallas_kernels import (
+        ladder_kernels_enabled, point_table_pallas,
+    )
+    if ladder_kernels_enabled() and px.ndim == 2:
+        rest = point_table_pallas(px, py)
+    else:
+        def step(cur, _):
+            nxt = jac_add_mixed(cur, px, py)
+            return nxt, nxt
 
-    _, rest = jax.lax.scan(step, one, None, length=14)
+        _, rest = jax.lax.scan(step, one, None, length=14)
     tx = jnp.concatenate([jnp.stack([inf[0], one[0]]), rest[0]])
     ty = jnp.concatenate([jnp.stack([inf[1], one[1]]), rest[1]])
     tz = jnp.concatenate([jnp.stack([inf[2], one[2]]), rest[2]])

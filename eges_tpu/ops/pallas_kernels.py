@@ -55,6 +55,7 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
 
 from eges_tpu.ops.bigint import MASK, NLIMBS, P, int_to_limbs
 
@@ -263,8 +264,6 @@ def _as_tiles(arrs, flags, B):
 
 
 def _pallas(kernel, ats, fts, n_blocks, n_out, interpret):
-    from jax.experimental import pallas as pl
-
     wide = ats[0].shape[1]
     specs = ([pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i))] * len(ats)
              + [pl.BlockSpec((1, LANE_BLOCK), lambda i: (0, i))] * len(fts))
@@ -337,12 +336,6 @@ def _strauss_stream_kernel(opx_ref, opy_ref, nz_ref, ox_ref, oy_ref, oz_ref):
     _write16(ox_ref, X)
     _write16(oy_ref, Y)
     _write16(oz_ref, Z)
-
-
-try:  # pl is needed at module level only for the streaming kernel
-    from jax.experimental import pallas as pl
-except Exception:  # pragma: no cover - pallas always ships with jax
-    pl = None
 
 
 def strauss_stream(opx: jnp.ndarray, opy: jnp.ndarray, nz: jnp.ndarray,
@@ -518,6 +511,88 @@ def pow_mod_np(a: np.ndarray, e: int, modulus: str) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# table-build kernel: entries 2..15 of the per-row variable-base window
+# table (d*R).  The graph form is a lax.scan of 14 mixed adds — the
+# last multi-thousand-launch loop on the fused path.  Grid walks the
+# entries; the running point lives in VMEM scratch and each step's
+# result lands in that entry's output block.
+# ---------------------------------------------------------------------------
+
+def _table_kernel(px_ref, py_ref, ox_ref, oy_ref, oz_ref, cur_ref):
+    d = pl.program_id(1)
+    px, py = _read16(px_ref), _read16(py_ref)
+
+    @pl.when(d == 0)
+    def _init():  # cur = 1*R (affine lifted to Jacobian)
+        one0 = jnp.ones((LANE_BLOCK,), jnp.uint32)
+        zero = jnp.zeros((LANE_BLOCK,), jnp.uint32)
+        for k in range(NLIMBS):
+            cur_ref[k, :] = px[k]
+            cur_ref[NLIMBS + k, :] = py[k]
+            cur_ref[2 * NLIMBS + k, :] = one0 if k == 0 else zero
+
+    X = [cur_ref[k, :] for k in range(NLIMBS)]
+    Y = [cur_ref[NLIMBS + k, :] for k in range(NLIMBS)]
+    Z = [cur_ref[2 * NLIMBS + k, :] for k in range(NLIMBS)]
+    X, Y, Z = _k_jac_add_mixed(X, Y, Z, px, py)
+    for k in range(NLIMBS):
+        cur_ref[k, :] = X[k]
+        cur_ref[NLIMBS + k, :] = Y[k]
+        cur_ref[2 * NLIMBS + k, :] = Z[k]
+    _write16(ox_ref, X)
+    _write16(oy_ref, Y)
+    _write16(oz_ref, Z)
+
+
+def point_table_pallas(px: jnp.ndarray, py: jnp.ndarray, *,
+                       interpret: bool | None = None):
+    """``[B, 16]`` affine R -> Jacobian entries ``d*R`` for d in 2..15,
+    each ``[14, B, 16]`` (X, Y, Z); bit-identical to the lax.scan of
+    ``ec.jac_add_mixed`` in ``_build_point_table``."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    B = px.shape[0]
+    pad = (-B) % LANE_BLOCK
+    pxt = jnp.pad(px, ((0, pad), (0, 0))).T
+    pyt = jnp.pad(py, ((0, pad), (0, 0))).T
+    wide = pxt.shape[1]
+    outs = pl.pallas_call(
+        _table_kernel,
+        out_shape=tuple(jax.ShapeDtypeStruct((14 * NLIMBS, wide),
+                                             jnp.uint32) for _ in range(3)),
+        grid=(wide // LANE_BLOCK, 14),
+        in_specs=[pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda b, d: (0, b)),
+                  pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda b, d: (0, b))],
+        out_specs=tuple(
+            pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda b, d: (d, b))
+            for _ in range(3)),
+        scratch_shapes=[pltpu.VMEM((3 * NLIMBS, LANE_BLOCK), jnp.uint32)],
+        interpret=interpret,
+    )(pxt, pyt)
+    # [14*16, wide] -> [14, B, 16]
+    return tuple(o.reshape(14, NLIMBS, wide).transpose(0, 2, 1)[:, :B]
+                 for o in outs)
+
+
+def point_table_np(px: np.ndarray, py: np.ndarray):
+    """Numpy twin of the table kernel."""
+    B = px.shape[0]
+    pxl = [px[:, k].copy() for k in range(NLIMBS)]
+    pyl = [py[:, k].copy() for k in range(NLIMBS)]
+    X, Y = list(pxl), list(pyl)
+    Z = [np.ones(B, np.uint32) if k == 0 else np.zeros(B, np.uint32)
+         for k in range(NLIMBS)]
+    outs = []
+    for _ in range(14):
+        X, Y, Z = _k_jac_add_mixed(X, Y, Z, pxl, pyl, np)
+        outs.append((np.stack(X, -1), np.stack(Y, -1), np.stack(Z, -1)))
+    return (np.stack([o[0] for o in outs]), np.stack([o[1] for o in outs]),
+            np.stack([o[2] for o in outs]))
+
+
+# ---------------------------------------------------------------------------
 # keccak-f[1600] kernel: the address-derivation tail of ecrecover
 # (keccak256(x||y)[12:]).  The XLA form is already a rolled 24-round
 # fori_loop (~1.5k executed ops per batch, ops/keccak_tpu.py); once the
@@ -636,9 +711,11 @@ def pallas_enabled() -> bool:
 
 @functools.lru_cache(maxsize=1)
 def ladder_kernels_enabled() -> bool:
-    """``EGES_TPU_PALLAS=ladder`` fuses the Strauss window step into the
-    double4/add kernels — TPU backend only (interpret mode would lower
-    each kernel back to per-block HLO and re-explode the CPU graph)."""
+    """``EGES_TPU_PALLAS=ladder`` routes the recover pipeline's hot
+    loops through the fused streamed kernels (strauss_stream, the pow
+    ladders, the R-table build, the keccak tail) — TPU backend only
+    (interpret mode would lower each kernel back to per-block HLO and
+    re-explode the CPU graph)."""
     return (os.environ.get("EGES_TPU_PALLAS", "") == "ladder"
             and jax.default_backend() in ("tpu", "axon"))
 

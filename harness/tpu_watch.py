@@ -221,10 +221,19 @@ def main() -> None:
         # the variant legs fall back to the plain graph)
         for b in (256, 1024):
             warm(b, "ladder")
-        # once the hardware A/B proved the fused Pallas ladder faster
-        # (r4: 70.7/s vs 20.1/s at 256), it becomes the main leg
+        # the fused Pallas variant becomes the main leg only once the
+        # hardware A/B proved it actually BEAT the plain graph (the
+        # artifact records the verdict) — a losing or regressed ladder
+        # must not stop the plain graph from being measured
         ab_path = os.path.join(_DIR, "ladder_ab.json")
-        main_variant = "ladder" if os.path.exists(ab_path) else ""
+        main_variant = ""
+        if os.path.exists(ab_path):
+            try:
+                with open(ab_path) as f:
+                    if json.load(f).get("beat_plain"):
+                        main_variant = "ladder"
+            except Exception:
+                pass
         res = bench(main_variant)
         if res is None and main_variant:
             main_variant = ""      # ladder leg produced nothing: the
@@ -267,6 +276,8 @@ def main() -> None:
                     lres = bench("ladder")
                     if lres is not None:
                         lres["variant"] = "pallas-ladder"
+                        lres["beat_plain"] = (
+                            lres.get("value", 0) > res.get("value", 0))
                         with open(ab_path, "w") as f:
                             json.dump(lres, f, indent=1)
                         _log(f"LADDER A/B: {json.dumps(lres)}")

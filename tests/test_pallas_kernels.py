@@ -145,7 +145,8 @@ def test_k_jac_add_mixed_matches_graph_path():
     want = tuple(select(jnp.asarray(nz), a, o)
                  for a, o in zip(added, pt))
 
-    # in-kernel math, numpy namespace (what _add_mixed_kernel runs)
+    # in-kernel math, numpy namespace (the conditional-add step the
+    # streamed ladder kernel runs per window operand)
     X, Y, Z = _t(pt[0]), _t(pt[1]), _t(pt[2])
     pxl, pyl = _t(px), _t(py)
     pyl = _k_select(neg, _k_neg(pyl, xp=np), pyl, xp=np)
@@ -253,6 +254,28 @@ def test_strauss_stream_math_matches_graph_path():
     want = ec.strauss_gR(u1, u2, rx, ry)  # plain XLA path
     for g, w in zip(got, want):
         np.testing.assert_array_equal(_untq(g)[:n], np.asarray(w))
+
+
+def test_point_table_math_matches_graph_path():
+    """The table kernel's numpy twin is bit-identical to the lax.scan
+    of mixed adds in ec._build_point_table (entries 2..15)."""
+    import jax.lax
+
+    from eges_tpu.ops.ec import jac_add_mixed, _const
+    from eges_tpu.ops.pallas_kernels import point_table_np
+
+    n = 5
+    px, py = _affine_batch(n)
+    one = (px, py, _const(1, px))
+
+    def step(cur, _):
+        nxt = jac_add_mixed(cur, px, py)
+        return nxt, nxt
+
+    _, want = jax.lax.scan(step, one, None, length=14)
+    got = point_table_np(np.asarray(px), np.asarray(py))
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, np.asarray(w))
 
 
 def test_pow_kernel_math_matches_graph():
