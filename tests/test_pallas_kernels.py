@@ -223,7 +223,10 @@ def test_ladder_kernels_on_tpu(monkeypatch):
                                for _ in range(4)]))
     # jit each variant (fresh wrappers: tracing happens under the
     # patched flag) — eager per-op dispatch over the tunnel would take
-    # longer than the compiles
+    # longer than the compiles.  The kernels are DEFAULT ON for tpu
+    # backends now, so the plain-graph leg must force them OFF or the
+    # comparison is kernels-vs-themselves.
+    monkeypatch.setattr(pk, "ladder_kernels_enabled", lambda: False)
     base = jax.jit(strauss_gR)(u1, u2, rx, ry)
     monkeypatch.setattr(pk, "ladder_kernels_enabled", lambda: True)
     kern = jax.jit(strauss_gR)(u1, u2, rx, ry)
