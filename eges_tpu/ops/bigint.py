@@ -468,8 +468,23 @@ class FieldP(Mod):
 
     # -- relaxed ops ------------------------------------------------------
 
+    @staticmethod
+    def _glue(*arrs) -> bool:
+        """Route this call site through its one-launch Pallas glue
+        kernel?  True on the fused-kernel variant (TPU backends) for
+        batched same-shape 16-limb operands — the round-4 census showed
+        the XLA forms of these ops execute as ~3.8k separate dispatches
+        per recover on hardware (harness/hlo_census.py)."""
+        from eges_tpu.ops.pallas_kernels import ladder_kernels_enabled
+        if not ladder_kernels_enabled():
+            return False
+        first = arrs[0]
+        return all(getattr(a, "ndim", 0) >= 2 and a.shape == first.shape
+                   and a.shape[-1] == NLIMBS for a in arrs)
+
     def mul(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-        if self._use_pallas and a.ndim >= 2 and a.shape == b.shape:
+        if (self._use_pallas or self._glue(a, b)) \
+                and a.ndim >= 2 and a.shape == b.shape:
             from eges_tpu.ops.pallas_kernels import fp_mul_pallas
             flat = fp_mul_pallas(a.reshape(-1, NLIMBS),
                                  b.reshape(-1, NLIMBS))
@@ -480,20 +495,35 @@ class FieldP(Mod):
         return self.mul(a, a)
 
     def add(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        if self._glue(a, b):
+            from eges_tpu.ops.pallas_kernels import fp_add_pallas
+            return fp_add_pallas(a.reshape(-1, NLIMBS),
+                                 b.reshape(-1, NLIMBS)).reshape(a.shape)
         return self._reduce_cols(a + b)  # cols < 2^17
 
     def sub(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
         """Branchless: a + (0xFFFF - b) + C where C = 2^256 - 2*delta + 1,
         so the column value is a - b + 2P >= 0 — no borrow chain."""
+        if self._glue(a, b):
+            from eges_tpu.ops.pallas_kernels import fp_sub_pallas
+            return fp_sub_pallas(a.reshape(-1, NLIMBS),
+                                 b.reshape(-1, NLIMBS)).reshape(a.shape)
         comp = jnp.uint32(MASK) - b
         subc = jnp.broadcast_to(jnp.asarray(self._subc_np), a.shape)
         return self._reduce_cols(a + comp + subc)  # cols < 3*2^16
 
     def neg(self, a: jnp.ndarray) -> jnp.ndarray:
+        if self._glue(a):
+            from eges_tpu.ops.pallas_kernels import fp_neg_pallas
+            return fp_neg_pallas(a.reshape(-1, NLIMBS)).reshape(a.shape)
         return self.sub(jnp.zeros_like(a), a)
 
     def mul_small(self, a: jnp.ndarray, k: int) -> jnp.ndarray:
         assert k < 16
+        if self._glue(a):
+            from eges_tpu.ops.pallas_kernels import fp_mul_small_pallas
+            return fp_mul_small_pallas(
+                a.reshape(-1, NLIMBS), k).reshape(a.shape)
         return self._reduce_cols(a * jnp.uint32(k))  # cols < 2^20
 
     # -- canonicalization ------------------------------------------------
@@ -501,6 +531,9 @@ class FieldP(Mod):
     def canon(self, a: jnp.ndarray) -> jnp.ndarray:
         """Relaxed [0, 2^256) -> canonical [0, P): one conditional
         subtract (2^256 - P < P, so one is always enough)."""
+        if self._glue(a):
+            from eges_tpu.ops.pallas_kernels import fp_canon_pallas
+            return fp_canon_pallas(a.reshape(-1, NLIMBS)).reshape(a.shape)
         return self._cond_sub_m(a)
 
     def is_zero_mod(self, a: jnp.ndarray) -> jnp.ndarray:
@@ -553,7 +586,28 @@ class OrderN(Mod):
     def sqr(self, a: jnp.ndarray) -> jnp.ndarray:
         return self.mul(a, a)
 
+    def sub(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        if FieldP._glue(a, b):
+            from eges_tpu.ops.pallas_kernels import fn_sub_pallas
+            return fn_sub_pallas(a.reshape(-1, NLIMBS),
+                                 b.reshape(-1, NLIMBS)).reshape(a.shape)
+        return super().sub(a, b)
+
+    def neg(self, a: jnp.ndarray) -> jnp.ndarray:
+        if FieldP._glue(a):
+            from eges_tpu.ops.pallas_kernels import fn_neg_pallas
+            return fn_neg_pallas(a.reshape(-1, NLIMBS)).reshape(a.shape)
+        return super().neg(a)
+
     def red(self, wide: jnp.ndarray) -> jnp.ndarray:
+        # the 17-limb reduction (z mod N, px mod N) as one glue launch
+        from eges_tpu.ops.pallas_kernels import ladder_kernels_enabled
+        if (ladder_kernels_enabled() and getattr(wide, "ndim", 0) >= 2
+                and wide.shape[-1] == NLIMBS + 1):
+            from eges_tpu.ops.pallas_kernels import fn_red17_pallas
+            return fn_red17_pallas(
+                wide.reshape(-1, NLIMBS + 1)).reshape(*wide.shape[:-1],
+                                                      NLIMBS)
         # carried limbs are valid (small) columns — same fast reducer
         return self._red_cols(wide)
 

@@ -311,10 +311,21 @@ def _glv_decompose(k: jnp.ndarray):
     flag; magnitudes stay far below N, so negativity of the mod-N
     residue is detected by size (anything above 2^140 must be N-small).
     """
-    g1 = jnp.broadcast_to(jnp.asarray(int_to_limbs(_G_G1, 16)), k.shape)
-    g2 = jnp.broadcast_to(jnp.asarray(int_to_limbs(_G_G2, 16)), k.shape)
-    c1 = bigint.big_mul(k, g1)[..., 24:32]  # >> 384, fits 8 limbs
-    c2 = bigint.big_mul(k, g2)[..., 24:32]
+    from eges_tpu.ops.pallas_kernels import (
+        ladder_kernels_enabled, mulhi8_pallas,
+    )
+    if ladder_kernels_enabled() and k.ndim >= 2:
+        # fused variant: (k * g) >> 384 as ONE launch per constant (the
+        # 512-bit schoolbook product alone executed as ~600 dispatches)
+        c1 = mulhi8_pallas(k.reshape(-1, NLIMBS),
+                           _G_G1).reshape(*k.shape[:-1], 8)
+        c2 = mulhi8_pallas(k.reshape(-1, NLIMBS),
+                           _G_G2).reshape(*k.shape[:-1], 8)
+    else:
+        g1 = jnp.broadcast_to(jnp.asarray(int_to_limbs(_G_G1, 16)), k.shape)
+        g2 = jnp.broadcast_to(jnp.asarray(int_to_limbs(_G_G2, 16)), k.shape)
+        c1 = bigint.big_mul(k, g1)[..., 24:32]  # >> 384, fits 8 limbs
+        c2 = bigint.big_mul(k, g2)[..., 24:32]
     pad = [(0, 0)] * (k.ndim - 1) + [(0, 8)]
     c1 = jnp.pad(c1, pad)
     c2 = jnp.pad(c2, pad)

@@ -19,6 +19,13 @@ operands while the carried state stays resident in VMEM/output refs:
   rolled 256-bit square-and-multiply ladders.
 * ``keccak_block_pallas``: the single-block Keccak-f[1600] of the
   address-derivation tail, all 24 rounds in one kernel.
+* the GLUE kernels (``fp_add/sub/neg/mul_small/canon``, ``fn_sub/neg/
+  red17``, ``mulhi8``): after the loops were fused, the recover graph
+  STILL executed as ~3.8k XLA fusions of prelude/GLV/pack/finish
+  arithmetic (harness/hlo_census.py), each its own dispatch — 97% of
+  batch wall time.  Routing every remaining field-op call site through
+  a one-launch kernel took the chip from 826.8 to 33.5k verifies/s at
+  4096 rows (54.0k/s at 16384) in the round-4 A/B.
 
 Layout: the graph stores a field element as ``[B, 16]`` u32 limbs (rows
 on sublanes).  Kernels TRANSPOSE to ``[16, B]`` — 16 limbs land exactly
@@ -255,41 +262,16 @@ def _fp_mul_kernel(a_ref, b_ref, out_ref):
 # wrappers: [B, 16] graph layout <-> [16, B] kernel tiles
 # ---------------------------------------------------------------------------
 
-def _as_tiles(arrs, flags, B):
-    pad = (-B) % LANE_BLOCK
-    ats = [jnp.pad(a, ((0, pad), (0, 0))).T for a in arrs]
-    fts = [jnp.pad(f.astype(jnp.uint32), (0, pad)).reshape(1, -1)
-           for f in flags]
-    return ats, fts, ats[0].shape[1] // LANE_BLOCK
-
-
-def _pallas(kernel, ats, fts, n_blocks, n_out, interpret):
-    wide = ats[0].shape[1]
-    specs = ([pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i))] * len(ats)
-             + [pl.BlockSpec((1, LANE_BLOCK), lambda i: (0, i))] * len(fts))
-    return pl.pallas_call(
-        kernel,
-        out_shape=tuple(jax.ShapeDtypeStruct((NLIMBS, wide), jnp.uint32)
-                        for _ in range(n_out)),
-        grid=(n_blocks,),
-        in_specs=specs,
-        out_specs=tuple(pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i))
-                        for _ in range(n_out)),
-        interpret=interpret,
-    )(*ats, *fts)
+def _default_interpret() -> bool:
+    # axon is the tunnel's TPU platform — real Mosaic, not interpret
+    return jax.default_backend() not in ("tpu", "axon")
 
 
 def fp_mul_pallas(a: jnp.ndarray, b: jnp.ndarray, *,
                   interpret: bool | None = None) -> jnp.ndarray:
     """``[B, 16] x [B, 16] -> [B, 16]`` F_P multiply via the Pallas
     kernel; bit-identical to ``bigint.FP.mul`` (relaxed outputs)."""
-    if interpret is None:
-        # axon is the tunnel's TPU platform — real Mosaic, not interpret
-        interpret = jax.default_backend() not in ("tpu", "axon")
-    B = a.shape[0]
-    ats, _, nb = _as_tiles([a, b], [], B)
-    out, = _pallas(_fp_mul_kernel, ats, [], nb, 1, interpret)
-    return out.T[:B]
+    return _ew(_fp_mul_kernel, [a, b], interpret=interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -348,7 +330,7 @@ def strauss_stream(opx: jnp.ndarray, opy: jnp.ndarray, nz: jnp.ndarray,
     used).  Returns Jacobian ``(X, Y, Z)`` each ``[batch, 16]``.
     """
     if interpret is None:
-        interpret = jax.default_backend() not in ("tpu", "axon")
+        interpret = _default_interpret()
     W, _, wide = opx.shape
     nb = wide // LANE_BLOCK
     outs = pl.pallas_call(
@@ -468,7 +450,7 @@ def pow_mod_pallas(a: jnp.ndarray, e: int, modulus: str, *,
     from jax.experimental.pallas import tpu as pltpu
 
     if interpret is None:
-        interpret = jax.default_backend() not in ("tpu", "axon")
+        interpret = _default_interpret()
     assert e.bit_length() <= 4 * POW_WINDOWS
     B = a.shape[0]
     pad = (-B) % LANE_BLOCK
@@ -552,7 +534,7 @@ def point_table_pallas(px: jnp.ndarray, py: jnp.ndarray, *,
     from jax.experimental.pallas import tpu as pltpu
 
     if interpret is None:
-        interpret = jax.default_backend() not in ("tpu", "axon")
+        interpret = _default_interpret()
     B = px.shape[0]
     pad = (-B) % LANE_BLOCK
     pxt = jnp.pad(px, ((0, pad), (0, 0))).T
@@ -683,7 +665,7 @@ def keccak_block_pallas(words: jnp.ndarray, *,
     """``[B, 34]`` LE u32 words of one padded block -> ``[B, 8]``
     digest words (matches keccak_tpu's squeeze order)."""
     if interpret is None:
-        interpret = jax.default_backend() not in ("tpu", "axon")
+        interpret = _default_interpret()
     B = words.shape[0]
     pad = (-B) % LANE_BLOCK
     wt = jnp.pad(words, ((0, pad), (0, 0))).T  # [34, wide]
@@ -788,23 +770,65 @@ def _k_mul_cols_vv(a, b, xp=jnp):
     return cols
 
 
-def _k_cond_sub_n(a, xp=jnp):
-    """One conditional subtract of N (borrow chain + select)."""
+def _k_cond_sub(a, m_limbs, xp=jnp):
+    """One conditional subtract of the constant ``m_limbs`` (borrow
+    chain + select); shared by the mod-N and mod-P variants."""
     mask = xp.uint32(MASK)
     out = []
     borrow = xp.zeros_like(a[0])
     for k in range(16):
-        t = a[k] + xp.uint32(1 << 16) - xp.uint32(_N_LIMBS_C[k]) - borrow
+        t = a[k] + xp.uint32(1 << 16) - xp.uint32(m_limbs[k]) - borrow
         out.append(t & mask)
         borrow = xp.uint32(1) - (t >> 16)
     return _k_select(borrow, a, out, xp)
+
+
+def _k_cond_sub_n(a, xp=jnp):
+    return _k_cond_sub(a, _N_LIMBS_C, xp)
 
 
 def _k_fn_mul(a, b, xp=jnp):
     """Canonical mod-N product; mirrors ``OrderN.mul`` fold-for-fold
     (three delta folds 32 -> 26 -> 20 -> 16+eps, then two top-limb
     folds and two conditional subtracts)."""
-    cols = _k_mul_cols_vv(a, b, xp)
+    return _k_fn_red_cols(_k_mul_cols_vv(a, b, xp), xp)
+
+
+def _fn_mul_kernel(a_ref, b_ref, out_ref):
+    """One [16, LANE_BLOCK] tile: out = a * b mod N (canonical)."""
+    _write16(out_ref, _k_fn_mul(_read16(a_ref), _read16(b_ref)))
+
+
+def fn_mul_pallas(a: jnp.ndarray, b: jnp.ndarray, *,
+                  interpret: bool | None = None) -> jnp.ndarray:
+    """``[B, 16] x [B, 16] -> [B, 16]`` mod-N multiply via the Pallas
+    kernel; bit-identical to ``bigint.FN.mul``."""
+    return _ew(_fn_mul_kernel, [a, b], interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# glue kernels: every remaining field op of the recover pipeline.
+#
+# Round-4 on-chip census (harness/hlo_census.py): with the LOOPS fused,
+# the recover graph still executed as ~3.8k XLA fusions — carry chains
+# of the scalar prelude, the GLV split, the y-recovery, the table
+# normalization and the affine tail — and on this backend each fusion
+# is its own ~0.1 ms dispatch, so the glue cost ~25x the kernels' own
+# arithmetic (65 ms of kernel time inside a 1.9 s batch at 1024 rows).
+# Each helper below turns one field-op call site into ONE launch; the
+# in-kernel math reuses the ``_k_*`` library above bit-for-bit, so the
+# fused and plain paths stay differential-testable against each other.
+# ---------------------------------------------------------------------------
+
+def _k_cond_sub_p(a, xp=jnp):
+    """In-kernel twin of ``Mod._cond_sub_m`` for the field prime."""
+    return _k_cond_sub(a, _P_LIMBS, xp)
+
+
+def _k_fn_red_cols(cols, xp=jnp):
+    """Small (< 2^31) columns, any width in (16, 32] -> canonical mod-N
+    value; the reduction tail of ``_k_fn_mul`` (mirrors
+    ``OrderN._red_cols`` fold-for-fold)."""
     while len(cols) > 16:
         lo = cols[:16]
         hi = _k_carry(cols[16:], len(cols) - 16 + 1, xp)
@@ -821,24 +845,157 @@ def _k_fn_mul(a, b, xp=jnp):
         zero = xp.zeros_like(top)
         fold = fold + [zero] * (16 - len(fold))
         a17 = _k_carry([x + y for x, y in zip(a17[:16], fold)], 17, xp)
-    out = a17[:16]
-    out = _k_cond_sub_n(out, xp)
+    out = _k_cond_sub_n(a17[:16], xp)
     return _k_cond_sub_n(out, xp)
 
 
-def _fn_mul_kernel(a_ref, b_ref, out_ref):
-    """One [16, LANE_BLOCK] tile: out = a * b mod N (canonical)."""
-    _write16(out_ref, _k_fn_mul(_read16(a_ref), _read16(b_ref)))
+# C with cols_k = a_k + (MASK - b_k) + C_k giving a - b + (2^256 - 1) + C
+# ≡ a - b + 2N (mod N): borrow-free per-limb subtraction mod N.
+_FN_SUBC = (2 * _ORDER_N) - (1 << 256) + 1
+_FN_SUBC_LIMBS = [int(v) for v in int_to_limbs(_FN_SUBC)]
 
 
-def fn_mul_pallas(a: jnp.ndarray, b: jnp.ndarray, *,
-                  interpret: bool | None = None) -> jnp.ndarray:
-    """``[B, 16] x [B, 16] -> [B, 16]`` mod-N multiply via the Pallas
-    kernel; bit-identical to ``bigint.FN.mul``."""
+def _k_fn_sub(a, b, xp=jnp):
+    """Canonical a - b mod N (both canonical)."""
+    mask = xp.uint32(MASK)
+    cols = [x + (mask - y) + xp.uint32(_FN_SUBC_LIMBS[k])
+            for k, (x, y) in enumerate(zip(a, b))]
+    return _k_fn_red_cols(cols, xp)
+
+
+def _k_is_zero(a, xp=jnp):
+    z = a[0] == 0
+    for k in range(1, 16):
+        z = z & (a[k] == 0)
+    return z.astype(xp.uint32)
+
+
+def _k_fn_neg(a, xp=jnp):
+    """Canonical -a mod N (0 -> 0)."""
+    out = _k_fn_sub([xp.zeros_like(v) for v in a], a, xp)
+    return _k_select(_k_is_zero(a, xp), [xp.zeros_like(v) for v in a],
+                     out, xp)
+
+
+# glue kernel bodies (each one [rows, LANE_BLOCK] tile set)
+
+def _fp_add_kernel(a_ref, b_ref, o_ref):
+    _write16(o_ref, _k_add(_read16(a_ref), _read16(b_ref)))
+
+
+def _fp_sub_kernel(a_ref, b_ref, o_ref):
+    _write16(o_ref, _k_sub(_read16(a_ref), _read16(b_ref)))
+
+
+def _fp_neg_kernel(a_ref, o_ref):
+    _write16(o_ref, _k_neg(_read16(a_ref)))
+
+
+def _fp_canon_kernel(a_ref, o_ref):
+    _write16(o_ref, _k_cond_sub_p(_read16(a_ref)))
+
+
+def _fn_sub_kernel(a_ref, b_ref, o_ref):
+    _write16(o_ref, _k_fn_sub(_read16(a_ref), _read16(b_ref)))
+
+
+def _fn_neg_kernel(a_ref, o_ref):
+    _write16(o_ref, _k_fn_neg(_read16(a_ref)))
+
+
+def _fn_red17_kernel(a_ref, o_ref):
+    cols = [a_ref[k, :] for k in range(17)]
+    _write16(o_ref, _k_fn_red_cols(cols))
+
+
+@functools.lru_cache(maxsize=4)
+def _mulhi8_kernel_for(g: int):
+    """Kernel: high limbs 24..31 of a 16-limb value times the 16-limb
+    constant ``g`` (the GLV rounding step ``(k * g) >> 384``)."""
+    g_limbs = [int(v) for v in int_to_limbs(g)]
+
+    def kernel(a_ref, o_ref):
+        cols = _k_mul_cols(_read16(a_ref), g_limbs)
+        limbs = _k_carry(cols, 32)
+        for k in range(8):
+            o_ref[k, :] = limbs[24 + k]
+
+    return kernel
+
+
+def _rows_call(kernel, arrs, in_rows, out_rows, interpret):
+    """Shared launch plumbing for the glue kernels: each operand is a
+    ``[rows_i, B]`` array tiled over LANE_BLOCK batch columns."""
+    wide = arrs[0].shape[-1]
+    nb = wide // LANE_BLOCK
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=tuple(jax.ShapeDtypeStruct((r, wide), jnp.uint32)
+                        for r in out_rows),
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((r, LANE_BLOCK), lambda i: (0, i))
+                  for r in in_rows],
+        out_specs=tuple(pl.BlockSpec((r, LANE_BLOCK), lambda i: (0, i))
+                        for r in out_rows),
+        interpret=interpret,
+    )(*arrs)
+    return outs
+
+
+def _ew(kernel, ins, out_limbs=NLIMBS, *, interpret=None):
+    """Elementwise-style glue launch: ``ins`` are ``[B, rows_i]`` limb
+    arrays (same B), output ``[B, out_limbs]``."""
     if interpret is None:
-        # axon is the tunnel's TPU platform — real Mosaic, not interpret
-        interpret = jax.default_backend() not in ("tpu", "axon")
-    B = a.shape[0]
-    ats, _, nb = _as_tiles([a, b], [], B)
-    out, = _pallas(_fn_mul_kernel, ats, [], nb, 1, interpret)
+        interpret = _default_interpret()
+    B = ins[0].shape[0]
+    pad = (-B) % LANE_BLOCK
+    ats = [jnp.pad(a, ((0, pad), (0, 0))).T for a in ins]
+    out, = _rows_call(kernel, ats, [a.shape[1] for a in ins],
+                      [out_limbs], interpret)
     return out.T[:B]
+
+
+def fp_add_pallas(a, b, **kw):
+    return _ew(_fp_add_kernel, [a, b], **kw)
+
+
+def fp_sub_pallas(a, b, **kw):
+    return _ew(_fp_sub_kernel, [a, b], **kw)
+
+
+def fp_neg_pallas(a, **kw):
+    return _ew(_fp_neg_kernel, [a], **kw)
+
+
+def fp_canon_pallas(a, **kw):
+    return _ew(_fp_canon_kernel, [a], **kw)
+
+
+def fn_sub_pallas(a, b, **kw):
+    return _ew(_fn_sub_kernel, [a, b], **kw)
+
+
+def fn_neg_pallas(a, **kw):
+    return _ew(_fn_neg_kernel, [a], **kw)
+
+
+def fn_red17_pallas(a, **kw):
+    """``[B, 17]`` small-column value -> canonical mod-N ``[B, 16]``."""
+    return _ew(_fn_red17_kernel, [a], **kw)
+
+
+def mulhi8_pallas(a, g: int, **kw):
+    """``[B, 16] -> [B, 8]``: limbs 24..31 of ``a * g`` for constant g."""
+    return _ew(_mulhi8_kernel_for(g), [a], out_limbs=8, **kw)
+
+
+@functools.lru_cache(maxsize=8)
+def _mul_small_kernel_for(k: int):
+    def kernel(a_ref, o_ref):
+        _write16(o_ref, _k_mul_small(_read16(a_ref), k))
+
+    return kernel
+
+
+def fp_mul_small_pallas(a, k: int, **kw):
+    return _ew(_mul_small_kernel_for(k), [a], **kw)

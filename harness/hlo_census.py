@@ -1,0 +1,83 @@
+"""Dispatch census of the compiled recover graph on the live backend.
+
+On the tunnel backend each executed HLO op is its own dispatch
+(measured ~40-100 us), so wall time ~= executed-op count.  This
+compiles ecrecover_batch at a given batch (warm persistent cache),
+prints the optimized-HLO entry instruction count, and itemizes every
+while loop (trip count x body size) and the biggest computations --
+the itemized bill for the ~1.9 s of XLA glue around the fused kernels.
+"""
+
+import collections
+import re
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+from eges_tpu.crypto.verifier import ecrecover_batch
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+
+sigs = jnp.zeros((B, 65), jnp.uint8)
+hashes = jnp.zeros((B, 32), jnp.uint8)
+
+t0 = time.time()
+comp = jax.jit(ecrecover_batch).lower(sigs, hashes).compile()
+print(f"compile {time.time()-t0:.1f}s on {jax.devices()[0]}", flush=True)
+
+txt = comp.as_text()
+with open(f"/tmp/recover_hlo_{B}.txt", "w") as f:
+    f.write(txt)
+print("HLO bytes:", len(txt), flush=True)
+
+# parse computations
+comps = {}  # name -> list of instruction lines
+cur = None
+for line in txt.splitlines():
+    m = re.match(r"^(%?[\w\.\-]+)\s.*{$", line.strip()) if line and not line.startswith(" ") else None
+    if line and not line.startswith(" ") and "{" in line:
+        m2 = re.search(r"^(ENTRY\s+)?%?([\w\.\-]+)", line.strip())
+        cur = m2.group(2) if m2 else None
+        comps[cur] = []
+        if line.strip().startswith("ENTRY"):
+            entry = cur
+        continue
+    if cur is not None and line.strip().startswith("%") or (cur and re.match(r"\s+(ROOT\s+)?[\w\.\-%]+\s*=", line)):
+        comps[cur].append(line.strip())
+
+entry_ops = comps.get(entry, [])
+print(f"entry computation: {len(entry_ops)} instructions", flush=True)
+
+opc = collections.Counter()
+for ln in entry_ops:
+    m = re.search(r"=\s*[\w\[\],\{\}\s]*?\s([a-z][\w\-]*)\(", ln)
+    if m:
+        opc[m.group(1)] += 1
+print("entry opcode histogram (top 20):")
+for k, v in opc.most_common(20):
+    print(f"  {k:24s} {v}")
+
+# while loops anywhere: find trip counts via known pattern (constant compare)
+nwhile = txt.count(" while(")
+print(f"while ops total: {nwhile}")
+for cname, lines in comps.items():
+    wl = [l for l in lines if " while(" in l]
+    for l in wl:
+        m = re.search(r"body=%?([\w\.\-]+), condition=%?([\w\.\-]+)", l)
+        if m:
+            b = m.group(1)
+            print(f"  while in {cname}: body={b} body_ops={len(comps.get(b, []))}")
+
+# biggest computations by instruction count
+sizes = sorted(((len(v), k) for k, v in comps.items()), reverse=True)[:15]
+print("largest computations:")
+for n, k in sizes:
+    print(f"  {n:6d} {k}")

@@ -1,0 +1,55 @@
+"""Time the full jitted recover on the live backend at given batches.
+
+Usage: measure_recover.py [B ...] (default 256 1024).  Prints compile
+time and per-call wall time; honest workload via models.flagship.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from eges_tpu.crypto.verifier import ecrecover_batch
+from eges_tpu.models.flagship import example_batch
+
+batches = [int(x) for x in sys.argv[1:]] or [256, 1024]
+fn = jax.jit(ecrecover_batch)
+dev = jax.devices()[0]
+print("device:", dev, flush=True)
+
+sigs, hashes, valid, expect = example_batch(max(batches), invalid_every=17)
+
+for B in batches:
+    js, jh = jnp.asarray(sigs[:B]), jnp.asarray(hashes[:B])
+    t0 = time.perf_counter()
+    out = fn(js, jh)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+
+    # correctness gate
+    addrs = np.asarray(out[0])
+    ok = np.asarray(out[2]).astype(bool)
+    for i in range(B):
+        if expect[i] is None:
+            continue
+        if valid[i]:
+            assert ok[i] and bytes(addrs[i]) == expect[i], f"row {i}"
+        else:
+            assert not ok[i], f"row {i}"
+
+    sets = [(jnp.asarray(np.roll(sigs[:B], i + 1, axis=0)),
+             jnp.asarray(np.roll(hashes[:B], i + 1, axis=0)))
+            for i in range(4)]
+    jax.block_until_ready(sets)
+    reps = 6
+    t0 = time.perf_counter()
+    for i in range(reps):
+        a, b = sets[i % 4]
+        jax.block_until_ready(fn(a, b))
+    per_call = (time.perf_counter() - t0) / reps
+    print(f"B={B}: compile {compile_s:.1f}s  per-call {per_call*1e3:.1f} ms"
+          f"  -> {B/per_call:.1f} verifies/s", flush=True)

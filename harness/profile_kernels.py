@@ -1,0 +1,146 @@
+"""On-chip per-kernel profile of the fused recover pipeline.
+
+Times each streamed Pallas kernel standalone (same shapes the recover
+graph feeds it) plus two layout prototypes of the F_P multiply, to
+locate the batch-0.31s at 256 rows measured in LADDER_AB.json.  Run
+only when the tunnel answers; writes KERNEL_PROFILE.json.
+
+Layout hypothesis under test: in-kernel limb rows are [B]-wide 1-D
+vectors -> Mosaic lays them (1, B) on the lane axis, so 7/8 sublanes
+idle.  The `mul8` prototype shapes the same math as [8, 128] rows
+(batch on sublanes AND lanes); if it runs ~8x faster per element the
+whole in-kernel field library should move to that layout.
+"""
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+sys.path.insert(0, "/root/repo")
+
+from eges_tpu.ops import bigint
+from eges_tpu.ops.pallas_kernels import (
+    LANE_BLOCK, NLIMBS, P, _k_mul,
+    fp_mul_pallas, pow_mod_pallas, keccak_block_pallas, point_table_pallas,
+    strauss_stream, STRAUSS_OPS,
+)
+
+GLV_WINDOWS = 33
+
+
+def timeit(fn, *args, reps=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def rand_limbs(rng, B):
+    vals = [rng.randrange(P) for _ in range(B)]
+    return jnp.asarray(np.stack([np.asarray(bigint.int_to_limbs(v))
+                                 for v in vals]))
+
+
+# ---- [8,128]-row prototype of the F_P multiply ----------------------------
+
+def _fp_mul8_kernel(a_ref, b_ref, out_ref):
+    a = [a_ref[k] for k in range(NLIMBS)]
+    b = [b_ref[k] for k in range(NLIMBS)]
+    o = _k_mul(a, b)
+    for k in range(NLIMBS):
+        out_ref[k] = o[k]
+
+
+def fp_mul8(a, b):
+    """[B,16] x [B,16] via [16, B/128, 8, 128]-ish rows: each limb a
+    (8,128) vreg-shaped block."""
+    B = a.shape[0]
+    assert B % 1024 == 0
+    nb = B // 1024
+    at = a.T.reshape(NLIMBS, nb, 8, 128)
+    bt = b.T.reshape(NLIMBS, nb, 8, 128)
+    out = pl.pallas_call(
+        _fp_mul8_kernel,
+        out_shape=jax.ShapeDtypeStruct((NLIMBS, nb, 8, 128), jnp.uint32),
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((NLIMBS, 1, 8, 128), lambda i: (0, i, 0, 0))] * 2,
+        out_specs=pl.BlockSpec((NLIMBS, 1, 8, 128), lambda i: (0, i, 0, 0)),
+    )(at, bt)
+    return out.reshape(NLIMBS, B).T
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev, flush=True)
+    rng = __import__("random").Random(7)
+    res = {"device": str(dev)}
+
+    for B in (256, 1024):
+        a = rand_limbs(rng, B)
+        b = rand_limbs(rng, B)
+        t = timeit(jax.jit(fp_mul_pallas), a, b)
+        res[f"fp_mul_{B}_s"] = t
+        print(f"fp_mul B={B}: {t*1e3:.3f} ms", flush=True)
+
+    # layout prototype at 1024
+    a = rand_limbs(rng, 1024)
+    b = rand_limbs(rng, 1024)
+    ref = np.asarray(jax.jit(fp_mul_pallas)(a, b))
+    got = np.asarray(jax.jit(fp_mul8)(a, b))
+    ok = bool((ref == got).all())
+    t = timeit(jax.jit(fp_mul8), a, b)
+    res["fp_mul8_1024_s"] = t
+    res["fp_mul8_correct"] = ok
+    print(f"fp_mul8 B=1024: {t*1e3:.3f} ms correct={ok}", flush=True)
+
+    for B in (256, 1024):
+        x = rand_limbs(rng, B)
+        for name, e, m in (("inv_p", P - 2, "p"), ("sqrt_p", (P + 1) // 4, "p"),
+                           ("inv_n", bigint.N - 2, "n")):
+            t = timeit(jax.jit(functools.partial(
+                pow_mod_pallas, e=e, modulus=m)), x)
+            res[f"pow_{name}_{B}_s"] = t
+            print(f"pow {name} B={B}: {t*1e3:.3f} ms", flush=True)
+
+    for B in (256, 1024):
+        px = rand_limbs(rng, B)
+        py = rand_limbs(rng, B)
+        t = timeit(jax.jit(point_table_pallas), px, py)
+        res[f"table_{B}_s"] = t
+        print(f"point_table B={B}: {t*1e3:.3f} ms", flush=True)
+
+    for B in (256, 1024):
+        wide = B  # already LANE_BLOCK-multiple
+        opx = jnp.asarray(np.random.randint(
+            0, 2**16, (GLV_WINDOWS, STRAUSS_OPS * NLIMBS, wide), np.uint32))
+        opy = jnp.asarray(np.random.randint(
+            0, 2**16, (GLV_WINDOWS, STRAUSS_OPS * NLIMBS, wide), np.uint32))
+        nz = jnp.asarray(np.random.randint(
+            0, 2, (GLV_WINDOWS, 8, wide), np.uint32))
+        t = timeit(jax.jit(functools.partial(strauss_stream, batch=B)),
+                   opx, opy, nz)
+        res[f"strauss_{B}_s"] = t
+        print(f"strauss B={B}: {t*1e3:.3f} ms", flush=True)
+
+    for B in (256, 1024):
+        w = jnp.asarray(np.random.randint(0, 2**32, (B, 34), np.uint32))
+        t = timeit(jax.jit(keccak_block_pallas), w)
+        res[f"keccak_{B}_s"] = t
+        print(f"keccak B={B}: {t*1e3:.3f} ms", flush=True)
+
+    with open("/root/repo/KERNEL_PROFILE.json", "w") as f:
+        json.dump(res, f, indent=1)
+    print(json.dumps(res, indent=1), flush=True)
+
+
+if __name__ == "__main__":
+    main()

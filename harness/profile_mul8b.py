@@ -1,0 +1,85 @@
+"""Second attempt at the sublane-filling F_P-multiply layout.
+
+profile_kernels.py's `fp_mul8` (4-D refs, one (1,8,128) block per limb)
+ran 245x SLOWER than the (16, B) 1-D-row kernel — consistent with
+Mosaic relayout/copy per 4-D block access, not with the VPU math.
+This variant keeps everything 2-D: a value is a (128, 128) tile =
+16 limbs x (8 sublanes x 128 lanes), and each limb is an aligned
+(8, 128) row-slice — exactly one vreg.  If THIS beats the (16, B)
+layout per element, the in-kernel field library should adopt it.
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+sys.path.insert(0, "/root/repo")
+
+from eges_tpu.ops import bigint
+from eges_tpu.ops.pallas_kernels import NLIMBS, P, _k_mul, fp_mul_pallas
+
+
+def _read8(ref):
+    return [ref[0, 8 * k:8 * k + 8, :] for k in range(NLIMBS)]
+
+
+def _fp_mul8b_kernel(a_ref, b_ref, o_ref):
+    o = _k_mul(_read8(a_ref), _read8(b_ref))
+    for k in range(NLIMBS):
+        o_ref[0, 8 * k:8 * k + 8, :] = o[k]
+
+
+def fp_mul8b(a, b):
+    """[B,16] x [B,16] -> [B,16] with B % 1024 == 0; tiles are
+    (16*8, 128): limb-major rows, batch split 8 sublanes x 128 lanes."""
+    B = a.shape[0]
+    nb = B // 1024
+    # [B,16] -> [16, nb, 8, 128] -> [nb, 16*8, 128]
+    at = a.T.reshape(NLIMBS, nb, 8, 128).transpose(1, 0, 2, 3) \
+        .reshape(nb, NLIMBS * 8, 128)
+    bt = b.T.reshape(NLIMBS, nb, 8, 128).transpose(1, 0, 2, 3) \
+        .reshape(nb, NLIMBS * 8, 128)
+    out = pl.pallas_call(
+        _fp_mul8b_kernel,
+        out_shape=jax.ShapeDtypeStruct((nb, NLIMBS * 8, 128), jnp.uint32),
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((1, NLIMBS * 8, 128),
+                               lambda i: (i, 0, 0))] * 2,
+        out_specs=pl.BlockSpec((1, NLIMBS * 8, 128), lambda i: (i, 0, 0)),
+    )(at, bt)
+    return out.reshape(nb, NLIMBS, 8, 128).transpose(1, 0, 2, 3) \
+        .reshape(NLIMBS, B).T
+
+
+def timeit(fn, *args, reps=10):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    rng = __import__("random").Random(3)
+    B = 4096
+    vals = [rng.randrange(P) for _ in range(B)]
+    a = jnp.asarray(np.stack([np.asarray(bigint.int_to_limbs(v))
+                              for v in vals]))
+    b = jnp.asarray(a[::-1])
+    ref = np.asarray(jax.jit(fp_mul_pallas)(a, b))
+    got = np.asarray(jax.jit(fp_mul8b)(a, b))
+    ok = bool((ref == got).all())
+    t_old = timeit(jax.jit(fp_mul_pallas), a, b)
+    t_new = timeit(jax.jit(fp_mul8b), a, b)
+    print(f"B={B} old(16,B): {t_old*1e3:.3f} ms   "
+          f"new(128,128): {t_new*1e3:.3f} ms   correct={ok}")
+
+
+if __name__ == "__main__":
+    main()

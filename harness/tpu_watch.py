@@ -131,15 +131,16 @@ def bench(variant: str = "") -> dict | None:
     """Run the real bench TPU-only; return the best TPU-device line.
 
     ``variant=""`` runs the session default: the fused Pallas kernels
-    (default-on for tpu backends) unless the operator's environment
-    opts out — an inherited ``EGES_TPU_PALLAS`` is respected verbatim.
-    ``variant="off"`` forces the plain XLA graph (the comparator leg of
-    the hardware A/B); real hardware is the only place the fused
-    kernels run, so the watcher is their proving ground."""
+    (default-on for tpu backends).  ``variant="off"`` forces the plain
+    XLA graph (the comparator leg of the hardware A/B).  The child's
+    ``EGES_TPU_PALLAS`` is set EXPLICITLY either way — an ambient
+    operator opt-out must not silently turn a "ladder" leg into a
+    plain-graph run and bank a bogus A/B verdict (r4 review finding);
+    real hardware is the only place the fused kernels run, so the
+    watcher is their proving ground."""
     env = dict(os.environ)
     env["BENCH_BUDGET_S"] = str(BENCH_BUDGET_S)
-    if variant:
-        env["EGES_TPU_PALLAS"] = variant
+    env["EGES_TPU_PALLAS"] = variant
     rc, out = _run_child(
         [sys.executable, os.path.join(_REPO, "bench.py"), "--tpu-only"],
         BENCH_BUDGET_S + 120, env)
@@ -230,19 +231,11 @@ def main() -> None:
             time.sleep(PROBE_PERIOD_S)
             continue
         _log(f"probe: TPU UP {info}")
-        # warm the two buckets the bench needs first; each is its own
-        # child so a flap mid-compile still banks the finished buckets.
-        # A warm failure means the tunnel just flapped — go back to the
-        # cheap probe cadence instead of sinking the full bench budget
-        # into a dead tunnel.
-        if not all(warm(b) for b in (256, 1024)):
-            time.sleep(PROBE_PERIOD_S)
-            continue
         # since the round-4 hardware A/B (LADDER_AB.json at the repo
-        # root: 826.8/s vs 20.1/s at 256 rows) the fused kernels are
-        # DEFAULT ON for tpu backends.  The banked verdict still gates
-        # the main leg: if the CURRENT kernels' A/B says they lost to
-        # the plain graph, the plain graph is what gets measured.
+        # root) the fused kernels are DEFAULT ON for tpu backends.  The
+        # banked verdict still gates the main leg: if the CURRENT
+        # kernels' A/B says they lost to the plain graph, the plain
+        # graph is what gets measured.
         ab_path = os.path.join(_REPO, "LADDER_AB.json")
         kernels_lost = False
         try:
@@ -252,8 +245,15 @@ def main() -> None:
                             and ab_cur.get("beat_plain") is False)
         except Exception:
             pass
-        env_off = os.environ.get("EGES_TPU_PALLAS", "") in ("off", "0", "1")
         main_variant = "off" if kernels_lost else ""
+        # warm the correctness-gate bucket for the leg that will
+        # actually be benched; its own child so a flap mid-compile
+        # still banks the finished bucket.  A warm failure means the
+        # tunnel just flapped — go back to the cheap probe cadence
+        # instead of sinking the full bench budget into a dead tunnel.
+        if not warm(256, main_variant):
+            time.sleep(PROBE_PERIOD_S)
+            continue
         res = bench(main_variant)
         fellback = res is None
         if fellback and not kernels_lost:
@@ -262,8 +262,8 @@ def main() -> None:
         if res is not None:
             res["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
             res["variant"] = (
-                "plain-graph" if (fellback or kernels_lost or env_off)
-                else "pallas-ladder-default")
+                "plain-graph" if (fellback or kernels_lost)
+                else "pallas-ladder+glue-default")
             _promote(res)
         # cadence follows the BANKED capture, not this run: a worse
         # run that _promote refused must not drop us back to the fast

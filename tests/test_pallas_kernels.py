@@ -364,3 +364,137 @@ def test_fn_mul_kernel_interpret():
     got = np.asarray(fn_mul_pallas(a, b, interpret=True))
     want = np.asarray(FN.mul(a, b))
     np.testing.assert_array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# glue kernels (round 4): every remaining field op of the recover
+# pipeline as one launch — numpy-twin math + interpret-mode plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_glue_fp_kernel_math():
+    """_k_add/_k_sub/_k_neg/_k_mul_small/_k_cond_sub_p (numpy namespace)
+    are bit-identical to the FieldP graph ops on random + extreme rows."""
+    from eges_tpu.ops.pallas_kernels import (
+        _k_add, _k_sub, _k_neg, _k_mul_small, _k_cond_sub_p,
+    )
+
+    vals = [0, 1, P - 1, P, (1 << 256) - 1, rng.randrange(1 << 256)]
+    vals += [rng.randrange(P) for _ in range(6)]
+    vb = list(reversed(vals))
+    a = jnp.asarray(np.stack([int_to_limbs(v) for v in vals]))
+    b = jnp.asarray(np.stack([int_to_limbs(v) for v in vb]))
+    ta, tb = _t(a), _t(b)
+
+    np.testing.assert_array_equal(_untq(_k_add(ta, tb, xp=np)),
+                                  np.asarray(FP._reduce_cols(a + b)))
+    comp = jnp.uint32(0xFFFF) - b
+    subc = jnp.broadcast_to(jnp.asarray(FP._subc_np), a.shape)
+    np.testing.assert_array_equal(
+        _untq(_k_sub(ta, tb, xp=np)),
+        np.asarray(FP._reduce_cols(a + comp + subc)))
+    np.testing.assert_array_equal(
+        _untq(_k_neg(ta, xp=np)),
+        np.asarray(FP._reduce_cols(jnp.zeros_like(a)
+                                   + (jnp.uint32(0xFFFF) - a) + subc)))
+    for k in (2, 3, 8):
+        np.testing.assert_array_equal(
+            _untq(_k_mul_small(ta, k, xp=np)),
+            np.asarray(FP._reduce_cols(a * jnp.uint32(k))))
+    np.testing.assert_array_equal(_untq(_k_cond_sub_p(ta, xp=np)),
+                                  np.asarray(FP._cond_sub_m(a)))
+
+
+def test_glue_fn_kernel_math():
+    """_k_fn_sub/_k_fn_neg/_k_fn_red_cols (numpy) match the canonical
+    OrderN graph ops exactly."""
+    from eges_tpu.ops.bigint import FN, N
+    from eges_tpu.ops.pallas_kernels import (
+        _k_fn_neg, _k_fn_red_cols, _k_fn_sub,
+    )
+
+    vals = [0, 1, N - 1, N - 2, rng.randrange(N), rng.randrange(N)]
+    vb = list(reversed(vals))
+    a = jnp.asarray(np.stack([int_to_limbs(v) for v in vals]))
+    b = jnp.asarray(np.stack([int_to_limbs(v) for v in vb]))
+
+    got = _untq(_k_fn_sub(_t(a), _t(b), xp=np))
+    np.testing.assert_array_equal(got, np.asarray(FN.sub(a, b)))
+    for x, y, row in zip(vals, vb, got):
+        assert limbs_to_int(row) == (x - y) % N
+
+    got = _untq(_k_fn_neg(_t(a), xp=np))
+    np.testing.assert_array_equal(got, np.asarray(FN.neg(a)))
+
+    # 17-limb reduction (the z-mod-N / px-mod-N path)
+    wide_vals = [0, 1, N, N + 1, (1 << 256) - 1,
+                 rng.randrange(1 << 256), rng.randrange(1 << 256)]
+    w = jnp.asarray(np.stack([int_to_limbs(v, 17) for v in wide_vals]))
+    cols = [np.asarray(w)[:, k].copy() for k in range(17)]
+    got = _untq(_k_fn_red_cols(cols, xp=np))
+    np.testing.assert_array_equal(got, np.asarray(FN._red_cols(w)))
+    for v, row in zip(wide_vals, got):
+        assert limbs_to_int(row) == v % N
+
+
+def test_glue_mulhi8_math():
+    """The GLV rounding kernel math: limbs 24..31 of k * g for the two
+    lattice constants, vs the XLA big_mul path."""
+    from eges_tpu.ops import bigint
+    from eges_tpu.ops.ec import _G_G1, _G_G2
+    from eges_tpu.ops.pallas_kernels import _k_carry, _k_mul_cols
+
+    vals = [0, 1, bigint.N - 1, rng.randrange(bigint.N),
+            rng.randrange(bigint.N)]
+    k = jnp.asarray(np.stack([int_to_limbs(v) for v in vals]))
+    for g in (_G_G1, _G_G2):
+        g_limbs = [int(v) for v in int_to_limbs(g)]
+        cols = _k_mul_cols(_t(k), g_limbs, xp=np)
+        got = _untq(_k_carry(cols, 32, xp=np)[24:32])
+        gb = jnp.broadcast_to(jnp.asarray(int_to_limbs(g, 16)), k.shape)
+        want = np.asarray(bigint.big_mul(k, gb)[..., 24:32])
+        np.testing.assert_array_equal(got, want)
+        for v, row in zip(vals, got):
+            assert limbs_to_int(row) == ((v * g) >> 384) & ((1 << 128) - 1)
+
+
+@pytest.mark.slow
+def test_glue_kernels_interpret():
+    """The glue kernels through pallas_call in interpret mode: covers
+    the [rows, B] tiling plumbing (incl. the non-16-row operands)."""
+    from eges_tpu.ops.bigint import FN, N
+    from eges_tpu.ops.ec import _G_G1
+    from eges_tpu.ops import bigint
+    from eges_tpu.ops.pallas_kernels import (
+        fn_red17_pallas, fn_sub_pallas, fp_add_pallas, fp_canon_pallas,
+        mulhi8_pallas,
+    )
+
+    n = 5
+    va = [rng.randrange(P) for _ in range(n)]
+    vb = [rng.randrange(P) for _ in range(n)]
+    a = jnp.asarray(np.stack([int_to_limbs(v) for v in va]))
+    b = jnp.asarray(np.stack([int_to_limbs(v) for v in vb]))
+    np.testing.assert_array_equal(
+        np.asarray(fp_add_pallas(a, b, interpret=True)),
+        np.asarray(FP._reduce_cols(a + b)))
+    np.testing.assert_array_equal(
+        np.asarray(fp_canon_pallas(a, interpret=True)),
+        np.asarray(FP._cond_sub_m(a)))
+
+    ka = jnp.asarray(np.stack([int_to_limbs(v % N) for v in va]))
+    kb = jnp.asarray(np.stack([int_to_limbs(v % N) for v in vb]))
+    np.testing.assert_array_equal(
+        np.asarray(fn_sub_pallas(ka, kb, interpret=True)),
+        np.asarray(FN.sub(ka, kb)))
+
+    w = jnp.asarray(np.stack([int_to_limbs(rng.randrange(1 << 256), 17)
+                              for _ in range(n)]))
+    np.testing.assert_array_equal(
+        np.asarray(fn_red17_pallas(w, interpret=True)),
+        np.asarray(FN._red_cols(w)))
+
+    gb = jnp.broadcast_to(jnp.asarray(int_to_limbs(_G_G1, 16)), ka.shape)
+    np.testing.assert_array_equal(
+        np.asarray(mulhi8_pallas(ka, _G_G1, interpret=True)),
+        np.asarray(bigint.big_mul(ka, gb)[..., 24:32]))
