@@ -53,11 +53,27 @@ def ecrecover_batch(sigs: jnp.ndarray, hashes: jnp.ndarray):
     secp256.go:105-124 — a mask is the batch-native contract).
     """
     z, r, s, v = _unpack(sigs, hashes)
-    qx, qy, ok = ec.ecrecover_point(z, r, s, v)
+
+    from eges_tpu.ops.pallas_kernels import (
+        keccak_rows_pallas, ladder_kernels_enabled,
+    )
+    if ladder_kernels_enabled() and sigs.ndim == 2:
+        # fused pipeline: ~12 composite kernel launches end-to-end; the
+        # finish kernel already packed the (masked) keccak block words
+        B = sigs.shape[0]
+        qx, qy, ok, words = ec.ecrecover_point_fused(z, r, s, v)
+        dig = keccak_rows_pallas(words)
+        dw = dig[3:8, :B]  # digest bytes 12..31 = LE words 3..7
+        ab = jnp.stack([(dw >> (8 * j)) & 0xFF for j in range(4)], axis=1)
+        addrs = ab.transpose(2, 0, 1).reshape(B, 20).astype(jnp.uint8)
+    else:
+        qx, qy, ok = ec.ecrecover_point(z, r, s, v)
+        addrs = None
     qx_b = bigint.limbs_to_bytes_be(qx)
     qy_b = bigint.limbs_to_bytes_be(qy)
-    addrs = keccak_tpu.pubkey_to_address(qx_b, qy_b)
     mask = ok[..., None].astype(jnp.uint8)
+    if addrs is None:
+        addrs = keccak_tpu.pubkey_to_address(qx_b, qy_b)
     pubs = jnp.concatenate([qx_b, qy_b], axis=-1) * mask
     return addrs * mask, pubs, ok
 

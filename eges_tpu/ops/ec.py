@@ -399,25 +399,27 @@ def strauss_gR(u1: jnp.ndarray, u2: jnp.ndarray, rx: jnp.ndarray, ry: jnp.ndarra
     R is affine per-row; the lam*R table is the R table with beta-scaled
     x.  Negative half-scalars negate the looked-up point's y per row.
     """
+    # Fused path (round-4 v2): TWO launches own the whole double-scalar
+    # multiply.  The GLV kernel turns both scalars into ladder digits +
+    # signs (ops/pallas_kernels.py glv_digits_pallas); the ladder kernel
+    # does its OWN table lookups in VMEM (strauss_tab) — the former XLA
+    # split/gather/sign-fold/pack stage was ~200 dispatches and two
+    # [W, 64, B] operand arrays re-uploaded per call, and on this
+    # backend every dispatch with fresh content is a round trip.
+    from eges_tpu.ops.pallas_kernels import (
+        glv_digits_pallas, ladder_kernels_enabled, strauss_tab,
+    )
+    if ladder_kernels_enabled() and rx.ndim == 2:
+        B = rx.shape[0]
+        dig, neg = glv_digits_pallas(u1, u2)
+        trx, try_ = _build_affine_table(rx, ry)
+        tlrx = FP.mul(trx, FP.const(GLV_BETA, trx))
+        return strauss_tab(dig, neg, _table_rows(trx, B),
+                           _table_rows(tlrx, B), _table_rows(try_, B), B)
+
     (d_g1, d_g2, d_r1, d_r2), (n1g, n2g, n1r, n2r), \
         (tgx, tgy), (tlx, tly), (trx, try_, tlrx) = \
         _strauss_prelude(u1, u2, rx, ry)
-
-    # EGES_TPU_PALLAS=ladder: the ENTIRE 33-window loop runs as one
-    # streamed Mosaic kernel — operands for every window are gathered
-    # and sign-folded here in a handful of vectorized XLA ops, then the
-    # kernel's grid walks the windows with the accumulator resident in
-    # VMEM (ops/pallas_kernels.py strauss_stream).  One kernel launch
-    # per batch; measured r4: launch overhead, not arithmetic, is what
-    # dominates this backend.
-    from eges_tpu.ops.pallas_kernels import (
-        ladder_kernels_enabled, strauss_stream,
-    )
-    if ladder_kernels_enabled() and rx.ndim == 2:
-        opx, opy, nzp = pack_strauss_operands(
-            (d_g1, d_g2, d_r1, d_r2), (n1g, n2g, n1r, n2r),
-            (tgx, tgy), (tlx, tly), (trx, try_, tlrx))
-        return strauss_stream(opx, opy, nzp, rx.shape[0])
 
     acc = infinity(rx)
     negs = jnp.stack([jnp.broadcast_to(n1g, d_g1.shape[:-1]),
@@ -450,6 +452,39 @@ def strauss_gR(u1: jnp.ndarray, u2: jnp.ndarray, rx: jnp.ndarray, ry: jnp.ndarra
         return jax.lax.fori_loop(0, 4, add_step, acc)
 
     return jax.lax.fori_loop(0, GLV_WINDOWS, body, acc)
+
+
+def _table_rows(tab: jnp.ndarray, B: int) -> jnp.ndarray:
+    """``[16, B, 16]`` entry-stacked affine table -> ``[256, Bpad]``
+    (row ``16*d + k`` = limb k of entry d), the strauss_tab layout."""
+    from eges_tpu.ops.pallas_kernels import LANE_BLOCK
+
+    pad = (-B) % LANE_BLOCK
+    return jnp.pad(jnp.transpose(tab, (0, 2, 1)).reshape(-1, B),
+                   ((0, 0), (0, pad)))
+
+
+def pack_strauss_tab_inputs(digits, negs, r_tab):
+    """Inputs for the self-gathering ladder kernel (strauss_tab) built
+    from the XLA prelude's digit/sign arrays: window digits as one
+    ``[W, 8, Bpad]`` array (rows 0-3: g1/g2/r1/r2, MSD-first), signs as
+    ``[8, Bpad]``, and the three affine R tables re-rowed.  Production
+    uses glv_digits_pallas instead; this path pins the two digit
+    pipelines against each other in tests."""
+    from eges_tpu.ops.pallas_kernels import LANE_BLOCK
+
+    d_g1, d_g2, d_r1, d_r2 = digits
+    n1g, n2g, n1r, n2r = negs
+    trx, try_, tlrx = r_tab
+    B, W = d_g1.shape
+    pad = (-B) % LANE_BLOCK
+    dig = jnp.stack([d[..., ::-1] for d in (d_g1, d_g2, d_r1, d_r2)])
+    dig = jnp.pad(jnp.transpose(dig, (2, 0, 1)), ((0, 0), (0, 4), (0, pad)))
+    neg = jnp.pad(jnp.stack([
+        jnp.broadcast_to(n, (B,)).astype(jnp.uint32)
+        for n in (n1g, n2g, n1r, n2r)]), ((0, 4), (0, pad)))
+    return dig, neg, _table_rows(trx, B), _table_rows(tlrx, B), \
+        _table_rows(try_, B)
 
 
 def pack_strauss_operands(digits, negs, g_tab, lam_tab, r_tab):
@@ -567,6 +602,34 @@ def scalar_mul(k: jnp.ndarray, px: jnp.ndarray, py: jnp.ndarray):
         return tuple(select(nz, n, o) for n, o in zip(added, acc))
 
     return jax.lax.fori_loop(0, N_WINDOWS, body, acc)
+
+
+def ecrecover_point_fused(z: jnp.ndarray, r: jnp.ndarray, s: jnp.ndarray,
+                          v: jnp.ndarray):
+    """Fused-kernel twin of :func:`ecrecover_point` (TPU backends): the
+    whole pipeline is ~12 launches — composite stage kernels around the
+    two pow ladders and the self-gathering Strauss kernel — instead of
+    the general path's per-op graph.  Returns ``(qx, qy, ok, words)``
+    where ``words [34, Bpad]`` is the ready-padded keccak block of
+    ``qx || qy`` (the finish kernel packs bytes in-kernel so the
+    address tail needs no XLA byte shuffling).  Outputs are
+    value-identical to the general path; every kernel's math is the
+    ``_k_*`` mirror of the graph ops (differential-tested in numpy and
+    on hardware)."""
+    from eges_tpu.ops import bigint as bg
+    from eges_tpu.ops.pallas_kernels import (
+        pow_mod_pallas, recover_finish_pallas, recover_prelude_pallas,
+        u1u2_pallas, y_fix_pallas,
+    )
+
+    x, y_sq, ok0 = recover_prelude_pallas(r, s, v)
+    root = pow_mod_pallas(y_sq, (bg.P + 1) // 4, "p")
+    y, y_ok = y_fix_pallas(root, y_sq, v)
+    r_inv = pow_mod_pallas(r, bg.N - 2, "n")
+    u1, u2 = u1u2_pallas(z, s, r_inv)
+    q = strauss_gR(u1, u2, x, y)
+    zi_raw = pow_mod_pallas(q[2], bg.P - 2, "p")
+    return recover_finish_pallas(q[0], q[1], q[2], zi_raw, ok0 * y_ok)
 
 
 def ecrecover_point(z: jnp.ndarray, r: jnp.ndarray, s: jnp.ndarray,

@@ -353,6 +353,171 @@ def strauss_stream(opx: jnp.ndarray, opy: jnp.ndarray, nz: jnp.ndarray,
     return tuple(o.T[:batch] for o in outs)
 
 
+# ---------------------------------------------------------------------------
+# self-gathering ladder kernel (round-4 v2): the per-window table
+# lookups move INSIDE the kernel as one-hot selects, so the XLA
+# pre-gather/sign-fold/pack stage (~150 dispatches and two [W, 64, B]
+# operand arrays — 280 MB per 16k batch — re-uploaded per call)
+# disappears entirely.  Fixed-base operands (±G, ±lam*G) select from
+# trace-time scalar constants; variable-base operands (±R, ±lam*R)
+# select rows of the R-table refs, which stay VMEM-resident across the
+# whole window walk (their index map is constant in w).  Digits arrive
+# MSD-first as one tiny [W, 8, B] array; signs as [8, B].
+# ---------------------------------------------------------------------------
+
+
+def _k_onehot_const(dig, tab_rows, xp=jnp):
+    """Per-lane lookup of a 16-entry x 16-limb CONSTANT table by digit
+    vector: limbs[k] = sum_d (dig == d) * tab[d][k].  Entry 0 of every
+    table is the zero row, so the d = 0 term is skipped."""
+    out = []
+    oh = [(dig == xp.uint32(d)).astype(xp.uint32) for d in range(1, 16)]
+    for k in range(NLIMBS):
+        s = xp.zeros_like(dig)
+        for d in range(1, 16):
+            c = tab_rows[d][k]
+            if c:
+                s = s + oh[d - 1] * xp.uint32(c)
+        out.append(s)
+    return out
+
+
+def _k_onehot_ref(dig, read_row, xp=jnp):
+    """Same, for a per-row table in a ref: ``read_row(d, k)`` yields the
+    [B]-vector of limb k of entry d."""
+    oh = [(dig == xp.uint32(d)).astype(xp.uint32) for d in range(1, 16)]
+    out = []
+    for k in range(NLIMBS):
+        s = xp.zeros_like(dig)
+        for d in range(1, 16):
+            s = s + oh[d - 1] * read_row(d, k)
+        out.append(s)
+    return out
+
+
+@functools.lru_cache(maxsize=1)
+def _strauss_tab_kernel():
+    # G/lam*G affine tables as trace-time int constants (entry 0 zero)
+    from eges_tpu.ops.ec import _g_lam_table16, _g_table16
+
+    tgx, tgy = _g_table16()
+    tlx, _ = _g_lam_table16()
+    gx_rows = tuple(tuple(int(v) for v in row) for row in tgx)
+    gy_rows = tuple(tuple(int(v) for v in row) for row in tgy)
+    lx_rows = tuple(tuple(int(v) for v in row) for row in tlx)
+
+    def kernel(dig_ref, neg_ref, trx_ref, tlrx_ref, try_ref,
+               ox_ref, oy_ref, oz_ref):
+        w = pl.program_id(1)
+
+        @pl.when(w == 0)
+        def _init():
+            zero = jnp.zeros((LANE_BLOCK,), jnp.uint32)
+            one = jnp.ones((LANE_BLOCK,), jnp.uint32)
+            for k in range(NLIMBS):
+                ox_ref[k, :] = zero
+                oy_ref[k, :] = one if k == 0 else zero
+                oz_ref[k, :] = zero
+
+        X, Y, Z = _read16(ox_ref), _read16(oy_ref), _read16(oz_ref)
+        for _ in range(4):
+            X, Y, Z = _k_jac_double(X, Y, Z)
+        for t in range(STRAUSS_OPS):
+            dig = dig_ref[0, t, :]
+            if t == 0:
+                px = _k_onehot_const(dig, gx_rows)
+                py = _k_onehot_const(dig, gy_rows)
+            elif t == 1:
+                px = _k_onehot_const(dig, lx_rows)
+                py = _k_onehot_const(dig, gy_rows)
+            else:
+                xref = trx_ref if t == 2 else tlrx_ref
+                px = _k_onehot_ref(dig, lambda d, k: xref[16 * d + k, :])
+                py = _k_onehot_ref(dig, lambda d, k: try_ref[16 * d + k, :])
+            py = _k_select(neg_ref[t, :], _k_neg(py), py)
+            nz = (dig != 0).astype(jnp.uint32)
+            AX, AY, AZ = _k_jac_add_mixed(X, Y, Z, px, py)
+            X = _k_select(nz, AX, X)
+            Y = _k_select(nz, AY, Y)
+            Z = _k_select(nz, AZ, Z)
+        _write16(ox_ref, X)
+        _write16(oy_ref, Y)
+        _write16(oz_ref, Z)
+
+    return kernel
+
+
+def strauss_tab(dig: jnp.ndarray, neg: jnp.ndarray, trx: jnp.ndarray,
+                tlrx: jnp.ndarray, try_: jnp.ndarray, batch: int, *,
+                interpret: bool | None = None):
+    """Self-gathering ladder: ``dig [W, 8, Bpad]`` (rows 0-3: window
+    digits of g1/g2/r1/r2, MSD-first), ``neg [8, Bpad]`` (rows 0-3:
+    half-scalar signs), ``trx/tlrx/try_ [256, Bpad]`` (R / lam*R x and
+    shared y affine tables, row ``16*d + k`` = limb k of entry d).
+    Returns Jacobian ``(X, Y, Z)`` each ``[batch, 16]``."""
+    if interpret is None:
+        interpret = _default_interpret()
+    W, _, wide = dig.shape
+    nb = wide // LANE_BLOCK
+    outs = pl.pallas_call(
+        _strauss_tab_kernel(),
+        out_shape=tuple(jax.ShapeDtypeStruct((NLIMBS, wide), jnp.uint32)
+                        for _ in range(3)),
+        grid=(nb, W),
+        in_specs=[
+            pl.BlockSpec((1, 8, LANE_BLOCK), lambda b, w: (w, 0, b)),
+            pl.BlockSpec((8, LANE_BLOCK), lambda b, w: (0, b)),
+            pl.BlockSpec((16 * NLIMBS, LANE_BLOCK), lambda b, w: (0, b)),
+            pl.BlockSpec((16 * NLIMBS, LANE_BLOCK), lambda b, w: (0, b)),
+            pl.BlockSpec((16 * NLIMBS, LANE_BLOCK), lambda b, w: (0, b)),
+        ],
+        out_specs=tuple(
+            pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda b, w: (0, b))
+            for _ in range(3)),
+        interpret=interpret,
+    )(dig, neg, trx, tlrx, try_)
+    return tuple(o.T[:batch] for o in outs)
+
+
+def strauss_tab_np(dig: np.ndarray, neg: np.ndarray, trx: np.ndarray,
+                   tlrx: np.ndarray, try_: np.ndarray):
+    """Numpy twin of the self-gathering ladder kernel's math."""
+    from eges_tpu.ops.ec import _g_lam_table16, _g_table16
+
+    tgx, tgy = _g_table16()
+    tlx, _ = _g_lam_table16()
+    gx_rows = tuple(tuple(int(v) for v in row) for row in tgx)
+    gy_rows = tuple(tuple(int(v) for v in row) for row in tgy)
+    lx_rows = tuple(tuple(int(v) for v in row) for row in tlx)
+    W, _, wide = dig.shape
+    X = [np.zeros(wide, np.uint32) for _ in range(NLIMBS)]
+    Y = [np.zeros(wide, np.uint32) for _ in range(NLIMBS)]
+    Y[0] = np.ones(wide, np.uint32)
+    Z = [np.zeros(wide, np.uint32) for _ in range(NLIMBS)]
+    for w in range(W):
+        for _ in range(4):
+            X, Y, Z = _k_jac_double(X, Y, Z, np)
+        for t in range(STRAUSS_OPS):
+            d = dig[w, t, :]
+            if t == 0:
+                px = _k_onehot_const(d, gx_rows, np)
+                py = _k_onehot_const(d, gy_rows, np)
+            elif t == 1:
+                px = _k_onehot_const(d, lx_rows, np)
+                py = _k_onehot_const(d, gy_rows, np)
+            else:
+                xt = trx if t == 2 else tlrx
+                px = _k_onehot_ref(d, lambda e, k: xt[16 * e + k, :], np)
+                py = _k_onehot_ref(d, lambda e, k: try_[16 * e + k, :], np)
+            py = _k_select(neg[t, :], _k_neg(py, np), py, np)
+            nz = (d != 0).astype(np.uint32)
+            AX, AY, AZ = _k_jac_add_mixed(X, Y, Z, px, py, np)
+            X = _k_select(nz, AX, X, np)
+            Y = _k_select(nz, AY, Y, np)
+            Z = _k_select(nz, AZ, Z, np)
+    return X, Y, Z
+
+
 def strauss_stream_np(opx: np.ndarray, opy: np.ndarray, nz: np.ndarray):
     """Numpy twin of the streaming kernel's math (same uint32 wrap
     semantics), for differential tests on hosts without a TPU."""
@@ -664,21 +829,10 @@ def keccak_block_pallas(words: jnp.ndarray, *,
                         interpret: bool | None = None) -> jnp.ndarray:
     """``[B, 34]`` LE u32 words of one padded block -> ``[B, 8]``
     digest words (matches keccak_tpu's squeeze order)."""
-    if interpret is None:
-        interpret = _default_interpret()
     B = words.shape[0]
     pad = (-B) % LANE_BLOCK
     wt = jnp.pad(words, ((0, pad), (0, 0))).T  # [34, wide]
-    wide = wt.shape[1]
-    out = pl.pallas_call(
-        _keccak_kernel,
-        out_shape=jax.ShapeDtypeStruct((8, wide), jnp.uint32),
-        grid=(wide // LANE_BLOCK,),
-        in_specs=[pl.BlockSpec((34, LANE_BLOCK), lambda b: (0, b))],
-        out_specs=pl.BlockSpec((8, LANE_BLOCK), lambda b: (0, b)),
-        interpret=interpret,
-    )(wt)
-    return out.T[:B]
+    return keccak_rows_pallas(wt, interpret=interpret).T[:B]
 
 
 # ---------------------------------------------------------------------------
@@ -770,9 +924,10 @@ def _k_mul_cols_vv(a, b, xp=jnp):
     return cols
 
 
-def _k_cond_sub(a, m_limbs, xp=jnp):
-    """One conditional subtract of the constant ``m_limbs`` (borrow
-    chain + select); shared by the mod-N and mod-P variants."""
+def _k_sub_const_chain(a, m_limbs, xp=jnp):
+    """Borrow-chain ``a - const``: returns (diff_limbs, borrow_flag);
+    borrow == 1 iff a < const.  The one borrow chain shared by the
+    conditional subtracts and the range checks."""
     mask = xp.uint32(MASK)
     out = []
     borrow = xp.zeros_like(a[0])
@@ -780,6 +935,13 @@ def _k_cond_sub(a, m_limbs, xp=jnp):
         t = a[k] + xp.uint32(1 << 16) - xp.uint32(m_limbs[k]) - borrow
         out.append(t & mask)
         borrow = xp.uint32(1) - (t >> 16)
+    return out, borrow
+
+
+def _k_cond_sub(a, m_limbs, xp=jnp):
+    """One conditional subtract of the constant ``m_limbs``; shared by
+    the mod-N and mod-P variants."""
+    out, borrow = _k_sub_const_chain(a, m_limbs, xp)
     return _k_select(borrow, a, out, xp)
 
 
@@ -989,6 +1151,142 @@ def mulhi8_pallas(a, g: int, **kw):
     return _ew(_mulhi8_kernel_for(g), [a], out_limbs=8, **kw)
 
 
+# ---------------------------------------------------------------------------
+# GLV-decompose kernel (round-4 v2): both recovery scalars -> ladder
+# digits + signs in ONE launch, emitted directly in the strauss_tab
+# input layout.  Absorbs what the XLA graph ran as ~60 dispatches: two
+# (k*g)>>384 rounding products per scalar, four mod-N muls, the k1/k2
+# lattice subtractions, the sign splits (|k| < 2^140 test + negate)
+# and the 33-window digit extraction/transpose/pack.
+# ---------------------------------------------------------------------------
+
+_GLV_WINDOWS = 33
+
+
+def _k_glv_track(u, consts, xp=jnp):
+    """One scalar's GLV split: canonical mod-N ``u`` (16 limbs) ->
+    (k1_digits, neg1, k2_digits, neg2), digits MSD-first length 33.
+    Mirrors ``ec._glv_decompose`` + ``_digits33`` value-for-value."""
+    g1, g2, a1, a2, b1n, b2 = consts
+
+    def mulhi8(a, g_limbs):
+        limbs = _k_carry(_k_mul_cols(a, g_limbs, xp), 32, xp)
+        return limbs[24:32] + [xp.zeros_like(a[0])] * 8
+
+    def fn_mul_const(a, c_limbs):
+        return _k_fn_red_cols(_k_mul_cols(a, c_limbs, xp), xp)
+
+    c1 = mulhi8(u, g1)
+    c2 = mulhi8(u, g2)
+    k1 = _k_fn_sub(_k_fn_sub(u, fn_mul_const(c1, a1), xp),
+                   fn_mul_const(c2, a2), xp)
+    k2 = _k_fn_sub(fn_mul_const(c1, b1n), fn_mul_const(c2, b2), xp)
+
+    def sign_split(v):
+        # negative residues are detected by size: |k| < 2^140 always
+        hi = v[8] >> xp.uint32(12)
+        for k in range(9, 16):
+            hi = hi | v[k]
+        neg = (hi != 0).astype(xp.uint32)
+        mag = _k_select(neg, _k_fn_neg(v, xp), v, xp)
+        return mag, neg
+
+    k1m, n1 = sign_split(k1)
+    k2m, n2 = sign_split(k2)
+
+    def digits(v):
+        # MSD-first 4-bit windows of a 132-bit magnitude
+        out = []
+        for w in range(_GLV_WINDOWS):
+            j = _GLV_WINDOWS - 1 - w           # LSD window index
+            out.append((v[j // 4] >> xp.uint32(4 * (j % 4))) & xp.uint32(0xF))
+        return out
+
+    return digits(k1m), n1, digits(k2m), n2
+
+
+@functools.lru_cache(maxsize=1)
+def _glv_kernel():
+    from eges_tpu.ops.ec import (
+        _G_A1, _G_A2, _G_B1N, _G_B2, _G_G1, _G_G2,
+    )
+
+    def limbs(x):
+        return tuple(int(v) for v in int_to_limbs(x))
+
+    consts = (limbs(_G_G1), limbs(_G_G2), limbs(_G_A1), limbs(_G_A2),
+              limbs(_G_B1N), limbs(_G_B2))
+
+    def kernel(u1_ref, u2_ref, dig_ref, neg_ref):
+        dg1, n1g, dg2, n2g = _k_glv_track(_read16(u1_ref), consts)
+        dr1, n1r, dr2, n2r = _k_glv_track(_read16(u2_ref), consts)
+        zero = jnp.zeros((LANE_BLOCK,), jnp.uint32)
+        for w in range(_GLV_WINDOWS):
+            dig_ref[w, 0, :] = dg1[w]
+            dig_ref[w, 1, :] = dg2[w]
+            dig_ref[w, 2, :] = dr1[w]
+            dig_ref[w, 3, :] = dr2[w]
+            for t in range(4, 8):
+                dig_ref[w, t, :] = zero
+        for t, n in enumerate((n1g, n2g, n1r, n2r)):
+            neg_ref[t, :] = n
+        for t in range(4, 8):
+            neg_ref[t, :] = zero
+
+    return kernel
+
+
+def glv_digits_pallas(u1: jnp.ndarray, u2: jnp.ndarray, *,
+                      interpret: bool | None = None):
+    """``u1/u2 [B, 16]`` canonical mod-N scalars -> ``(dig [33, 8,
+    Bpad], neg [8, Bpad])`` ready for :func:`strauss_tab`."""
+    if interpret is None:
+        interpret = _default_interpret()
+    B = u1.shape[0]
+    pad = (-B) % LANE_BLOCK
+    u1t = jnp.pad(u1, ((0, pad), (0, 0))).T
+    u2t = jnp.pad(u2, ((0, pad), (0, 0))).T
+    wide = u1t.shape[1]
+    dig, neg = pl.pallas_call(
+        _glv_kernel(),
+        out_shape=(jax.ShapeDtypeStruct((_GLV_WINDOWS, 8, wide), jnp.uint32),
+                   jax.ShapeDtypeStruct((8, wide), jnp.uint32)),
+        grid=(wide // LANE_BLOCK,),
+        in_specs=[pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i))] * 2,
+        out_specs=(pl.BlockSpec((_GLV_WINDOWS, 8, LANE_BLOCK),
+                                lambda i: (0, 0, i)),
+                   pl.BlockSpec((8, LANE_BLOCK), lambda i: (0, i))),
+        interpret=interpret,
+    )(u1t, u2t)
+    return dig, neg
+
+
+def glv_digits_np(u1: np.ndarray, u2: np.ndarray):
+    """Numpy twin of the GLV-decompose kernel (unpadded)."""
+    from eges_tpu.ops.ec import (
+        _G_A1, _G_A2, _G_B1N, _G_B2, _G_G1, _G_G2,
+    )
+
+    def limbs(x):
+        return tuple(int(v) for v in int_to_limbs(x))
+
+    consts = (limbs(_G_G1), limbs(_G_G2), limbs(_G_A1), limbs(_G_A2),
+              limbs(_G_B1N), limbs(_G_B2))
+    B = u1.shape[0]
+    t1 = [u1[:, k].copy() for k in range(NLIMBS)]
+    t2 = [u2[:, k].copy() for k in range(NLIMBS)]
+    dg1, n1g, dg2, n2g = _k_glv_track(t1, consts, np)
+    dr1, n1r, dr2, n2r = _k_glv_track(t2, consts, np)
+    dig = np.zeros((_GLV_WINDOWS, 8, B), np.uint32)
+    for w in range(_GLV_WINDOWS):
+        dig[w, 0], dig[w, 1] = dg1[w], dg2[w]
+        dig[w, 2], dig[w, 3] = dr1[w], dr2[w]
+    neg = np.zeros((8, B), np.uint32)
+    for t, n in enumerate((n1g, n2g, n1r, n2r)):
+        neg[t] = n
+    return dig, neg
+
+
 @functools.lru_cache(maxsize=8)
 def _mul_small_kernel_for(k: int):
     def kernel(a_ref, o_ref):
@@ -999,3 +1297,262 @@ def _mul_small_kernel_for(k: int):
 
 def fp_mul_small_pallas(a, k: int, **kw):
     return _ew(_mul_small_kernel_for(k), [a], **kw)
+
+
+# ---------------------------------------------------------------------------
+# recover-pipeline composite kernels (round-4 v2): the scalar prelude,
+# the y-fix after sqrt, the u1/u2 scalars after the mod-N inverse, and
+# the affine/keccak-prep finish — each a whole pipeline STAGE as one
+# launch.  The per-op glue kernels above cut the graph from ~3.8k to
+# ~640 dispatches; these composites absorb the remaining carry chains,
+# range checks, parity fixes and byte packing that still ran as
+# separate fusions (each a fresh round trip on the tunnel backend).
+# ---------------------------------------------------------------------------
+
+
+def _k_lt_const(a, m_limbs, xp=jnp):
+    """Borrow-chain a < const flag ([B] u32 0/1); mirrors big_lt."""
+    return _k_sub_const_chain(a, m_limbs, xp)[1]
+
+
+def _k_recover_prelude(r, s, v, xp=jnp):
+    """Checks + x-candidate + y^2 for the whole batch: mirrors the
+    front of ``ec.ecrecover_point`` value-for-value.  ``v`` is the
+    recovery id as a [B] u32 vector.  Returns (x, y_sq, ok)."""
+    r_ok = (xp.uint32(1) - _k_is_zero(r, xp)) * _k_lt_const(r, _N_LIMBS_C, xp)
+    s_ok = (xp.uint32(1) - _k_is_zero(s, xp)) * _k_lt_const(s, _N_LIMBS_C, xp)
+    v_ok = (v < 4).astype(xp.uint32)
+    hi = (v >= 2).astype(xp.uint32)
+    # x = r + (v >= 2 ? N : 0), 17-limb carry chain
+    mask = xp.uint32(MASK)
+    x = []
+    c = xp.zeros_like(r[0])
+    for k in range(16):
+        t = r[k] + hi * xp.uint32(_N_LIMBS_C[k]) + c
+        x.append(t & mask)
+        c = t >> 16
+    x_ok = (c == 0).astype(xp.uint32) * _k_lt_const(x, _P_LIMBS, xp)
+    y_sq = _k_mul(_k_sqr(x, xp), x, xp)
+    seven = [xp.uint32(7) if k == 0 else xp.uint32(0) for k in range(16)]
+    y_sq = _k_carry_tail([a + b for a, b in zip(y_sq, seven)], xp)
+    return x, y_sq, r_ok * s_ok * v_ok * x_ok
+
+
+def _recover_prelude_kernel(r_ref, s_ref, v_ref, x_ref, ysq_ref, ok_ref):
+    x, y_sq, ok = _k_recover_prelude(_read16(r_ref), _read16(s_ref),
+                                     v_ref[0, :])
+    _write16(x_ref, x)
+    _write16(ysq_ref, y_sq)
+    ok_ref[0, :] = ok
+
+
+def recover_prelude_pallas(r, s, v, *, interpret=None):
+    """``r/s [B, 16]`` raw wire scalars, ``v [B]`` recovery id ->
+    ``(x [B, 16], y_sq [B, 16], ok [B])``."""
+    if interpret is None:
+        interpret = _default_interpret()
+    B = r.shape[0]
+    pad = (-B) % LANE_BLOCK
+    rt = jnp.pad(r, ((0, pad), (0, 0))).T
+    st = jnp.pad(s, ((0, pad), (0, 0))).T
+    vt = jnp.pad(v.astype(jnp.uint32), (0, pad)).reshape(1, -1)
+    wide = rt.shape[1]
+    x, ysq, ok = pl.pallas_call(
+        _recover_prelude_kernel,
+        out_shape=(jax.ShapeDtypeStruct((NLIMBS, wide), jnp.uint32),
+                   jax.ShapeDtypeStruct((NLIMBS, wide), jnp.uint32),
+                   jax.ShapeDtypeStruct((1, wide), jnp.uint32)),
+        grid=(wide // LANE_BLOCK,),
+        in_specs=[pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i)),
+                  pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i)),
+                  pl.BlockSpec((1, LANE_BLOCK), lambda i: (0, i))],
+        out_specs=(pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i)),
+                   pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i)),
+                   pl.BlockSpec((1, LANE_BLOCK), lambda i: (0, i))),
+        interpret=interpret,
+    )(rt, st, vt)
+    return x.T[:B], ysq.T[:B], ok[0, :B]
+
+
+def _k_y_fix(root, y_sq, v, xp=jnp):
+    """After the sqrt pow: canonicalize the root, verify it, fix parity
+    to v&1.  Mirrors FP.sqrt's check + ecrecover_point's parity select.
+    Returns (y, y_ok)."""
+    rc = _k_cond_sub_p(_k_sqr(root, xp), xp)
+    ac = _k_cond_sub_p(y_sq, xp)
+    y_ok = xp.ones_like(root[0])
+    for g, w in zip(rc, ac):
+        y_ok = y_ok * (g == w).astype(xp.uint32)
+    y0 = _k_cond_sub_p(root, xp)
+    want_odd = v & xp.uint32(1)
+    flip = want_odd ^ (y0[0] & xp.uint32(1))
+    y = _k_select(flip, _k_neg(y0, xp), y0, xp)
+    return y, y_ok
+
+
+def _y_fix_kernel(root_ref, ysq_ref, v_ref, y_ref, ok_ref):
+    y, ok = _k_y_fix(_read16(root_ref), _read16(ysq_ref), v_ref[0, :])
+    _write16(y_ref, y)
+    ok_ref[0, :] = ok
+
+
+def y_fix_pallas(root, y_sq, v, *, interpret=None):
+    """``(root, y_sq) [B, 16]`` relaxed, ``v [B]`` -> ``(y [B, 16],
+    y_ok [B])``."""
+    if interpret is None:
+        interpret = _default_interpret()
+    B = root.shape[0]
+    pad = (-B) % LANE_BLOCK
+    rt = jnp.pad(root, ((0, pad), (0, 0))).T
+    at = jnp.pad(y_sq, ((0, pad), (0, 0))).T
+    vt = jnp.pad(v.astype(jnp.uint32), (0, pad)).reshape(1, -1)
+    wide = rt.shape[1]
+    y, ok = pl.pallas_call(
+        _y_fix_kernel,
+        out_shape=(jax.ShapeDtypeStruct((NLIMBS, wide), jnp.uint32),
+                   jax.ShapeDtypeStruct((1, wide), jnp.uint32)),
+        grid=(wide // LANE_BLOCK,),
+        in_specs=[pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i)),
+                  pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i)),
+                  pl.BlockSpec((1, LANE_BLOCK), lambda i: (0, i))],
+        out_specs=(pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i)),
+                   pl.BlockSpec((1, LANE_BLOCK), lambda i: (0, i))),
+        interpret=interpret,
+    )(rt, at, vt)
+    return y.T[:B], ok[0, :B]
+
+
+def _k_u1u2(z, s, r_inv, xp=jnp):
+    """u1 = -(z mod N) * r^-1, u2 = s * r^-1 (all canonical mod N);
+    mirrors the u1/u2 block of ``ec.ecrecover_point``."""
+    z_mod = _k_fn_red_cols(list(z) + [xp.zeros_like(z[0])], xp)
+    u1 = _k_fn_neg(_k_fn_mul(z_mod, r_inv, xp), xp)
+    u2 = _k_fn_mul(s, r_inv, xp)
+    return u1, u2
+
+
+def _u1u2_kernel(z_ref, s_ref, rinv_ref, u1_ref, u2_ref):
+    u1, u2 = _k_u1u2(_read16(z_ref), _read16(s_ref), _read16(rinv_ref))
+    _write16(u1_ref, u1)
+    _write16(u2_ref, u2)
+
+
+def u1u2_pallas(z, s, r_inv, *, interpret=None):
+    if interpret is None:
+        interpret = _default_interpret()
+    B = z.shape[0]
+    pad = (-B) % LANE_BLOCK
+    ats = [jnp.pad(a, ((0, pad), (0, 0))).T for a in (z, s, r_inv)]
+    wide = ats[0].shape[1]
+    u1, u2 = pl.pallas_call(
+        _u1u2_kernel,
+        out_shape=tuple(jax.ShapeDtypeStruct((NLIMBS, wide), jnp.uint32)
+                        for _ in range(2)),
+        grid=(wide // LANE_BLOCK,),
+        in_specs=[pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i))] * 3,
+        out_specs=tuple(pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i))
+                        for _ in range(2)),
+        interpret=interpret,
+    )(*ats)
+    return u1.T[:B], u2.T[:B]
+
+
+def _k_limbs_to_words_be(a, xp=jnp):
+    """16 LE 16-bit limbs (one 256-bit value) -> 8 LE u32 words of the
+    value's BIG-endian byte string (keccak input order)."""
+    out = []
+    for w in range(8):
+        # BE bytes 4w..4w+3 come from limbs 15-2w (hi) and 14-2w (lo)
+        hi_l = a[15 - 2 * w]
+        lo_l = a[14 - 2 * w]
+        b0 = hi_l >> xp.uint32(8)
+        b1 = hi_l & xp.uint32(0xFF)
+        b2 = lo_l >> xp.uint32(8)
+        b3 = lo_l & xp.uint32(0xFF)
+        out.append(b0 | (b1 << xp.uint32(8)) | (b2 << xp.uint32(16))
+                   | (b3 << xp.uint32(24)))
+    return out
+
+
+def _k_recover_finish(X, Y, Z, zi_raw, ok_in, xp=jnp):
+    """Jacobian result + raw (relaxed) Z-inverse + accumulated validity
+    -> affine (qx, qy), final ok, and the padded keccak block words of
+    qx||qy.  Mirrors ``to_affine`` + the final selects of
+    ``ecrecover_point`` + the keccak prep of ``pubkey_to_address``."""
+    inf = _k_is_zero_mod(Z, xp)
+    zi = _k_cond_sub_p(zi_raw, xp)   # inv_batched canonicalizes
+    zi2 = _k_sqr(zi, xp)
+    x = _k_cond_sub_p(_k_mul(X, zi2, xp), xp)
+    y = _k_cond_sub_p(_k_mul(Y, _k_mul(zi, zi2, xp), xp), xp)
+    zero = [xp.zeros_like(x[0])] * 16
+    x = _k_select(inf, zero, x, xp)
+    y = _k_select(inf, zero, y, xp)
+    ok = ok_in * (xp.uint32(1) - inf)
+    qx = _k_select(ok, x, zero, xp)
+    qy = _k_select(ok, y, zero, xp)
+    words = (_k_limbs_to_words_be(qx, xp) + _k_limbs_to_words_be(qy, xp))
+    # keccak padding for a 64-byte message in a 136-byte rate block:
+    # byte 64 = 0x01 (word 16 lsb), byte 135 = 0x80 (word 33 msb)
+    z0 = xp.zeros_like(words[0])
+    words.append(z0 + xp.uint32(1))
+    words += [z0] * 16
+    words.append(z0 + xp.uint32(0x80000000))
+    return qx, qy, ok, words
+
+
+def _recover_finish_kernel(x_ref, y_ref, z_ref, zi_ref, ok_ref,
+                           qx_ref, qy_ref, oko_ref, w_ref):
+    qx, qy, ok, words = _k_recover_finish(
+        _read16(x_ref), _read16(y_ref), _read16(z_ref), _read16(zi_ref),
+        ok_ref[0, :])
+    _write16(qx_ref, qx)
+    _write16(qy_ref, qy)
+    oko_ref[0, :] = ok
+    for k in range(34):
+        w_ref[k, :] = words[k]
+
+
+def recover_finish_pallas(X, Y, Z, zi_raw, ok_in, *, interpret=None):
+    """``(X, Y, Z, zi_raw) [B, 16]``, ``ok_in [B]`` -> ``(qx, qy
+    [B, 16] canonical/masked, ok [B], words [34, Bpad])``."""
+    if interpret is None:
+        interpret = _default_interpret()
+    B = X.shape[0]
+    pad = (-B) % LANE_BLOCK
+    ats = [jnp.pad(a, ((0, pad), (0, 0))).T for a in (X, Y, Z, zi_raw)]
+    okt = jnp.pad(ok_in.astype(jnp.uint32), (0, pad)).reshape(1, -1)
+    wide = ats[0].shape[1]
+    qx, qy, ok, words = pl.pallas_call(
+        _recover_finish_kernel,
+        out_shape=(jax.ShapeDtypeStruct((NLIMBS, wide), jnp.uint32),
+                   jax.ShapeDtypeStruct((NLIMBS, wide), jnp.uint32),
+                   jax.ShapeDtypeStruct((1, wide), jnp.uint32),
+                   jax.ShapeDtypeStruct((34, wide), jnp.uint32)),
+        grid=(wide // LANE_BLOCK,),
+        in_specs=[pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i))] * 4
+        + [pl.BlockSpec((1, LANE_BLOCK), lambda i: (0, i))],
+        out_specs=(pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i)),
+                   pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i)),
+                   pl.BlockSpec((1, LANE_BLOCK), lambda i: (0, i)),
+                   pl.BlockSpec((34, LANE_BLOCK), lambda i: (0, i))),
+        interpret=interpret,
+    )(*ats, okt)
+    return qx.T[:B], qy.T[:B], ok[0, :B], words
+
+
+def keccak_rows_pallas(words: jnp.ndarray, *,
+                       interpret: bool | None = None) -> jnp.ndarray:
+    """``[34, wide]`` block words (already limb-major) -> ``[8, wide]``
+    digest words; the transpose-free twin of keccak_block_pallas for
+    the fused pipeline."""
+    if interpret is None:
+        interpret = _default_interpret()
+    wide = words.shape[1]
+    return pl.pallas_call(
+        _keccak_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, wide), jnp.uint32),
+        grid=(wide // LANE_BLOCK,),
+        in_specs=[pl.BlockSpec((34, LANE_BLOCK), lambda b: (0, b))],
+        out_specs=pl.BlockSpec((8, LANE_BLOCK), lambda b: (0, b)),
+        interpret=interpret,
+    )(words)

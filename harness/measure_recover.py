@@ -4,6 +4,7 @@ Usage: measure_recover.py [B ...] (default 256 1024).  Prints compile
 time and per-call wall time; honest workload via models.flagship.
 """
 
+import os
 import sys
 import time
 
@@ -41,14 +42,20 @@ for B in batches:
         else:
             assert not ok[i], f"row {i}"
 
-    sets = [(jnp.asarray(np.roll(sigs[:B], i + 1, axis=0)),
-             jnp.asarray(np.roll(hashes[:B], i + 1, axis=0)))
-            for i in range(4)]
+    # one NEVER-REPEATED input set per timed call: the tunnel backend
+    # memoizes dispatches at (executable, same buffers) granularity and
+    # repeat content measures nothing; a fresh random roll offset per
+    # process guards against cross-process result caching too
+    base = int.from_bytes(os.urandom(2), "big") + 16
+    sets = [(jnp.asarray(np.roll(sigs[:B], base + i, axis=0)),
+             jnp.asarray(np.roll(hashes[:B], base + i, axis=0)))
+            for i in range(9)]
     jax.block_until_ready(sets)
-    reps = 6
+    jax.block_until_ready(fn(*sets[0]))  # warm-up on a fresh set
+    reps = len(sets) - 1
     t0 = time.perf_counter()
-    for i in range(reps):
-        a, b = sets[i % 4]
+    for i in range(1, len(sets)):
+        a, b = sets[i]
         jax.block_until_ready(fn(a, b))
     per_call = (time.perf_counter() - t0) / reps
     print(f"B={B}: compile {compile_s:.1f}s  per-call {per_call*1e3:.1f} ms"

@@ -1,0 +1,96 @@
+"""Per-kernel fresh-content timing on the live backend.
+
+The tunnel runtime memoizes repeat dispatches (and appears to serve
+repeat CONTENT from a cache), so every timed call here gets its own
+never-repeated random operands — the only protocol that matches
+independent full-pipeline runs.  Writes KERNEL_PROFILE2.json.
+"""
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+from eges_tpu.ops import bigint
+from eges_tpu.ops.pallas_kernels import (
+    NLIMBS, P, STRAUSS_OPS, fp_mul_pallas, keccak_block_pallas,
+    point_table_pallas, pow_mod_pallas, strauss_stream,
+)
+
+GLV_WINDOWS = 33
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+rng = np.random.default_rng()
+
+
+def fresh_limbs(n):
+    # random 16-bit limbs: valid relaxed field encodings, never repeated
+    return jnp.asarray(rng.integers(0, 2**16, (n, NLIMBS), dtype=np.uint32))
+
+
+def timeit_unique(fn, gen, reps=6):
+    args0 = gen()
+    jax.block_until_ready(fn(*args0))
+    argsets = [gen() for _ in range(reps)]
+    jax.block_until_ready(argsets)
+    t0 = time.perf_counter()
+    for a in argsets:
+        jax.block_until_ready(fn(*a))
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    print("device:", jax.devices()[0], " B =", B, flush=True)
+    res = {"device": str(jax.devices()[0]), "batch": B}
+
+    t = timeit_unique(jax.jit(fp_mul_pallas),
+                      lambda: (fresh_limbs(B), fresh_limbs(B)))
+    res["fp_mul_ms"] = round(t * 1e3, 3)
+    print(f"fp_mul        {t*1e3:8.3f} ms", flush=True)
+
+    for name, e, m in (("inv_p", P - 2, "p"), ("sqrt_p", (P + 1) // 4, "p"),
+                       ("inv_n", bigint.N - 2, "n")):
+        t = timeit_unique(
+            jax.jit(functools.partial(pow_mod_pallas, e=e, modulus=m)),
+            lambda: (fresh_limbs(B),))
+        res[f"pow_{name}_ms"] = round(t * 1e3, 3)
+        print(f"pow_{name:8s} {t*1e3:8.3f} ms", flush=True)
+
+    t = timeit_unique(jax.jit(point_table_pallas),
+                      lambda: (fresh_limbs(B), fresh_limbs(B)))
+    res["point_table_ms"] = round(t * 1e3, 3)
+    print(f"point_table   {t*1e3:8.3f} ms", flush=True)
+
+    def strauss_gen():
+        opx = jnp.asarray(rng.integers(
+            0, 2**16, (GLV_WINDOWS, STRAUSS_OPS * NLIMBS, B), dtype=np.uint32))
+        opy = jnp.asarray(rng.integers(
+            0, 2**16, (GLV_WINDOWS, STRAUSS_OPS * NLIMBS, B), dtype=np.uint32))
+        nz = jnp.asarray(rng.integers(
+            0, 2, (GLV_WINDOWS, 8, B), dtype=np.uint32))
+        return opx, opy, nz
+
+    t = timeit_unique(jax.jit(functools.partial(strauss_stream, batch=B)),
+                      strauss_gen, reps=4)
+    res["strauss_ms"] = round(t * 1e3, 3)
+    print(f"strauss       {t*1e3:8.3f} ms", flush=True)
+
+    t = timeit_unique(
+        jax.jit(keccak_block_pallas),
+        lambda: (jnp.asarray(rng.integers(0, 2**32, (B, 34),
+                                          dtype=np.int64).astype(np.uint32)),))
+    res["keccak_ms"] = round(t * 1e3, 3)
+    print(f"keccak        {t*1e3:8.3f} ms", flush=True)
+
+    with open("/root/repo/KERNEL_PROFILE2.json", "w") as f:
+        json.dump(res, f, indent=1)
+    print(json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    main()

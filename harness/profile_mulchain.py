@@ -1,0 +1,100 @@
+"""Decisive layout microbenchmark: 64 chained F_P multiplies in ONE
+kernel, so neither dispatch memoization nor async futures can fake the
+timing (single launch, one output, a strict data dependency chain).
+
+Variant A: limb rows as [LANE]-wide 1-D vectors ((1, LANE) vregs — the
+current in-kernel layout, 1/8 sublane utilization).
+Variant B: limb rows as (8, 128) blocks — full vregs.
+
+If B wins ~8x per element, the whole in-kernel field library should
+move to (8, 128) rows.
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+sys.path.insert(0, "/root/repo")
+
+from eges_tpu.ops.pallas_kernels import NLIMBS, _k_mul
+
+CHAIN = 64
+rng = np.random.default_rng()
+
+
+def _chain_kernel_1d(a_ref, b_ref, o_ref):
+    a = [a_ref[k, :] for k in range(NLIMBS)]
+    b = [b_ref[k, :] for k in range(NLIMBS)]
+    for _ in range(CHAIN):
+        a = _k_mul(a, b)
+    for k in range(NLIMBS):
+        o_ref[k, :] = a[k]
+
+
+def _chain_kernel_8x(a_ref, b_ref, o_ref):
+    a = [a_ref[0, 8 * k:8 * (k + 1), :] for k in range(NLIMBS)]
+    b = [b_ref[0, 8 * k:8 * (k + 1), :] for k in range(NLIMBS)]
+    for _ in range(CHAIN):
+        a = _k_mul(a, b)
+    for k in range(NLIMBS):
+        o_ref[0, 8 * k:8 * (k + 1), :] = a[k]
+
+
+def run_1d(a, b, lane):
+    wide = a.shape[1]
+    return pl.pallas_call(
+        _chain_kernel_1d,
+        out_shape=jax.ShapeDtypeStruct((NLIMBS, wide), jnp.uint32),
+        grid=(wide // lane,),
+        in_specs=[pl.BlockSpec((NLIMBS, lane), lambda i: (0, i))] * 2,
+        out_specs=pl.BlockSpec((NLIMBS, lane), lambda i: (0, i)),
+    )(a, b)
+
+
+def timeit(fn, *args, reps=4):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    B = 4096
+    print("device:", jax.devices()[0], " B =", B, " chain =", CHAIN,
+          flush=True)
+    a1 = jnp.asarray(rng.integers(0, 2**16, (NLIMBS, B), dtype=np.uint32))
+    b1 = jnp.asarray(rng.integers(0, 2**16, (NLIMBS, B), dtype=np.uint32))
+    for lane in (256, 1024):
+        t = timeit(jax.jit(lambda a, b, lane=lane: run_1d(a, b, lane)),
+                   a1, b1)
+        per_mul_ns = t / (CHAIN * B) * 1e9
+        print(f"1-D rows lane={lane}: {t*1e3:8.3f} ms"
+              f"  ({per_mul_ns:6.2f} ns/row-mul)", flush=True)
+
+    nb = B // 1024
+    a8 = jnp.asarray(rng.integers(0, 2**16, (nb, NLIMBS * 8, 128),
+                                  dtype=np.uint32))
+    b8 = jnp.asarray(rng.integers(0, 2**16, (nb, NLIMBS * 8, 128),
+                                  dtype=np.uint32))
+    t = timeit(jax.jit(lambda a, b: pl.pallas_call(
+        _chain_kernel_8x,
+        out_shape=jax.ShapeDtypeStruct((nb, NLIMBS * 8, 128), jnp.uint32),
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((1, NLIMBS * 8, 128),
+                               lambda i: (i, 0, 0))] * 2,
+        out_specs=pl.BlockSpec((1, NLIMBS * 8, 128),
+                               lambda i: (i, 0, 0)))(a, b)), a8, b8)
+    per_mul_ns = t / (CHAIN * B) * 1e9
+    print(f"(8,128) rows:        {t*1e3:8.3f} ms"
+          f"  ({per_mul_ns:6.2f} ns/row-mul)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""Stage-level timing of the fused (v2) recover pipeline on the chip.
+
+Times jitted PREFIXES of the fused pipeline; successive differences
+attribute wall time to the scalar stage (composite prelude + pows +
+u1/u2), the double-scalar multiply (GLV kernel + table build + ladder)
+and the finish/keccak tail.  EVERY stage gets its own never-repeated
+random inputs (the tunnel backend memoizes repeat dispatches AND
+shares per-dispatch results across executables with common prefixes,
+so reused content measures nothing).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from eges_tpu.crypto.verifier import _unpack, ecrecover_batch
+from eges_tpu.models.flagship import example_batch
+from eges_tpu.ops import bigint, ec
+from eges_tpu.ops.pallas_kernels import (
+    pow_mod_pallas, recover_prelude_pallas, u1u2_pallas, y_fix_pallas,
+)
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+
+
+def _scalar_stage(sigs, hashes):
+    z, r, s, v = _unpack(sigs, hashes)
+    x, y_sq, ok0 = recover_prelude_pallas(r, s, v)
+    root = pow_mod_pallas(y_sq, (bigint.P + 1) // 4, "p")
+    y, y_ok = y_fix_pallas(root, y_sq, v)
+    r_inv = pow_mod_pallas(r, bigint.N - 2, "n")
+    u1, u2 = u1u2_pallas(z, s, r_inv)
+    return u1, u2, x, y, ok0 * y_ok
+
+
+def _through_ladder(sigs, hashes):
+    u1, u2, x, y, ok = _scalar_stage(sigs, hashes)
+    return ec.strauss_gR(u1, u2, x, y), ok
+
+
+def timeit(fn, sets):
+    out = fn(*sets[0])
+    jax.block_until_ready(out)
+    reps = len(sets) - 1
+    t0 = time.perf_counter()
+    for i in range(1, len(sets)):
+        jax.block_until_ready(fn(*sets[i]))
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    print("device:", jax.devices()[0], flush=True)
+    sigs, hashes, _, _ = example_batch(B, invalid_every=17)
+
+    stages = [
+        ("scalar_stage", _scalar_stage),
+        ("through_ladder", _through_ladder),
+        ("full", ecrecover_batch),
+    ]
+    prev = 0.0
+    for name, fn in stages:
+        base = int.from_bytes(os.urandom(2), "big") + 16
+        sets = [(jnp.asarray(np.roll(sigs, base + i, axis=0)),
+                 jnp.asarray(np.roll(hashes, base + i, axis=0)))
+                for i in range(7)]
+        jax.block_until_ready(sets)
+        t0 = time.perf_counter()
+        jf = jax.jit(fn)
+        jax.block_until_ready(jf(*sets[0]))
+        comp = time.perf_counter() - t0
+        t = timeit(jf, sets)
+        print(f"{name:16s} compile {comp:6.1f}s  per-call {t*1e3:8.2f} ms"
+              f"  (+{(t-prev)*1e3:7.2f} ms)", flush=True)
+        prev = t
+
+
+if __name__ == "__main__":
+    main()

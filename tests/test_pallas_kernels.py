@@ -498,3 +498,201 @@ def test_glue_kernels_interpret():
     np.testing.assert_array_equal(
         np.asarray(mulhi8_pallas(ka, _G_G1, interpret=True)),
         np.asarray(bigint.big_mul(ka, gb)[..., 24:32]))
+
+
+def test_strauss_tab_math_matches_graph_path():
+    """The self-gathering ladder kernel (round-4 v2): in-kernel one-hot
+    table lookups + sign folds must reproduce the plain XLA strauss_gR
+    bit-for-bit, consuming exactly what pack_strauss_tab_inputs feeds
+    the real kernel (digit order, sign rows, re-rowed R tables, lane
+    padding)."""
+    from eges_tpu.ops import ec
+    from eges_tpu.ops.bigint import N
+    from eges_tpu.ops.pallas_kernels import strauss_tab_np
+
+    n = 4
+    rx, ry = _affine_batch(n)
+    u1_l = [0, 1, rng.randrange(N), rng.randrange(N)]  # incl. zero scalar
+    u2_l = [rng.randrange(N), 0, 1, rng.randrange(N)]
+    u1 = jnp.asarray(np.stack([int_to_limbs(v) for v in u1_l]))
+    u2 = jnp.asarray(np.stack([int_to_limbs(v) for v in u2_l]))
+
+    (digits, negs, _, _, r_tab) = ec._strauss_prelude(u1, u2, rx, ry)
+    args = ec.pack_strauss_tab_inputs(digits, negs, r_tab)
+    got = strauss_tab_np(*[np.asarray(a) for a in args])
+    want = ec.strauss_gR(u1, u2, rx, ry)  # plain XLA path (CPU backend)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(_untq(g)[:n], np.asarray(w))
+
+
+def test_glv_digits_kernel_matches_graph_path():
+    """The GLV-decompose kernel's math (numpy twin) must emit exactly
+    the digit/sign arrays the XLA prelude builds (same lattice split,
+    sign test, digit order) for random and edge scalars."""
+    from eges_tpu.ops import ec
+    from eges_tpu.ops.bigint import N
+    from eges_tpu.ops.pallas_kernels import glv_digits_np
+
+    n = 6
+    vals1 = [0, 1, N - 1, rng.randrange(N), rng.randrange(N),
+             rng.randrange(N)]
+    vals2 = [N - 2, 0, 1, rng.randrange(N), rng.randrange(N), 2]
+    u1 = jnp.asarray(np.stack([int_to_limbs(v) for v in vals1]))
+    u2 = jnp.asarray(np.stack([int_to_limbs(v) for v in vals2]))
+
+    k1s, n1s, k2s, n2s = ec._glv_decompose(jnp.stack([u1, u2]))
+    digits = (ec._digits33(k1s[0]), ec._digits33(k2s[0]),
+              ec._digits33(k1s[1]), ec._digits33(k2s[1]))
+    negs = (n1s[0], n2s[0], n1s[1], n2s[1])
+    rtab = tuple(jnp.zeros((16, n, 16), jnp.uint32) for _ in range(3))
+    dig_want, neg_want, *_ = ec.pack_strauss_tab_inputs(digits, negs, rtab)
+
+    dig_got, neg_got = glv_digits_np(np.asarray(u1), np.asarray(u2))
+    np.testing.assert_array_equal(dig_got, np.asarray(dig_want)[:, :, :n])
+    np.testing.assert_array_equal(neg_got, np.asarray(neg_want)[:, :n])
+
+
+def test_recover_prelude_kernel_math():
+    """_k_recover_prelude (numpy) vs the graph front of ecrecover_point:
+    range checks, x-candidate, y^2 — value-for-value on valid rows and
+    every invalid class (r=0, r>=N, s>=N, v>3, x>=P)."""
+    from eges_tpu.ops import bigint, ec
+    from eges_tpu.ops.bigint import FN, FP, N, NLIMBS, is_zero, select
+    from eges_tpu.ops.pallas_kernels import _k_recover_prelude
+
+    rows = [
+        (rng.randrange(1, N), rng.randrange(1, N), 0),
+        (rng.randrange(1, N), rng.randrange(1, N), 1),
+        (rng.randrange(1, N), rng.randrange(1, N), 2),   # x = r + N path
+        (rng.randrange(1, N), rng.randrange(1, N), 3),
+        (0, rng.randrange(1, N), 0),                     # r = 0
+        (N + 5, rng.randrange(1, N), 0),                 # r >= N
+        (rng.randrange(1, N), N, 1),                     # s >= N
+        (rng.randrange(1, N), rng.randrange(1, N), 7),   # bad v
+        (P - N, 1, 2),                                   # r + N == P exactly
+    ]
+    r = jnp.asarray(np.stack([int_to_limbs(a % (1 << 256)) for a, _, _ in rows]))
+    s = jnp.asarray(np.stack([int_to_limbs(b % (1 << 256)) for _, b, _ in rows]))
+    v = jnp.asarray(np.asarray([c for _, _, c in rows], np.uint32))
+
+    # graph reference (plain path ops on CPU)
+    n_lim = jnp.broadcast_to(FN.m_limbs, r.shape)
+    p_lim = jnp.broadcast_to(FP.m_limbs, r.shape)
+    r_ok = (1 - is_zero(r)) * bigint.big_lt(r, n_lim)
+    s_ok = (1 - is_zero(s)) * bigint.big_lt(s, n_lim)
+    v_ok = (v < 4).astype(jnp.uint32)
+    hi = (v >= 2).astype(jnp.uint32)
+    x_wide = bigint.big_add(r, select(hi, n_lim, jnp.zeros_like(r)),
+                            NLIMBS + 1)
+    x_ok = is_zero(x_wide[..., NLIMBS:]) * bigint.big_lt(
+        x_wide[..., :NLIMBS], p_lim)
+    x_want = x_wide[..., :NLIMBS]
+    y_sq_want = FP.add(FP.mul(FP.sqr(x_want), x_want), ec._const(7, x_want))
+    ok_want = r_ok * s_ok * v_ok * x_ok
+
+    x_got, ysq_got, ok_got = _k_recover_prelude(
+        _t(r), _t(s), np.asarray(v), np)
+    np.testing.assert_array_equal(_untq(x_got), np.asarray(x_want))
+    np.testing.assert_array_equal(_untq(ysq_got), np.asarray(y_sq_want))
+    np.testing.assert_array_equal(np.asarray(ok_got), np.asarray(ok_want))
+
+
+def test_y_fix_kernel_math():
+    """_k_y_fix vs the graph sqrt-check/canon/parity block, same root
+    input on both sides (incl. a non-residue row where y_ok = 0)."""
+    from eges_tpu.ops.bigint import FP
+    from eges_tpu.ops.pallas_kernels import _k_y_fix
+
+    vals = []
+    while len(vals) < 3:  # quadratic residues
+        c = rng.randrange(P)
+        if pow(c, (P - 1) // 2, P) == 1:
+            vals.append(c)
+    nonres = next(c for c in range(2, 50)
+                  if pow(c, (P - 1) // 2, P) == P - 1)
+    vals.append(nonres)
+    y_sq = jnp.asarray(np.stack([int_to_limbs(v) for v in vals]))
+    v = jnp.asarray(np.asarray([0, 1, 0, 1], np.uint32))
+    root = FP.pow_const(y_sq, (P + 1) // 4)
+
+    ok_want = FP.eq_mod(FP.sqr(root), y_sq)
+    from eges_tpu.ops.bigint import select
+    y0 = FP.canon(root)
+    want_odd = (v & 1).astype(jnp.uint32)
+    y_odd = (y0[..., 0] & 1).astype(jnp.uint32)
+    y_want = select(want_odd ^ y_odd, FP.neg(y0), y0)
+
+    y_got, ok_got = _k_y_fix(_t(root), _t(y_sq), np.asarray(v), np)
+    np.testing.assert_array_equal(_untq(y_got), np.asarray(y_want))
+    np.testing.assert_array_equal(np.asarray(ok_got), np.asarray(ok_want))
+
+
+def test_u1u2_kernel_math():
+    """_k_u1u2 vs the graph u1/u2 block (z reduction, r^-1 products)."""
+    from eges_tpu.ops.bigint import FN, N
+    from eges_tpu.ops.pallas_kernels import _k_u1u2
+
+    n = 5
+    zs = [rng.randrange(1 << 256) for _ in range(n)]
+    ss = [rng.randrange(1, N) for _ in range(n)]
+    rs = [rng.randrange(1, N) for _ in range(n)]
+    z = jnp.asarray(np.stack([int_to_limbs(v) for v in zs]))
+    s = jnp.asarray(np.stack([int_to_limbs(v) for v in ss]))
+    r_inv = FN.inv_batched(jnp.asarray(np.stack([int_to_limbs(v)
+                                                 for v in rs])))
+    z_mod = FN.red(jnp.pad(z, ((0, 0), (0, 1))))
+    u1_want = FN.neg(FN.mul(z_mod, r_inv))
+    u2_want = FN.mul(s, r_inv)
+
+    u1_got, u2_got = _k_u1u2(_t(z), _t(s), _t(r_inv), np)
+    np.testing.assert_array_equal(_untq(u1_got), np.asarray(u1_want))
+    np.testing.assert_array_equal(_untq(u2_got), np.asarray(u2_want))
+    for zv, rv, row in zip(zs, rs, _untq(u1_got)):
+        assert limbs_to_int(row) == (-zv * pow(rv, -1, N)) % N
+
+
+def test_recover_finish_kernel_math():
+    """_k_recover_finish vs to_affine + final selects + keccak word
+    packing (incl. an infinity row and an ok=0 row)."""
+    from eges_tpu.ops.bigint import FP, select
+    from eges_tpu.ops.ec import to_affine
+    from eges_tpu.ops.keccak_tpu import RATE
+    from eges_tpu.ops.pallas_kernels import _k_recover_finish
+
+    n = 5
+    X, Y, Z = (np.asarray(t).copy() for t in _rand_point_batch(n))
+    Z[2] = 0  # infinity row
+    ok_in = np.asarray([1, 0, 1, 1, 1], np.uint32)
+    Xj, Yj, Zj = (jnp.asarray(t) for t in (X, Y, Z))
+
+    zi_raw = FP.pow_const(Zj, P - 2)  # relaxed, like the pow kernel leg
+    inf = FP.is_zero_mod(Zj)
+    zi = FP.canon(zi_raw)
+    zi2 = FP.sqr(zi)
+    x = FP.canon(FP.mul(Xj, zi2))
+    y = FP.canon(FP.mul(Yj, FP.mul(zi, zi2)))
+    zero = jnp.zeros_like(x)
+    x = select(inf, zero, x)
+    y = select(inf, zero, y)
+    ok_want = jnp.asarray(ok_in) * (1 - inf)
+    qx_want = select(ok_want, x, zero)
+    qy_want = select(ok_want, y, zero)
+
+    qx, qy, ok, words = _k_recover_finish(
+        _t(Xj), _t(Yj), _t(Zj), _t(zi_raw), ok_in, np)
+    np.testing.assert_array_equal(_untq(qx), np.asarray(qx_want))
+    np.testing.assert_array_equal(_untq(qy), np.asarray(qy_want))
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_want))
+
+    # word packing vs the reference padding construction
+    qx_i = [limbs_to_int(row) for row in _untq(qx)]
+    qy_i = [limbs_to_int(row) for row in _untq(qy)]
+    for i in range(n):
+        msg = qx_i[i].to_bytes(32, "big") + qy_i[i].to_bytes(32, "big")
+        buf = bytearray(RATE)
+        buf[:64] = msg
+        buf[64] ^= 0x01
+        buf[RATE - 1] ^= 0x80
+        want_words = np.frombuffer(bytes(buf), "<u4")
+        got_words = np.asarray([w[i] for w in words], np.uint32)
+        np.testing.assert_array_equal(got_words, want_words)
