@@ -66,7 +66,15 @@ from jax.experimental import pallas as pl
 
 from eges_tpu.ops.bigint import MASK, NLIMBS, P, int_to_limbs
 
-LANE_BLOCK = 256  # batch columns per kernel invocation
+# Batch columns per kernel grid step.  Env-tunable for hardware A/B:
+# larger blocks mean fewer grid steps (and more VMEM per step — the
+# strauss_tab tables cost 3 x 1 KB per column).  256 is the proven
+# default; override with EGES_TPU_LANE_BLOCK=1024 to test.
+LANE_BLOCK = int(os.environ.get("EGES_TPU_LANE_BLOCK", "256"))
+if LANE_BLOCK <= 0 or LANE_BLOCK % 128:
+    raise ValueError(
+        f"EGES_TPU_LANE_BLOCK={LANE_BLOCK}: must be a positive multiple "
+        "of 128 (TPU lane width)")
 
 _P_LIMBS = [int(v) for v in int_to_limbs(P)]
 _SUBC_LIMBS = [int(v) for v in int_to_limbs((1 << 256) - 2 * ((1 << 256) - P) + 1)]

@@ -535,8 +535,16 @@ def loadtest(dirpath: str, n: int, seconds: float, *, n_udp=300,
             # problem, and the native default exists precisely for
             # many-node single-host rigs).  The assertion is the
             # HONEST share: device rows only, no C++ batch rows.
-            jmet = rpc("thw_metrics", [], port=RPC_BASE + jax_node,
-                       timeout=60, tries=5)
+            try:
+                jmet = rpc("thw_metrics", [], port=RPC_BASE + jax_node,
+                           timeout=60, tries=5)
+            except Exception as exc:
+                # an overloaded 1-core rig can starve the device node's
+                # RPC loop for minutes; that's a FAIL verdict for this
+                # mode, not a harness crash (4-node rigs hit this)
+                print(f"[loadtest] jax node{jax_node}: metrics RPC "
+                      f"unreachable ({exc}) — mode FAIL")
+                jmet = {}
             jshare = jmet.get("verifier.device_share")
             jrows = jmet.get("verifier.rows", {})
             jrows = jrows.get("count", 0) if isinstance(jrows, dict) else jrows
@@ -548,13 +556,17 @@ def loadtest(dirpath: str, n: int, seconds: float, *, n_udp=300,
         # device graph and may still be catching up a fast-moving head
         # — traffic still entered through it, which is what the mode
         # exercises
-        rec = rpc("eth_getTransactionReceipt", [txh], port=qport)
-        h = int(rpc("eth_blockNumber", [], port=qport), 16)
+        # same starvation tolerance for the chain-state node: retried,
+        # generous timeouts — a busy loop is a slow answer, not a crash
+        rec = rpc("eth_getTransactionReceipt", [txh], port=qport,
+                  timeout=30, tries=4)
+        h = int(rpc("eth_blockNumber", [], port=qport,
+                    timeout=30, tries=4), 16)
         geec_total = sum(
             rpc("eth_getBlockByNumber", [hex(b), False],
-                port=qport)["geecTxnCount"]
+                port=qport, timeout=30, tries=2)["geecTxnCount"]
             for b in range(1, h + 1))
-        met = rpc("thw_metrics", [], port=qport)
+        met = rpc("thw_metrics", [], port=qport, timeout=30, tries=4)
         share = met.get("verifier.device_share")
         bshare = met.get("verifier.batched_share")
         print(f"[loadtest] height={h} geec_on_chain={geec_total}/{n_udp} "
