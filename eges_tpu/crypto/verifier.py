@@ -43,6 +43,15 @@ def _unpack(sigs: jnp.ndarray, hashes: jnp.ndarray):
     return z, r, s, v
 
 
+def addr_from_digest_rows(dig: jnp.ndarray, B: int) -> jnp.ndarray:
+    """``[8, Bpad]`` LE keccak digest words -> ``[B, 20]`` u8 addresses
+    (digest bytes 12..31, i.e. LE words 3..7) — the address tail of the
+    fused pipeline (ref role: crypto/crypto.go PubkeyToAddress)."""
+    dw = dig[3:8, :B]
+    ab = jnp.stack([(dw >> (8 * j)) & 0xFF for j in range(4)], axis=1)
+    return ab.transpose(2, 0, 1).reshape(B, 20).astype(jnp.uint8)
+
+
 def ecrecover_batch(sigs: jnp.ndarray, hashes: jnp.ndarray):
     """Batched sender recovery.
 
@@ -62,10 +71,7 @@ def ecrecover_batch(sigs: jnp.ndarray, hashes: jnp.ndarray):
         # finish kernel already packed the (masked) keccak block words
         B = sigs.shape[0]
         qx, qy, ok, words = ec.ecrecover_point_fused(z, r, s, v)
-        dig = keccak_rows_pallas(words)
-        dw = dig[3:8, :B]  # digest bytes 12..31 = LE words 3..7
-        ab = jnp.stack([(dw >> (8 * j)) & 0xFF for j in range(4)], axis=1)
-        addrs = ab.transpose(2, 0, 1).reshape(B, 20).astype(jnp.uint8)
+        addrs = addr_from_digest_rows(keccak_rows_pallas(words), B)
     else:
         qx, qy, ok = ec.ecrecover_point(z, r, s, v)
         addrs = None

@@ -56,6 +56,39 @@ def initialize(coordinator: str, num_processes: int, process_id: int,
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)
+    # LOCALHOST rigs only (the dryrun launcher sets the env): a real
+    # multi-machine CPU deployment must NOT pin gloo to loopback, or
+    # every cross-host connect dials the wrong machine.
+    if os.environ.get("EGES_TPU_GLOO_LOOPBACK") == "1":
+        _pin_gloo_loopback()
+
+
+def _pin_gloo_loopback() -> None:
+    """Re-register the CPU backend factory with gloo collectives pinned
+    to the loopback interface.
+
+    jax builds gloo with ``hostname=None, interface=None`` and gloo then
+    binds a NIC from its own discovery; inside this sandboxed host that
+    picked an interface whose worker-to-worker connects time out
+    ("Gloo context initialization failed: Connect timeout") even though
+    the hostname resolves to 127.0.0.1.  The dry run is strictly
+    localhost, so pin both ends to loopback.  Harmless on real
+    multi-host TPO deployments: those use the native ICI/DCN stack, not
+    the CPU gloo transport."""
+    from jax._src import distributed, xla_bridge
+    from jaxlib import xla_client
+
+    def make(*_a, **_kw):
+        collectives = xla_client._xla.make_gloo_tcp_collectives(
+            distributed_client=distributed.global_state.client,
+            hostname="127.0.0.1")
+        return xla_bridge.make_cpu_client(collectives=collectives)
+
+    # same flags as jax's own cpu registration; the factory table is
+    # keyed by name, so this simply replaces the default factory (it
+    # must run before the first backend use or jax raises)
+    xla_bridge.register_backend_factory("cpu", make, priority=0,
+                                        fail_quietly=False)
 
 
 def global_mesh(axis: str = "dp"):
@@ -111,7 +144,18 @@ def _worker_body(process_id: int, num_processes: int,
 
     gsigs, ghashes = make_global_rows(mesh, "dp", sigs, hashes)
     fn = make_sharded_ecrecover(mesh, "dp")
-    addrs, _pubs, ok, tally = fn(gsigs, ghashes)
+    # Compile ahead-of-time, then meet at a COORDINATION-SERVICE
+    # barrier (not a collective) before the first execution.  The gloo
+    # transport rendezvouses lazily at the first collective with ~30 s
+    # timeouts; on a 1-core host one worker can hit the persistent
+    # compile cache while the other compiles from scratch, and that
+    # skew alone blew the rendezvous ("Gloo context initialization
+    # failed: Connect timeout / GetKeyValue() timed out").
+    compiled = fn.lower(gsigs, ghashes).compile()  # fn is jitted already
+    from jax._src import distributed as _dist
+    _dist.global_state.client.wait_at_barrier("eges_compiled",
+                                              timeout_in_ms=900_000)
+    addrs, _pubs, ok, tally = compiled(gsigs, ghashes)
 
     # the psum tally is replicated: every process holds the global count
     assert int(tally) == rows, f"pid {process_id}: tally {int(tally)} != {rows}"
@@ -154,6 +198,9 @@ def dryrun_multihost(num_processes: int = 2, devices_per_proc: int = 4,
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU-tunnel plugin in workers
+    # the dryrun is strictly localhost: have the workers rebuild their
+    # gloo collectives pinned to loopback (see _pin_gloo_loopback)
+    env["EGES_TPU_GLOO_LOOPBACK"] = "1"
     flags = [f for f in env.get("XLA_FLAGS", "").split()
              if not f.startswith("--xla_force_host_platform_device_count")]
     env["XLA_FLAGS"] = " ".join(

@@ -557,16 +557,22 @@ def loadtest(dirpath: str, n: int, seconds: float, *, n_udp=300,
         # — traffic still entered through it, which is what the mode
         # exercises
         # same starvation tolerance for the chain-state node: retried,
-        # generous timeouts — a busy loop is a slow answer, not a crash
-        rec = rpc("eth_getTransactionReceipt", [txh], port=qport,
-                  timeout=30, tries=4)
-        h = int(rpc("eth_blockNumber", [], port=qport,
-                    timeout=30, tries=4), 16)
-        geec_total = sum(
-            rpc("eth_getBlockByNumber", [hex(b), False],
-                port=qport, timeout=30, tries=2)["geecTxnCount"]
-            for b in range(1, h + 1))
-        met = rpc("thw_metrics", [], port=qport, timeout=30, tries=4)
+        # generous timeouts, and exhaustion is a FAIL verdict — a busy
+        # loop is a slow answer, not a harness crash
+        try:
+            rec = rpc("eth_getTransactionReceipt", [txh], port=qport,
+                      timeout=30, tries=4)
+            h = int(rpc("eth_blockNumber", [], port=qport,
+                        timeout=30, tries=4), 16)
+            geec_total = sum(
+                rpc("eth_getBlockByNumber", [hex(b), False],
+                    port=qport, timeout=30, tries=2)["geecTxnCount"]
+                for b in range(1, h + 1))
+            met = rpc("thw_metrics", [], port=qport, timeout=30, tries=4)
+        except Exception as exc:
+            print(f"[loadtest] chain-state RPC on port {qport} "
+                  f"unreachable ({exc}) — FAIL")
+            return False
         share = met.get("verifier.device_share")
         bshare = met.get("verifier.batched_share")
         print(f"[loadtest] height={h} geec_on_chain={geec_total}/{n_udp} "

@@ -696,3 +696,20 @@ def test_recover_finish_kernel_math():
         want_words = np.frombuffer(bytes(buf), "<u4")
         got_words = np.asarray([w[i] for w in words], np.uint32)
         np.testing.assert_array_equal(got_words, want_words)
+
+
+def test_addr_from_digest_rows():
+    """The fused pipeline's address extraction (digest LE words 3..7 ->
+    20 address bytes) against the host golden keccak."""
+    from eges_tpu.crypto.keccak import keccak256
+    from eges_tpu.crypto.verifier import addr_from_digest_rows
+
+    msgs = [bytes(range(64)), rng.randbytes(64), b"\x00" * 64]
+    B = len(msgs)
+    dig = np.zeros((8, 256), np.uint32)  # padded wide like keccak_rows
+    for i, m in enumerate(msgs):
+        d = keccak256(m)
+        dig[:, i] = np.frombuffer(d, "<u4")
+    got = np.asarray(addr_from_digest_rows(jnp.asarray(dig), B))
+    for i, m in enumerate(msgs):
+        assert bytes(got[i]) == keccak256(m)[12:], f"msg {i}"
