@@ -335,7 +335,45 @@ def main() -> None:
                         _log(f"LADDER A/B: {json.dumps(ab)}")
         else:
             _log("bench produced no TPU-device line")
+        if res is not None:
+            _run_experiments()
         time.sleep(SETTLED_PERIOD_S if captured_full else PROBE_PERIOD_S)
+
+
+_EXP_DONE = os.path.join(_DIR, "experiments_done")
+
+
+def _run_experiments() -> None:
+    """Queued one-shot hardware A/Bs, run once per watcher lifetime the
+    first time a bench lands while the tunnel is alive:
+
+    * mulchain layout microbenchmark ((1, LANE) vs (8, 128) limb rows —
+      the decisive un-fakeable per-mul timing, round-4 lead #1)
+    * LANE_BLOCK=1024 full-pipeline A/B at 1024 rows (fewer grid steps)
+
+    Results go to .tpu_watch/experiments.log for the next session."""
+    if os.path.exists(_EXP_DONE):
+        return
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    outp = os.path.join(_DIR, "experiments.log")
+    jobs = [
+        ("mulchain", [sys.executable,
+                      os.path.join(_REPO, "harness/profile_mulchain.py")],
+         env),
+        ("lane1024", [sys.executable,
+                      os.path.join(_REPO, "harness/measure_recover.py"),
+                      "1024"],
+         {**env, "EGES_TPU_LANE_BLOCK": "1024"}),
+    ]
+    with open(outp, "a") as f:
+        for name, argv, jenv in jobs:
+            rc, out = _run_child(argv, 600, jenv)
+            f.write(f"=== {name} rc={rc} at "
+                    f"{time.strftime('%H:%M:%S')} ===\n{out}\n")
+            _log(f"experiment {name}: rc={rc}")
+    with open(_EXP_DONE, "w") as f:
+        f.write(time.strftime("%Y-%m-%dT%H:%M:%S"))
 
 
 if __name__ == "__main__":
