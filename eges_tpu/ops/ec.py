@@ -487,58 +487,6 @@ def pack_strauss_tab_inputs(digits, negs, r_tab):
         _table_rows(try_, B)
 
 
-def pack_strauss_operands(digits, negs, g_tab, lam_tab, r_tab):
-    """Gather + sign-fold the four table operands for EVERY window of
-    the Strauss ladder at once (the streamed kernel's diet: XLA does
-    the vectorized lookups it is good at, the kernel does the field
-    arithmetic it is good at).
-
-    Returns ``(opx, opy, nz)`` shaped ``[W, 64, Bpad]`` / ``[W, 64,
-    Bpad]`` / ``[W, 8, Bpad]`` in window-processing order (MSD first):
-    operand ``t``'s limbs live in rows ``16t..16t+15``.
-    """
-    from eges_tpu.ops.pallas_kernels import LANE_BLOCK
-
-    # digits are LSD-first; the ladder consumes MSD-first
-    d_g1, d_g2, d_r1, d_r2 = [d[..., ::-1] for d in digits]
-    n1g, n2g, n1r, n2r = negs
-    tgx, tgy = g_tab
-    tlx, tly = lam_tab
-    trx, try_, tlrx = r_tab
-    B, W = d_g1.shape
-
-    gx, gy = jnp.take(tgx, d_g1, axis=0), jnp.take(tgy, d_g1, axis=0)
-    lx, ly = jnp.take(tlx, d_g2, axis=0), jnp.take(tly, d_g2, axis=0)
-
-    def row_gather(tab, d):
-        # tab [16, B, 16] (entry, row, limb) -> out[b, w, k] = tab[d[b,w], b, k]
-        return jnp.take_along_axis(jnp.moveaxis(tab, 0, 1),
-                                   d[:, :, None], axis=1)
-
-    rxo, ryo = row_gather(trx, d_r1), row_gather(try_, d_r1)
-    lrxo, lryo = row_gather(tlrx, d_r2), row_gather(try_, d_r2)
-
-    xs = [gx, lx, rxo, lrxo]
-    ys = []
-    for y, n in ((gy, n1g), (ly, n2g), (ryo, n1r), (lryo, n2r)):
-        flag = jnp.broadcast_to(n[:, None], (B, W))
-        ys.append(select(flag, FP.neg(y), y))
-
-    def pack(parts):
-        # 4 x [B, W, 16] -> [W, 4*16, Bpad]
-        a = jnp.stack(parts)                      # [4, B, W, 16]
-        a = jnp.transpose(a, (2, 0, 3, 1))        # [W, 4, 16, B]
-        a = a.reshape(W, 4 * NLIMBS, B)
-        pad = (-B) % LANE_BLOCK
-        return jnp.pad(a, ((0, 0), (0, 0), (0, pad)))
-
-    nz = jnp.stack([(d != 0).astype(jnp.uint32)
-                    for d in (d_g1, d_g2, d_r1, d_r2)])   # [4, B, W]
-    nz = jnp.transpose(nz, (2, 0, 1))                     # [W, 4, B]
-    nz = jnp.pad(nz, ((0, 0), (0, 4), (0, (-B) % LANE_BLOCK)))
-    return pack(xs), pack(ys), nz
-
-
 def strauss_gR_plain(u1: jnp.ndarray, u2: jnp.ndarray, rx: jnp.ndarray, ry: jnp.ndarray):
     """Windowed Shamir/Strauss ``u1*G + u2*R`` (R affine, per-row).
 

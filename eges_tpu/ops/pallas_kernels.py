@@ -11,9 +11,10 @@ variant only 3.5x that.  So these kernels fuse entire LOOPS, not
 steps, each a single ``pallas_call`` whose grid streams per-iteration
 operands while the carried state stays resident in VMEM/output refs:
 
-* ``strauss_stream``: the whole 33-window GLV/Strauss ladder (4
-  doublings + 4 conditional mixed adds per window), operands
-  pre-gathered and sign-folded by XLA in a handful of vectorized ops.
+* ``strauss_tab``: the whole 33-window GLV/Strauss ladder (4 doublings
+  + 4 conditional mixed adds per window) with IN-KERNEL one-hot table
+  lookups — fixed-base operands from trace-time constants, the R
+  tables VMEM-resident across the window walk.
 * ``pow_mod_pallas``: constant-exponent windowed pow (a^e mod P or
   mod N) — covers FP.sqrt, FP inverse and FN inverse, replacing three
   rolled 256-bit square-and-multiply ladders.
@@ -282,83 +283,8 @@ def fp_mul_pallas(a: jnp.ndarray, b: jnp.ndarray, *,
     return _ew(_fp_mul_kernel, [a, b], interpret=interpret)
 
 
-# ---------------------------------------------------------------------------
-# streamed full-ladder kernel: the WHOLE 33-window Strauss loop as ONE
-# pallas_call.  Measured r4 on the live chip: the 2-kernel-per-window
-# variant still paid ~165 kernel launches + interleaved XLA gathers per
-# batch, and launch overhead on this backend is tens of microseconds —
-# the ladder ran at 70.7 verifies/s at 256 rows.  Here the grid's last
-# dimension IS the window loop: per-window operands (already looked up
-# and sign-folded by XLA in one vectorized gather) stream HBM->VMEM via
-# the Pallas pipeline, and the accumulator lives in the output refs
-# across grid steps (the classic matmul-K-loop carry pattern).  One
-# launch per batch, zero interstitial XLA.
-# ---------------------------------------------------------------------------
-
+# operand layout of the ladder kernels
 STRAUSS_OPS = 4  # ±G, ±lam*G, ±R, ±lam*R
-
-
-def _strauss_stream_kernel(opx_ref, opy_ref, nz_ref, ox_ref, oy_ref, oz_ref):
-    """Grid ``(batch_blocks, GLV_WINDOWS)``; one step = one window:
-    4 doublings + 4 conditional mixed adds, MSD window first."""
-    w = pl.program_id(1)
-
-    @pl.when(w == 0)
-    def _init():  # accumulator = infinity (Z == 0, Y = 1)
-        zero = jnp.zeros((LANE_BLOCK,), jnp.uint32)
-        one = jnp.ones((LANE_BLOCK,), jnp.uint32)
-        for k in range(NLIMBS):
-            ox_ref[k, :] = zero
-            oy_ref[k, :] = one if k == 0 else zero
-            oz_ref[k, :] = zero
-
-    X, Y, Z = _read16(ox_ref), _read16(oy_ref), _read16(oz_ref)
-    for _ in range(4):
-        X, Y, Z = _k_jac_double(X, Y, Z)
-    for t in range(STRAUSS_OPS):
-        px = [opx_ref[0, 16 * t + k, :] for k in range(NLIMBS)]
-        py = [opy_ref[0, 16 * t + k, :] for k in range(NLIMBS)]
-        nz = nz_ref[0, t, :]
-        AX, AY, AZ = _k_jac_add_mixed(X, Y, Z, px, py)
-        X = _k_select(nz, AX, X)
-        Y = _k_select(nz, AY, Y)
-        Z = _k_select(nz, AZ, Z)
-    _write16(ox_ref, X)
-    _write16(oy_ref, Y)
-    _write16(oz_ref, Z)
-
-
-def strauss_stream(opx: jnp.ndarray, opy: jnp.ndarray, nz: jnp.ndarray,
-                   batch: int, *, interpret: bool | None = None):
-    """Run the full ladder over pre-gathered operands.
-
-    ``opx``/``opy``: ``[W, 64, Bpad]`` u32 — x/y limbs of the four
-    table operands per window, window-processing order (MSD first),
-    y already sign-folded.  ``nz``: ``[W, 8, Bpad]`` u32 0/1 (rows 0-3
-    used).  Returns Jacobian ``(X, Y, Z)`` each ``[batch, 16]``.
-    """
-    if interpret is None:
-        interpret = _default_interpret()
-    W, _, wide = opx.shape
-    nb = wide // LANE_BLOCK
-    outs = pl.pallas_call(
-        _strauss_stream_kernel,
-        out_shape=tuple(jax.ShapeDtypeStruct((NLIMBS, wide), jnp.uint32)
-                        for _ in range(3)),
-        grid=(nb, W),
-        in_specs=[
-            pl.BlockSpec((1, STRAUSS_OPS * NLIMBS, LANE_BLOCK),
-                         lambda b, w: (w, 0, b)),
-            pl.BlockSpec((1, STRAUSS_OPS * NLIMBS, LANE_BLOCK),
-                         lambda b, w: (w, 0, b)),
-            pl.BlockSpec((1, 8, LANE_BLOCK), lambda b, w: (w, 0, b)),
-        ],
-        out_specs=tuple(
-            pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda b, w: (0, b))
-            for _ in range(3)),
-        interpret=interpret,
-    )(opx, opy, nz)
-    return tuple(o.T[:batch] for o in outs)
 
 
 # ---------------------------------------------------------------------------
@@ -523,28 +449,6 @@ def strauss_tab_np(dig: np.ndarray, neg: np.ndarray, trx: np.ndarray,
             X = _k_select(nz, AX, X, np)
             Y = _k_select(nz, AY, Y, np)
             Z = _k_select(nz, AZ, Z, np)
-    return X, Y, Z
-
-
-def strauss_stream_np(opx: np.ndarray, opy: np.ndarray, nz: np.ndarray):
-    """Numpy twin of the streaming kernel's math (same uint32 wrap
-    semantics), for differential tests on hosts without a TPU."""
-    W, _, wide = opx.shape
-    X = [np.zeros(wide, np.uint32) for _ in range(NLIMBS)]
-    Y = [np.zeros(wide, np.uint32) for _ in range(NLIMBS)]
-    Y[0] = np.ones(wide, np.uint32)
-    Z = [np.zeros(wide, np.uint32) for _ in range(NLIMBS)]
-    for w in range(W):
-        for _ in range(4):
-            X, Y, Z = _k_jac_double(X, Y, Z, np)
-        for t in range(STRAUSS_OPS):
-            px = [opx[w, 16 * t + k, :] for k in range(NLIMBS)]
-            py = [opy[w, 16 * t + k, :] for k in range(NLIMBS)]
-            f = nz[w, t, :]
-            AX, AY, AZ = _k_jac_add_mixed(X, Y, Z, px, py, np)
-            X = _k_select(f, AX, X, np)
-            Y = _k_select(f, AY, Y, np)
-            Z = _k_select(f, AZ, Z, np)
     return X, Y, Z
 
 
@@ -855,10 +759,11 @@ def pallas_enabled() -> bool:
 
 @functools.lru_cache(maxsize=1)
 def ladder_kernels_enabled() -> bool:
-    """Route the recover pipeline's hot loops through the fused streamed
-    kernels (strauss_stream, the pow ladders, the R-table build, the
-    keccak tail) — TPU backend only (interpret mode would lower each
-    kernel back to per-block HLO and re-explode the CPU graph).
+    """Route the recover pipeline through the fused kernels (the
+    composite stage kernels, glv_digits, strauss_tab, the pow ladders,
+    the R-table build, the keccak tail, the one-launch glue ops) — TPU
+    backend only (interpret mode would lower each kernel back to
+    per-block HLO and re-explode the CPU graph).
 
     DEFAULT ON for TPU backends since the round-4 hardware A/B
     (LADDER_AB.json): 826.8 verifies/s vs the plain graph's 20.1/s at

@@ -19,8 +19,8 @@ sys.path.insert(0, "/root/repo")
 
 from eges_tpu.ops import bigint
 from eges_tpu.ops.pallas_kernels import (
-    NLIMBS, P, STRAUSS_OPS, fp_mul_pallas, keccak_block_pallas,
-    point_table_pallas, pow_mod_pallas, strauss_stream,
+    NLIMBS, P, fp_mul_pallas, keccak_block_pallas,
+    point_table_pallas, pow_mod_pallas, strauss_tab,
 )
 
 GLV_WINDOWS = 33
@@ -67,18 +67,18 @@ def main():
     print(f"point_table   {t*1e3:8.3f} ms", flush=True)
 
     def strauss_gen():
-        opx = jnp.asarray(rng.integers(
-            0, 2**16, (GLV_WINDOWS, STRAUSS_OPS * NLIMBS, B), dtype=np.uint32))
-        opy = jnp.asarray(rng.integers(
-            0, 2**16, (GLV_WINDOWS, STRAUSS_OPS * NLIMBS, B), dtype=np.uint32))
-        nz = jnp.asarray(rng.integers(
-            0, 2, (GLV_WINDOWS, 8, B), dtype=np.uint32))
-        return opx, opy, nz
+        dig = jnp.asarray(rng.integers(
+            0, 16, (GLV_WINDOWS, 8, B), dtype=np.uint32))
+        neg = jnp.asarray(rng.integers(0, 2, (8, B), dtype=np.uint32))
+        tabs = [jnp.asarray(rng.integers(0, 2**16, (16 * NLIMBS, B),
+                                         dtype=np.uint32))
+                for _ in range(3)]
+        return (dig, neg, *tabs)
 
-    t = timeit_unique(jax.jit(functools.partial(strauss_stream, batch=B)),
+    t = timeit_unique(jax.jit(functools.partial(strauss_tab, batch=B)),
                       strauss_gen, reps=4)
-    res["strauss_ms"] = round(t * 1e3, 3)
-    print(f"strauss       {t*1e3:8.3f} ms", flush=True)
+    res["strauss_tab_ms"] = round(t * 1e3, 3)
+    print(f"strauss_tab   {t*1e3:8.3f} ms", flush=True)
 
     t = timeit_unique(
         jax.jit(keccak_block_pallas),

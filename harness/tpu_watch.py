@@ -335,7 +335,9 @@ def main() -> None:
                         _log(f"LADDER A/B: {json.dumps(ab)}")
         else:
             _log("bench produced no TPU-device line")
-        if res is not None:
+        if res is not None and not fellback and not kernels_lost:
+            # only when the FUSED pipeline just proved itself — the
+            # experiments measure that pipeline's variants
             _run_experiments()
         time.sleep(SETTLED_PERIOD_S if captured_full else PROBE_PERIOD_S)
 
@@ -356,6 +358,10 @@ def _run_experiments() -> None:
         return
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # pin the pipeline variant explicitly, like bench(): an ambient
+    # EGES_TPU_PALLAS opt-out must not turn the fused-pipeline A/B
+    # into a meaningless plain-graph measurement
+    env["EGES_TPU_PALLAS"] = ""
     outp = os.path.join(_DIR, "experiments.log")
     jobs = [
         ("mulchain", [sys.executable,
@@ -366,14 +372,20 @@ def _run_experiments() -> None:
                       "1024"],
          {**env, "EGES_TPU_LANE_BLOCK": "1024"}),
     ]
+    all_ok = True
     with open(outp, "a") as f:
         for name, argv, jenv in jobs:
             rc, out = _run_child(argv, 600, jenv)
             f.write(f"=== {name} rc={rc} at "
                     f"{time.strftime('%H:%M:%S')} ===\n{out}\n")
+            f.flush()  # a kill during job 2 must not lose job 1
             _log(f"experiment {name}: rc={rc}")
-    with open(_EXP_DONE, "w") as f:
-        f.write(time.strftime("%Y-%m-%dT%H:%M:%S"))
+            all_ok = all_ok and rc == 0
+    if all_ok:
+        # a flapped tunnel (rc != 0) re-arms the experiments for the
+        # next window instead of burning the one-shot on no data
+        with open(_EXP_DONE, "w") as f:
+            f.write(time.strftime("%Y-%m-%dT%H:%M:%S"))
 
 
 if __name__ == "__main__":

@@ -234,31 +234,6 @@ def test_ladder_kernels_on_tpu(monkeypatch):
         np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
 
 
-def test_strauss_stream_math_matches_graph_path():
-    """The streamed full-ladder kernel's math + operand packing must be
-    bit-identical to the XLA strauss_gR loop: the numpy twin of the
-    kernel body consumes exactly what pack_strauss_operands feeds the
-    real kernel (window order, sign folds, nz rows, lane padding)."""
-    from eges_tpu.ops import ec
-    from eges_tpu.ops.bigint import N
-    from eges_tpu.ops.pallas_kernels import strauss_stream_np
-
-    n = 4
-    rx, ry = _affine_batch(n)
-    u1_l = [0, 1, rng.randrange(N), rng.randrange(N)]  # incl. zero scalar
-    u2_l = [rng.randrange(N), 0, 1, rng.randrange(N)]
-    u1 = jnp.asarray(np.stack([int_to_limbs(v) for v in u1_l]))
-    u2 = jnp.asarray(np.stack([int_to_limbs(v) for v in u2_l]))
-
-    prelude = ec._strauss_prelude(u1, u2, rx, ry)
-    opx, opy, nz = ec.pack_strauss_operands(*prelude)
-    got = strauss_stream_np(np.asarray(opx), np.asarray(opy),
-                            np.asarray(nz))
-    want = ec.strauss_gR(u1, u2, rx, ry)  # plain XLA path
-    for g, w in zip(got, want):
-        np.testing.assert_array_equal(_untq(g)[:n], np.asarray(w))
-
-
 def test_point_table_math_matches_graph_path():
     """The table kernel's numpy twin is bit-identical to the lax.scan
     of mixed adds in ec._build_point_table (entries 2..15)."""
