@@ -1,12 +1,16 @@
 """Second attempt at the sublane-filling F_P-multiply layout.
 
-profile_kernels.py's `fp_mul8` (4-D refs, one (1,8,128) block per limb)
-ran 245x SLOWER than the (16, B) 1-D-row kernel — consistent with
+A first prototype (`fp_mul8` in the since-deleted profile_kernels.py,
+see git history) used 4-D refs with one (1, 8, 128) block per limb and
+timed 245x SLOWER than the (16, B) 1-D-row kernel — consistent with a
 Mosaic relayout/copy per 4-D block access, not with the VPU math.
 This variant keeps everything 2-D: a value is a (128, 128) tile =
 16 limbs x (8 sublanes x 128 lanes), and each limb is an aligned
 (8, 128) row-slice — exactly one vreg.  If THIS beats the (16, B)
 layout per element, the in-kernel field library should adopt it.
+NOTE: both timings here predate the repeat-content-memoization finding
+(see harness/profile_mulchain.py, the trustworthy chained-dependency
+microbenchmark the watcher runs on the next tunnel window).
 """
 
 import sys
