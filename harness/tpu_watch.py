@@ -377,23 +377,34 @@ def _run_experiments() -> None:
     ]
     with open(outp, "a") as f:
         for name, argv, jenv in jobs:
-            # per-job markers: a success never re-runs; a timeout/kill
-            # (rc == -9: tunnel flap) retries next window; a
-            # deterministic failure (any other rc) is remembered and
-            # not retried — no every-window burn on a broken variant
+            # per-job markers: done = rc 0 AND a TPU device string in
+            # the output (a CPU-fallback success must not bank a
+            # meaningless number); anything else counts one attempt —
+            # transient tunnel errors exit rc=1, indistinguishable from
+            # deterministic failures, so each job gets 3 attempts
+            # before its .failed marker, not a first-strike ban
             done = os.path.join(_DIR, f"exp_{name}.done")
             failed = os.path.join(_DIR, f"exp_{name}.failed")
+            tries_p = os.path.join(_DIR, f"exp_{name}.tries")
             if os.path.exists(done) or os.path.exists(failed):
                 continue
             rc, out = _run_child(argv, 600, jenv)
             f.write(f"=== {name} rc={rc} at "
                     f"{time.strftime('%H:%M:%S')} ===\n{out}\n")
             f.flush()  # a kill during job 2 must not lose job 1
-            _log(f"experiment {name}: rc={rc}")
-            if rc == 0:
+            on_tpu = "TPU" in out
+            _log(f"experiment {name}: rc={rc} on_tpu={on_tpu}")
+            if rc == 0 and on_tpu:
                 open(done, "w").write(time.strftime("%H:%M:%S"))
-            elif rc != -9:
-                open(failed, "w").write(f"rc={rc}")
+                continue
+            tries = 1
+            try:
+                tries = int(open(tries_p).read()) + 1
+            except Exception:
+                pass
+            open(tries_p, "w").write(str(tries))
+            if tries >= 3:
+                open(failed, "w").write(f"rc={rc} tries={tries}")
 
 
 if __name__ == "__main__":

@@ -713,3 +713,105 @@ def test_addr_from_digest_rows():
     got = np.asarray(addr_from_digest_rows(jnp.asarray(dig), B))
     for i, m in enumerate(msgs):
         assert bytes(got[i]) == keccak256(m)[12:], f"msg {i}"
+
+
+def test_fused_pipeline_end_to_end_numpy():
+    """The WHOLE fused recover pipeline, composed from every kernel's
+    numpy twin exactly as ecrecover_point_fused wires the real kernels
+    (prelude -> sqrt pow -> y-fix -> inv_n pow -> u1u2 -> glv digits ->
+    R-table build + affine normalization -> self-gathering ladder ->
+    inv_p pow -> finish -> keccak), checked against the independent
+    host model: recovered addresses for valid rows, rejection for every
+    invalid class.  This is the CPU-side proof of the fused WIRING, not
+    just of each kernel's math in isolation."""
+    from eges_tpu.crypto import secp256k1 as hostc
+    from eges_tpu.crypto.keccak import keccak256
+    from eges_tpu.ops.bigint import N
+    from eges_tpu.ops.ec import GLV_BETA
+    from eges_tpu.ops.pallas_kernels import (
+        _k_cond_sub_p, _k_keccak_words, _k_mul, _k_recover_finish,
+        _k_recover_prelude, _k_sqr, _k_u1u2, _k_y_fix, glv_digits_np,
+        point_table_np, pow_mod_np, strauss_tab_np,
+    )
+
+    # rows: valid signatures + one of each invalid class
+    msgs, privs = [], []
+    B_valid = 5
+    for i in range(B_valid):
+        msgs.append(bytes([(i % 250) + 2]) * 32)
+        privs.append(bytes([(i % 199) + 11]) * 32)
+    sigs, hashes = [], []
+    for m, k in zip(msgs, privs):
+        sigs.append(hostc.ecdsa_sign(m, k))  # 65 bytes r||s||v
+        hashes.append(m)
+    # invalid rows: r=0, s>=N, v=9
+    sigs.append(bytes(32) + sigs[0][32:])
+    hashes.append(hashes[0])
+    sigs.append(sigs[1][:32] + N.to_bytes(32, "big") + sigs[1][64:])
+    hashes.append(hashes[1])
+    sigs.append(sigs[2][:64] + bytes([9]))
+    hashes.append(hashes[2])
+    B = len(sigs)
+
+    def limbs_of(bs):  # [B] list of 32-byte BE -> [B, 16] u32
+        return np.stack([int_to_limbs(int.from_bytes(b, "big"))
+                         for b in bs]).astype(np.uint32)
+
+    r = limbs_of([s[0:32] for s in sigs])
+    s_ = limbs_of([s[32:64] for s in sigs])
+    z = limbs_of(hashes)
+    v = np.asarray([s[64] for s in sigs], np.uint32)
+
+    def t(a):
+        return [a[:, k].copy() for k in range(16)]
+
+    # --- the fused wiring, numpy twins in ecrecover_point_fused order
+    x, y_sq, ok0 = _k_recover_prelude(t(r), t(s_), v, np)
+    root = pow_mod_np(_untq(y_sq), (P + 1) // 4, "p")
+    y, y_ok = _k_y_fix(t(root), y_sq, v, np)
+    r_inv = pow_mod_np(r, N - 2, "n")
+    u1, u2 = _k_u1u2(t(z), t(s_), t(r_inv), np)
+
+    dig, neg = glv_digits_np(_untq(u1), _untq(u2))
+    xa, ya = _untq(x), _untq(y)
+    tx, ty, tz = point_table_np(xa, ya)          # entries 2..15 Jacobian
+    # affine normalization, mirroring _build_affine_table: entries 0
+    # (infinity) and 1 (R itself) prepended, one inversion per entry
+    ones = np.zeros((B, 16), np.uint32)
+    ones[:, 0] = 1
+    tx_full = np.concatenate([np.zeros((1, B, 16), np.uint32),
+                              xa[None], tx])
+    ty_full = np.concatenate([np.zeros((1, B, 16), np.uint32),
+                              ya[None], ty])
+    tz_full = np.concatenate([np.zeros((1, B, 16), np.uint32),
+                              ones[None], tz])
+    zi = pow_mod_np(tz_full.reshape(-1, 16), P - 2, "p")
+    zi = _untq(_k_cond_sub_p(t(zi), np))         # inv_batched canonicalizes
+    zi_l = t(zi)
+    zi2 = _k_sqr(zi_l, np)
+    tl = t(tx_full.reshape(-1, 16))
+    ax = _k_mul(tl, zi2, np)
+    ay = _k_mul(t(ty_full.reshape(-1, 16)), _k_mul(zi_l, zi2, np), np)
+    beta = [np.full(16 * B, int(l), np.uint32)
+            for l in int_to_limbs(GLV_BETA)]
+    axb = _k_mul(ax, beta, np)
+
+    def rows(limb_list):  # 16B-row limb list -> [256, B] table rows
+        arr = _untq(limb_list).reshape(16, B, 16)
+        return np.ascontiguousarray(arr.transpose(0, 2, 1)).reshape(-1, B)
+
+    X, Y, Z = strauss_tab_np(dig, neg, rows(ax), rows(axb), rows(ay))
+    zi_raw = pow_mod_np(_untq(Z).astype(np.uint32), P - 2, "p")
+    qx, qy, ok, words = _k_recover_finish(
+        X, Y, Z, t(zi_raw), ok0 * y_ok, np)
+    digest = _k_keccak_words([w for w in words], np)
+    dig_bytes = np.stack(digest, -1).astype("<u4").view(np.uint8) \
+        .reshape(B, 32)
+
+    # --- checks against the host model
+    for i in range(B_valid):
+        want = keccak256(hostc.privkey_to_pubkey(privs[i]))[12:]
+        assert ok[i] == 1, f"valid row {i} rejected"
+        assert bytes(dig_bytes[i][12:32]) == want, f"row {i} addr"
+    for i in range(B_valid, B):
+        assert ok[i] == 0, f"invalid row {i} accepted"
