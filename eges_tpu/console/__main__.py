@@ -143,6 +143,14 @@ def main(argv=None) -> None:
         "thw": _Namespace(rpc, "thw"),
         "net": _Namespace(rpc, "net"),
         "debug": _Namespace(rpc, "debug"),
+        # JS literal aliases: geth console snippets built from method
+        # calls, property access and bare literals — e.g.
+        # `eth.getBalance(addr, "latest")` or `debug.verbosity(4) ==
+        # null` — parse identically in Python once these three names
+        # resolve.  JS-only SYNTAX (ternaries, `var`, `function`)
+        # still needs rewriting; this is a literal shim, not a JS VM
+        # (ref role: console/ otto surface).
+        "true": True, "false": False, "null": None,
     }
     if args.exec:
         print(eval(args.exec, ns))  # noqa: S307 - operator-driven REPL
