@@ -43,6 +43,12 @@ def test_console_attaches_and_queries():
     debug = _Namespace(client, "debug")
     assert debug.stats()["threads"] >= 1
 
+    # JS literal shim: pasted geth snippets with bare true/false/null
+    # evaluate through the same namespace the REPL/--exec builds
+    ns = {"eth": eth, "true": True, "false": False, "null": None}
+    assert eval("eth.block_number() == 0 and true", ns) is True
+    assert eval("null", ns) is None
+
     loop_box["loop"].call_soon_threadsafe(loop_box["loop"].stop)
 
 
