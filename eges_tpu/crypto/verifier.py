@@ -43,13 +43,21 @@ def _unpack(sigs: jnp.ndarray, hashes: jnp.ndarray):
     return z, r, s, v
 
 
+def words_to_bytes(rows: jnp.ndarray, B: int) -> jnp.ndarray:
+    """``[W, Bpad]`` LE u32 words -> ``[B, 4*W]`` u8 byte stream (word
+    LSB first — the keccak byte order both the digest and the packed
+    qx||qy block use)."""
+    W = rows.shape[0]
+    wb = rows[:, :B]
+    b = jnp.stack([(wb >> (8 * j)) & 0xFF for j in range(4)], axis=1)
+    return b.transpose(2, 0, 1).reshape(B, 4 * W).astype(jnp.uint8)
+
+
 def addr_from_digest_rows(dig: jnp.ndarray, B: int) -> jnp.ndarray:
     """``[8, Bpad]`` LE keccak digest words -> ``[B, 20]`` u8 addresses
     (digest bytes 12..31, i.e. LE words 3..7) — the address tail of the
     fused pipeline (ref role: crypto/crypto.go PubkeyToAddress)."""
-    dw = dig[3:8, :B]
-    ab = jnp.stack([(dw >> (8 * j)) & 0xFF for j in range(4)], axis=1)
-    return ab.transpose(2, 0, 1).reshape(B, 20).astype(jnp.uint8)
+    return words_to_bytes(dig[3:8], B)
 
 
 def ecrecover_batch(sigs: jnp.ndarray, hashes: jnp.ndarray):
@@ -61,25 +69,26 @@ def ecrecover_batch(sigs: jnp.ndarray, hashes: jnp.ndarray):
     ``ok == 0`` (the reference raises per-call instead,
     secp256.go:105-124 — a mask is the batch-native contract).
     """
-    z, r, s, v = _unpack(sigs, hashes)
-
     from eges_tpu.ops.pallas_kernels import (
         keccak_rows_pallas, ladder_kernels_enabled,
     )
     if ladder_kernels_enabled() and sigs.ndim == 2:
-        # fused pipeline: ~12 composite kernel launches end-to-end; the
-        # finish kernel already packed the (masked) keccak block words
+        # fused pipeline: ~12 composite kernel launches end-to-end
+        # from wire bytes; the finish kernel already packed the
+        # (masked) keccak block words, whose first 16 words ARE the
+        # big-endian qx || qy bytes — pubs fall out of them
         B = sigs.shape[0]
-        qx, qy, ok, words = ec.ecrecover_point_fused(z, r, s, v)
+        _qx, _qy, ok, words = ec.ecrecover_point_fused(sigs, hashes)
         addrs = addr_from_digest_rows(keccak_rows_pallas(words), B)
-    else:
-        qx, qy, ok = ec.ecrecover_point(z, r, s, v)
-        addrs = None
+        pubs = words_to_bytes(words[:16], B)
+        mask = ok[..., None].astype(jnp.uint8)
+        return addrs * mask, pubs, ok
+    z, r, s, v = _unpack(sigs, hashes)
+    qx, qy, ok = ec.ecrecover_point(z, r, s, v)
     qx_b = bigint.limbs_to_bytes_be(qx)
     qy_b = bigint.limbs_to_bytes_be(qy)
     mask = ok[..., None].astype(jnp.uint8)
-    if addrs is None:
-        addrs = keccak_tpu.pubkey_to_address(qx_b, qy_b)
+    addrs = keccak_tpu.pubkey_to_address(qx_b, qy_b)
     pubs = jnp.concatenate([qx_b, qy_b], axis=-1) * mask
     return addrs * mask, pubs, ok
 

@@ -552,25 +552,25 @@ def scalar_mul(k: jnp.ndarray, px: jnp.ndarray, py: jnp.ndarray):
     return jax.lax.fori_loop(0, N_WINDOWS, body, acc)
 
 
-def ecrecover_point_fused(z: jnp.ndarray, r: jnp.ndarray, s: jnp.ndarray,
-                          v: jnp.ndarray):
-    """Fused-kernel twin of :func:`ecrecover_point` (TPU backends): the
-    whole pipeline is ~12 launches — composite stage kernels around the
-    two pow ladders and the self-gathering Strauss kernel — instead of
-    the general path's per-op graph.  Returns ``(qx, qy, ok, words)``
-    where ``words [34, Bpad]`` is the ready-padded keccak block of
-    ``qx || qy`` (the finish kernel packs bytes in-kernel so the
-    address tail needs no XLA byte shuffling).  Outputs are
-    value-identical to the general path; every kernel's math is the
-    ``_k_*`` mirror of the graph ops (differential-tested in numpy and
-    on hardware)."""
+def ecrecover_point_fused(sigs: jnp.ndarray, hashes: jnp.ndarray):
+    """Fused-kernel twin of :func:`ecrecover_point` (TPU backends),
+    wire bytes in: the whole pipeline is ~12 launches — composite stage
+    kernels around the two pow ladders and the self-gathering Strauss
+    kernel — instead of the general path's per-op graph.  The prelude
+    kernel unpacks r/s/v/z itself (the byte shuffles ran as ~14 XLA
+    dispatches).  Returns ``(qx, qy, ok, words)`` where ``words [34,
+    Bpad]`` is the ready-padded keccak block of ``qx || qy`` (the
+    finish kernel packs bytes in-kernel so the address tail needs no
+    XLA byte shuffling).  Outputs are value-identical to the general
+    path; every kernel's math is the ``_k_*`` mirror of the graph ops
+    (differential-tested in numpy and on hardware)."""
     from eges_tpu.ops import bigint as bg
     from eges_tpu.ops.pallas_kernels import (
         pow_mod_pallas, recover_finish_pallas, recover_prelude_pallas,
         u1u2_pallas, y_fix_pallas,
     )
 
-    x, y_sq, ok0 = recover_prelude_pallas(r, s, v)
+    x, y_sq, ok0, r, s, z, v = recover_prelude_pallas(sigs, hashes)
     root = pow_mod_pallas(y_sq, (bg.P + 1) // 4, "p")
     y, y_ok = y_fix_pallas(root, y_sq, v)
     r_inv = pow_mod_pallas(r, bg.N - 2, "n")

@@ -1228,6 +1228,13 @@ def _k_lt_const(a, m_limbs, xp=jnp):
     return _k_sub_const_chain(a, m_limbs, xp)[1]
 
 
+def _k_unpack_be(rows, off, xp=jnp):
+    """32 big-endian byte rows (u32 values < 256) starting at ``off``
+    -> 16 LE 16-bit limbs; mirrors ``bigint.bytes_be_to_limbs``."""
+    return [rows[off + 31 - 2 * k] | (rows[off + 30 - 2 * k] << xp.uint32(8))
+            for k in range(16)]
+
+
 def _k_recover_prelude(r, s, v, xp=jnp):
     """Checks + x-candidate + y^2 for the whole batch: mirrors the
     front of ``ec.ecrecover_point`` value-for-value.  ``v`` is the
@@ -1251,40 +1258,53 @@ def _k_recover_prelude(r, s, v, xp=jnp):
     return x, y_sq, r_ok * s_ok * v_ok * x_ok
 
 
-def _recover_prelude_kernel(r_ref, s_ref, v_ref, x_ref, ysq_ref, ok_ref):
-    x, y_sq, ok = _k_recover_prelude(_read16(r_ref), _read16(s_ref),
-                                     v_ref[0, :])
+def _recover_prelude_kernel(sig_ref, hash_ref, x_ref, ysq_ref, ok_ref,
+                            r_ref, s_ref, z_ref, v_ref):
+    """Wire bytes in, scalar-stage outputs out: unpacks r/s/v/z from
+    the 65-byte signature + 32-byte hash rows IN-KERNEL (the byte
+    shuffles ran as ~14 separate XLA dispatches), then the checks and
+    y^2 candidate."""
+    srows = [sig_ref[k, :] for k in range(65)]
+    r = _k_unpack_be(srows, 0)
+    s = _k_unpack_be(srows, 32)
+    v = srows[64]
+    z = _k_unpack_be([hash_ref[k, :] for k in range(32)], 0)
+    x, y_sq, ok = _k_recover_prelude(r, s, v)
     _write16(x_ref, x)
     _write16(ysq_ref, y_sq)
     ok_ref[0, :] = ok
+    _write16(r_ref, r)
+    _write16(s_ref, s)
+    _write16(z_ref, z)
+    v_ref[0, :] = v
 
 
-def recover_prelude_pallas(r, s, v, *, interpret=None):
-    """``r/s [B, 16]`` raw wire scalars, ``v [B]`` recovery id ->
-    ``(x [B, 16], y_sq [B, 16], ok [B])``."""
+def recover_prelude_pallas(sigs, hashes, *, interpret=None):
+    """``sigs [B, 65]`` u8 wire signatures, ``hashes [B, 32]`` u8 ->
+    ``(x, y_sq, ok, r, s, z, v)`` — the unpacked limb fields ride out
+    of the same launch that checks them."""
     if interpret is None:
         interpret = _default_interpret()
-    B = r.shape[0]
+    B = sigs.shape[0]
     pad = (-B) % LANE_BLOCK
-    rt = jnp.pad(r, ((0, pad), (0, 0))).T
-    st = jnp.pad(s, ((0, pad), (0, 0))).T
-    vt = jnp.pad(v.astype(jnp.uint32), (0, pad)).reshape(1, -1)
-    wide = rt.shape[1]
-    x, ysq, ok = pl.pallas_call(
+    st = jnp.pad(sigs.astype(jnp.uint32), ((0, pad), (0, 0))).T
+    ht = jnp.pad(hashes.astype(jnp.uint32), ((0, pad), (0, 0))).T
+    wide = st.shape[1]
+    lim = jax.ShapeDtypeStruct((NLIMBS, wide), jnp.uint32)
+    row = jax.ShapeDtypeStruct((1, wide), jnp.uint32)
+    lspec = pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i))
+    rspec = pl.BlockSpec((1, LANE_BLOCK), lambda i: (0, i))
+    x, ysq, ok, r, s, z, v = pl.pallas_call(
         _recover_prelude_kernel,
-        out_shape=(jax.ShapeDtypeStruct((NLIMBS, wide), jnp.uint32),
-                   jax.ShapeDtypeStruct((NLIMBS, wide), jnp.uint32),
-                   jax.ShapeDtypeStruct((1, wide), jnp.uint32)),
+        out_shape=(lim, lim, row, lim, lim, lim, row),
         grid=(wide // LANE_BLOCK,),
-        in_specs=[pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i)),
-                  pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i)),
-                  pl.BlockSpec((1, LANE_BLOCK), lambda i: (0, i))],
-        out_specs=(pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i)),
-                   pl.BlockSpec((NLIMBS, LANE_BLOCK), lambda i: (0, i)),
-                   pl.BlockSpec((1, LANE_BLOCK), lambda i: (0, i))),
+        in_specs=[pl.BlockSpec((65, LANE_BLOCK), lambda i: (0, i)),
+                  pl.BlockSpec((32, LANE_BLOCK), lambda i: (0, i))],
+        out_specs=(lspec, lspec, rspec, lspec, lspec, lspec, rspec),
         interpret=interpret,
-    )(rt, st, vt)
-    return x.T[:B], ysq.T[:B], ok[0, :B]
+    )(st, ht)
+    return (x.T[:B], ysq.T[:B], ok[0, :B],
+            r.T[:B], s.T[:B], z.T[:B], v[0, :B])
 
 
 def _k_y_fix(root, y_sq, v, xp=jnp):

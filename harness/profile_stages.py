@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from eges_tpu.crypto.verifier import _unpack, ecrecover_batch
+from eges_tpu.crypto.verifier import ecrecover_batch
 from eges_tpu.models.flagship import example_batch
 from eges_tpu.ops import bigint, ec
 from eges_tpu.ops.pallas_kernels import (
@@ -30,8 +30,7 @@ B = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
 
 
 def _scalar_stage(sigs, hashes):
-    z, r, s, v = _unpack(sigs, hashes)
-    x, y_sq, ok0 = recover_prelude_pallas(r, s, v)
+    x, y_sq, ok0, r, s, z, v = recover_prelude_pallas(sigs, hashes)
     root = pow_mod_pallas(y_sq, (bigint.P + 1) // 4, "p")
     y, y_ok = y_fix_pallas(root, y_sq, v)
     r_inv = pow_mod_pallas(r, bigint.N - 2, "n")

@@ -43,11 +43,24 @@ def test_console_attaches_and_queries():
     debug = _Namespace(client, "debug")
     assert debug.stats()["threads"] >= 1
 
-    # JS literal shim: pasted geth snippets with bare true/false/null
-    # evaluate through the same namespace the REPL/--exec builds
-    ns = {"eth": eth, "true": True, "false": False, "null": None}
-    assert eval("eth.block_number() == 0 and true", ns) is True
-    assert eval("null", ns) is None
+    # JS literal shim: drive the REAL console entrypoint (--exec) so
+    # removing the true/false/null namespace entries fails this test
+    import contextlib
+    import io
+    import sys as _sys
+
+    from eges_tpu.console.__main__ import main as console_main
+    buf = io.StringIO()
+    argv = _sys.argv
+    _sys.argv = ["console", "--rpc",
+                 f"http://127.0.0.1:{port_box['port']}",
+                 "--exec", "eth.block_number() == 0 and true"]
+    try:
+        with contextlib.redirect_stdout(buf):
+            console_main()
+    finally:
+        _sys.argv = argv
+    assert buf.getvalue().strip() == "True"
 
     loop_box["loop"].call_soon_threadsafe(loop_box["loop"].stop)
 

@@ -730,8 +730,8 @@ def test_fused_pipeline_end_to_end_numpy():
     from eges_tpu.ops.ec import GLV_BETA
     from eges_tpu.ops.pallas_kernels import (
         _k_cond_sub_p, _k_keccak_words, _k_mul, _k_recover_finish,
-        _k_recover_prelude, _k_sqr, _k_u1u2, _k_y_fix, glv_digits_np,
-        point_table_np, pow_mod_np, strauss_tab_np,
+        _k_recover_prelude, _k_sqr, _k_u1u2, _k_unpack_be, _k_y_fix,
+        glv_digits_np, point_table_np, pow_mod_np, strauss_tab_np,
     )
 
     # rows: valid signatures + one of each invalid class
@@ -753,24 +753,25 @@ def test_fused_pipeline_end_to_end_numpy():
     hashes.append(hashes[2])
     B = len(sigs)
 
-    def limbs_of(bs):  # [B] list of 32-byte BE -> [B, 16] u32
-        return np.stack([int_to_limbs(int.from_bytes(b, "big"))
-                         for b in bs]).astype(np.uint32)
-
-    r = limbs_of([s[0:32] for s in sigs])
-    s_ = limbs_of([s[32:64] for s in sigs])
-    z = limbs_of(hashes)
-    v = np.asarray([s[64] for s in sigs], np.uint32)
+    # wire bytes -> limb fields exactly as the prelude kernel unpacks
+    srows = [np.asarray([sg[k] for sg in sigs], np.uint32)
+             for k in range(65)]
+    hrows = [np.asarray([h[k] for h in hashes], np.uint32)
+             for k in range(32)]
+    r_l = _k_unpack_be(srows, 0, np)
+    s_l = _k_unpack_be(srows, 32, np)
+    v = srows[64]
+    z_l = _k_unpack_be(hrows, 0, np)
 
     def t(a):
         return [a[:, k].copy() for k in range(16)]
 
     # --- the fused wiring, numpy twins in ecrecover_point_fused order
-    x, y_sq, ok0 = _k_recover_prelude(t(r), t(s_), v, np)
+    x, y_sq, ok0 = _k_recover_prelude(r_l, s_l, v, np)
     root = pow_mod_np(_untq(y_sq), (P + 1) // 4, "p")
     y, y_ok = _k_y_fix(t(root), y_sq, v, np)
-    r_inv = pow_mod_np(r, N - 2, "n")
-    u1, u2 = _k_u1u2(t(z), t(s_), t(r_inv), np)
+    r_inv = pow_mod_np(_untq(r_l), N - 2, "n")
+    u1, u2 = _k_u1u2(z_l, s_l, t(r_inv), np)
 
     dig, neg = glv_digits_np(_untq(u1), _untq(u2))
     xa, ya = _untq(x), _untq(y)
@@ -807,6 +808,19 @@ def test_fused_pipeline_end_to_end_numpy():
     digest = _k_keccak_words([w for w in words], np)
     dig_bytes = np.stack(digest, -1).astype("<u4").view(np.uint8) \
         .reshape(B, 32)
+
+    # the packed block words must reproduce qx || qy as bytes — the
+    # fused pubs output extracts them this way (verifier.words_to_bytes)
+    import jax.numpy as _jnp
+
+    from eges_tpu.crypto.verifier import words_to_bytes
+    pub_bytes = np.asarray(words_to_bytes(
+        _jnp.asarray(np.stack(words[:16])), B))
+    for i in range(B):
+        qx_i = limbs_to_int(_untq(qx)[i])
+        qy_i = limbs_to_int(_untq(qy)[i])
+        assert bytes(pub_bytes[i]) == (qx_i.to_bytes(32, "big")
+                                       + qy_i.to_bytes(32, "big")), i
 
     # --- checks against the host model
     for i in range(B_valid):
