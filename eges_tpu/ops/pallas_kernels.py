@@ -389,6 +389,9 @@ def strauss_tab(dig: jnp.ndarray, neg: jnp.ndarray, trx: jnp.ndarray,
     half-scalar signs), ``trx/tlrx/try_ [256, Bpad]`` (R / lam*R x and
     shared y affine tables, row ``16*d + k`` = limb k of entry d).
     Returns Jacobian ``(X, Y, Z)`` each ``[batch, 16]``."""
+    if rows8_enabled():
+        return strauss_tab_rows8(dig, neg, trx, tlrx, try_, batch,
+                                 interpret=interpret)
     if interpret is None:
         interpret = _default_interpret()
     W, _, wide = dig.shape
@@ -526,6 +529,8 @@ def pow_mod_pallas(a: jnp.ndarray, e: int, modulus: str, *,
     outputs up to the field's representation contract."""
     from jax.experimental.pallas import tpu as pltpu
 
+    if rows8_enabled():
+        return pow_mod_rows8(a, e, modulus, interpret=interpret)
     if interpret is None:
         interpret = _default_interpret()
     assert e.bit_length() <= 4 * POW_WINDOWS
@@ -745,6 +750,238 @@ def keccak_block_pallas(words: jnp.ndarray, *,
     pad = (-B) % LANE_BLOCK
     wt = jnp.pad(words, ((0, pad), (0, 0))).T  # [34, wide]
     return keccak_rows_pallas(wt, interpret=interpret).T[:B]
+
+
+# ---------------------------------------------------------------------------
+# rows8 experiment (EGES_TPU_ROWS8=1): (8, 128)-packed limb rows for
+# the two compute-heaviest kernels.  The default layout keeps each limb
+# as a [LANE]-wide 1-D vector, which Mosaic lays out (1, LANE) — one of
+# eight sublanes live, so the VPU idles 7/8 of its datapath on every
+# op.  Here one batch block is 1024 rows shaped (8, 128): a value is 16
+# limbs x one full (8, 128) vreg each, array row ``limb*8 + sublane``.
+# The ``_k_*`` math is shape-agnostic, so these kernels only change the
+# ref plumbing.  Gated off by default until the on-chip A/B (the bench
+# correctness gate runs before any timing is trusted).  Validation
+# story: the re-lay index contract is pinned by
+# test_rows8_layout_roundtrip; the kernel bodies reuse the twin-tested
+# _k_* math; interpret mode is NOT a viable differential here (the
+# (8,128)-block flat graphs take >15 min to compile on the 1-core
+# host), so end-to-end proof is the hardware gate, as with LANE_BLOCK.
+# ---------------------------------------------------------------------------
+
+ROWS8_BLOCK = 1024  # rows per grid step: 8 sublanes x 128 lanes
+
+
+def rows8_enabled() -> bool:
+    if os.environ.get("EGES_TPU_ROWS8", "") != "1":
+        return False
+    if LANE_BLOCK % ROWS8_BLOCK:
+        raise ValueError(
+            "EGES_TPU_ROWS8=1 requires EGES_TPU_LANE_BLOCK to be a "
+            f"multiple of {ROWS8_BLOCK} (got {LANE_BLOCK}) so every "
+            "padded batch width re-lays into (8, 128) tiles")
+    return True
+
+
+def _r8_read(ref, k: int):
+    """Limb k of a (1, 128, 128) value block -> (8, 128)."""
+    return ref[0, 8 * k:8 * (k + 1), :]
+
+
+def _r8_read16(ref):
+    return [_r8_read(ref, k) for k in range(NLIMBS)]
+
+
+def _r8_write16(ref, val):
+    for k in range(NLIMBS):
+        ref[0, 8 * k:8 * (k + 1), :] = val[k]
+
+
+def _to_rows8(a: jnp.ndarray) -> jnp.ndarray:
+    """``[B, 16]`` (B a ROWS8_BLOCK multiple) -> ``[nb, 128, 128]``
+    with row ``limb*8 + sublane``; batch b = block*1024 + s*128 + l."""
+    B = a.shape[0]
+    nb = B // ROWS8_BLOCK
+    return (a.T.reshape(NLIMBS, nb, 8, 128).transpose(1, 0, 2, 3)
+            .reshape(nb, NLIMBS * 8, 128))
+
+
+def _from_rows8(a: jnp.ndarray, B: int) -> jnp.ndarray:
+    nb = a.shape[0]
+    return (a.reshape(nb, NLIMBS, 8, 128).transpose(1, 0, 2, 3)
+            .reshape(NLIMBS, nb * ROWS8_BLOCK).T[:B])
+
+
+def _pad_rows8(a: jnp.ndarray) -> tuple[jnp.ndarray, int]:
+    B = a.shape[0]
+    pad = (-B) % ROWS8_BLOCK
+    return jnp.pad(a, ((0, pad), (0, 0))), B
+
+
+@functools.lru_cache(maxsize=2)
+def _pow_kernel_rows8(modulus: str):
+    mul_fn = _k_mul if modulus == "p" else _k_fn_mul
+
+    def kernel(sel_ref, a_ref, o_ref, tab_ref):
+        w = pl.program_id(1)
+
+        @pl.when(w == 0)
+        def _init():
+            A = _r8_read16(a_ref)
+            one0 = jnp.ones_like(A[0])
+            zero = jnp.zeros_like(A[0])
+            for k in range(NLIMBS):
+                tab_ref[8 * k:8 * (k + 1), :] = one0 if k == 0 else zero
+                tab_ref[8 * (NLIMBS + k):8 * (NLIMBS + k) + 8, :] = A[k]
+                o_ref[0, 8 * k:8 * (k + 1), :] = one0 if k == 0 else zero
+            cur = A
+            for e in range(2, 16):
+                cur = mul_fn(cur, A)
+                for k in range(NLIMBS):
+                    r0 = 8 * (NLIMBS * e + k)
+                    tab_ref[r0:r0 + 8, :] = cur[k]
+
+        acc = _r8_read16(o_ref)
+        for _ in range(4):
+            acc = mul_fn(acc, acc)
+        sel = [sel_ref[0, e, :] for e in range(16)]  # (128,) rows
+        op = []
+        for k in range(NLIMBS):
+            s = sel[0] * tab_ref[8 * k:8 * (k + 1), :]
+            for e in range(1, 16):
+                r0 = 8 * (NLIMBS * e + k)
+                s = s + sel[e] * tab_ref[r0:r0 + 8, :]
+            op.append(s)
+        acc = mul_fn(acc, op)
+        _r8_write16(o_ref, acc)
+
+    return kernel
+
+
+def pow_mod_rows8(a: jnp.ndarray, e: int, modulus: str, *,
+                  interpret: bool | None = None) -> jnp.ndarray:
+    """rows8 twin of :func:`pow_mod_pallas` — same contract."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = _default_interpret()
+    assert e.bit_length() <= 4 * POW_WINDOWS
+    ap, B = _pad_rows8(a)
+    at = _to_rows8(ap)
+    nb = at.shape[0]
+    sel = jnp.asarray(_pow_onehot(e)[:, :, :128])
+    out = pl.pallas_call(
+        _pow_kernel_rows8(modulus),
+        out_shape=jax.ShapeDtypeStruct((nb, NLIMBS * 8, 128), jnp.uint32),
+        grid=(nb, POW_WINDOWS),
+        in_specs=[
+            pl.BlockSpec((1, 16, 128), lambda b, w: (w, 0, 0)),
+            pl.BlockSpec((1, NLIMBS * 8, 128), lambda b, w: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, NLIMBS * 8, 128), lambda b, w: (b, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((16 * NLIMBS * 8, 128), jnp.uint32)],
+        interpret=interpret,
+    )(sel, at)
+    return _from_rows8(out, B)
+
+
+@functools.lru_cache(maxsize=1)
+def _strauss_tab_kernel_rows8():
+    from eges_tpu.ops.ec import _g_lam_table16, _g_table16
+
+    tgx, tgy = _g_table16()
+    tlx, _ = _g_lam_table16()
+    gx_rows = tuple(tuple(int(v) for v in row) for row in tgx)
+    gy_rows = tuple(tuple(int(v) for v in row) for row in tgy)
+    lx_rows = tuple(tuple(int(v) for v in row) for row in tlx)
+
+    def kernel(dig_ref, neg_ref, trx_ref, tlrx_ref, try_ref,
+               ox_ref, oy_ref, oz_ref):
+        w = pl.program_id(1)
+
+        @pl.when(w == 0)
+        def _init():
+            zero = jnp.zeros((8, 128), jnp.uint32)
+            one = jnp.ones((8, 128), jnp.uint32)
+            for k in range(NLIMBS):
+                ox_ref[0, 8 * k:8 * k + 8, :] = zero
+                oy_ref[0, 8 * k:8 * k + 8, :] = one if k == 0 else zero
+                oz_ref[0, 8 * k:8 * k + 8, :] = zero
+
+        X = _r8_read16(ox_ref)
+        Y = _r8_read16(oy_ref)
+        Z = _r8_read16(oz_ref)
+        for _ in range(4):
+            X, Y, Z = _k_jac_double(X, Y, Z)
+        for t in range(STRAUSS_OPS):
+            dig = dig_ref[0, 0, 8 * t:8 * t + 8, :]
+            if t == 0:
+                px = _k_onehot_const(dig, gx_rows)
+                py = _k_onehot_const(dig, gy_rows)
+            elif t == 1:
+                px = _k_onehot_const(dig, lx_rows)
+                py = _k_onehot_const(dig, gy_rows)
+            else:
+                xref = trx_ref if t == 2 else tlrx_ref
+
+                def rr(d, k, ref=xref):
+                    r0 = 8 * (16 * d + k)
+                    return ref[0, r0:r0 + 8, :]
+
+                px = _k_onehot_ref(dig, rr)
+                py = _k_onehot_ref(
+                    dig, lambda d, k: try_ref[0, 8 * (16 * d + k):
+                                              8 * (16 * d + k) + 8, :])
+            py = _k_select(neg_ref[0, 8 * t:8 * t + 8, :], _k_neg(py), py)
+            nz = (dig != 0).astype(jnp.uint32)
+            AX, AY, AZ = _k_jac_add_mixed(X, Y, Z, px, py)
+            X = _k_select(nz, AX, X)
+            Y = _k_select(nz, AY, Y)
+            Z = _k_select(nz, AZ, Z)
+        _r8_write16(ox_ref, X)
+        _r8_write16(oy_ref, Y)
+        _r8_write16(oz_ref, Z)
+
+    return kernel
+
+
+def strauss_tab_rows8(dig: jnp.ndarray, neg: jnp.ndarray, trx: jnp.ndarray,
+                      tlrx: jnp.ndarray, try_: jnp.ndarray, batch: int, *,
+                      interpret: bool | None = None):
+    """rows8 twin of :func:`strauss_tab`: same [W, 8, Bpad]/[8, Bpad]/
+    [256, Bpad] inputs (Bpad a ROWS8_BLOCK multiple), re-laid here."""
+    if interpret is None:
+        interpret = _default_interpret()
+    W, _, wide = dig.shape
+    nb = wide // ROWS8_BLOCK
+
+    def lay(rows):  # [R, wide] -> [nb, R*8, 128], row r*8 + sublane
+        R = rows.shape[0]
+        return (rows.reshape(R, nb, 8, 128).transpose(1, 0, 2, 3)
+                .reshape(nb, R * 8, 128))
+
+    digl = (dig.reshape(W, 8, nb, 8, 128).transpose(2, 0, 1, 3, 4)
+            .reshape(nb, W, 64, 128))
+    negl = lay(neg)
+    outs = pl.pallas_call(
+        _strauss_tab_kernel_rows8(),
+        out_shape=tuple(
+            jax.ShapeDtypeStruct((nb, NLIMBS * 8, 128), jnp.uint32)
+            for _ in range(3)),
+        grid=(nb, W),
+        in_specs=[
+            pl.BlockSpec((1, 1, 64, 128), lambda b, w: (b, w, 0, 0)),
+            pl.BlockSpec((1, 64, 128), lambda b, w: (b, 0, 0)),
+            pl.BlockSpec((1, 16 * NLIMBS * 8, 128), lambda b, w: (b, 0, 0)),
+            pl.BlockSpec((1, 16 * NLIMBS * 8, 128), lambda b, w: (b, 0, 0)),
+            pl.BlockSpec((1, 16 * NLIMBS * 8, 128), lambda b, w: (b, 0, 0)),
+        ],
+        out_specs=tuple(
+            pl.BlockSpec((1, NLIMBS * 8, 128), lambda b, w: (b, 0, 0))
+            for _ in range(3)),
+        interpret=interpret,
+    )(digl, negl, lay(trx), lay(tlrx), lay(try_))
+    return tuple(_from_rows8(o, batch) for o in outs)
 
 
 # ---------------------------------------------------------------------------

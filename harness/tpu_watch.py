@@ -374,6 +374,13 @@ def _run_experiments() -> None:
                       os.path.join(_REPO, "harness/measure_recover.py"),
                       "1024"],
          {**env, "EGES_TPU_LANE_BLOCK": "1024"}),
+        # (8,128)-packed limb rows for the ladder + pow kernels (8x VPU
+        # sublane utilization if layout is the bound); measure_recover's
+        # correctness gate vets it before the timing means anything
+        ("rows8_1024", [sys.executable,
+                        os.path.join(_REPO, "harness/measure_recover.py"),
+                        "1024"],
+         {**env, "EGES_TPU_LANE_BLOCK": "1024", "EGES_TPU_ROWS8": "1"}),
     ]
     with open(outp, "a") as f:
         for name, argv, jenv in jobs:

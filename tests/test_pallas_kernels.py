@@ -829,3 +829,21 @@ def test_fused_pipeline_end_to_end_numpy():
         assert bytes(dig_bytes[i][12:32]) == want, f"row {i} addr"
     for i in range(B_valid, B):
         assert ok[i] == 0, f"invalid row {i} accepted"
+
+
+def test_rows8_layout_roundtrip():
+    """The (8,128) re-lay helpers: _to_rows8/_from_rows8 are inverses
+    and place batch b = blk*1024 + sublane*128 + lane at row
+    limb*8 + sublane — the index contract the rows8 kernels read."""
+    from eges_tpu.ops.pallas_kernels import _from_rows8, _to_rows8
+
+    B = 2048
+    a = jnp.asarray(np.arange(B * 16, dtype=np.uint32).reshape(B, 16))
+    t = np.asarray(_to_rows8(a))
+    assert t.shape == (2, 128, 128)
+    for blk, s, l, k in ((0, 0, 0, 0), (0, 3, 17, 5), (1, 7, 127, 15),
+                         (1, 2, 64, 8)):
+        b = blk * 1024 + s * 128 + l
+        assert t[blk, k * 8 + s, l] == np.asarray(a)[b, k], (blk, s, l, k)
+    np.testing.assert_array_equal(np.asarray(_from_rows8(jnp.asarray(t), B)),
+                                  np.asarray(a))
