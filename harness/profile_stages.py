@@ -1,12 +1,13 @@
 """Stage-level timing of the fused (v2) recover pipeline on the chip.
 
-Times jitted PREFIXES of the fused pipeline; successive differences
-attribute wall time to the scalar stage (composite prelude + pows +
-u1/u2), the double-scalar multiply (GLV kernel + table build + ladder)
-and the finish/keccak tail.  EVERY stage gets its own never-repeated
-random inputs (the tunnel backend memoizes repeat dispatches AND
-shares per-dispatch results across executables with common prefixes,
-so reused content measures nothing).
+**CAVEAT (round-4 finding): the numbers this prints are NOT
+trustworthy.**  Even with never-repeated per-stage inputs, prefix
+graphs in a multi-executable process timed 0.07-0.85 ms where
+independent fresh-process runs of the same functions measure
+80-120 ms — `block_until_ready` returns early / results are shared in
+ways we could not pin down.  Kept only as a record of the instrument
+that failed; use `measure_recover.py` (independent process, fresh
+content, full pipeline) for anything that feeds a decision.
 """
 
 import os
