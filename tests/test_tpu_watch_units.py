@@ -1,0 +1,54 @@
+"""Unit tests for the watcher's one-shot experiment state machine
+(harness/tpu_watch.py): done only on a TPU-device success, bounded
+retries on failure, and no re-runs once concluded — the logic that
+protects scarce tunnel windows from being re-burned."""
+
+import importlib
+import os
+
+
+def _load(tmp_path, monkeypatch, outcomes):
+    import harness.tpu_watch as tw
+
+    importlib.reload(tw)
+    monkeypatch.setattr(tw, "_DIR", str(tmp_path))
+    monkeypatch.setattr(tw, "_REPO", str(tmp_path))
+    calls = []
+
+    def fake_run_child(argv, timeout, env=None):
+        name = "mulchain" if "mulchain" in " ".join(argv) else (
+            "rows8_1024" if env and env.get("EGES_TPU_ROWS8") == "1"
+            else "lane1024")
+        calls.append(name)
+        rc, out = outcomes[name].pop(0)
+        return rc, out
+
+    monkeypatch.setattr(tw, "_run_child", fake_run_child)
+    return tw, calls
+
+
+def test_experiment_done_requires_tpu_device(tmp_path, monkeypatch):
+    tw, calls = _load(tmp_path, monkeypatch, {
+        "mulchain": [(0, "device: TPU v5 lite0\nok")],
+        "lane1024": [(0, "device: TFRT_CPU_0\ncpu fallback"),
+                     (0, "device: TPU v5 lite0\nok")],
+        "rows8_1024": [(1, "boom"), (1, "boom"), (1, "boom")],
+    })
+    tw._run_experiments()
+    # mulchain: TPU success -> done on first try
+    assert os.path.exists(tmp_path / "exp_mulchain.done")
+    # lane1024: CPU-fallback success does NOT conclude the experiment
+    assert not os.path.exists(tmp_path / "exp_lane1024.done")
+    # second window: lane1024 retries and lands on TPU; mulchain skipped
+    tw._run_experiments()
+    assert os.path.exists(tmp_path / "exp_lane1024.done")
+    assert calls.count("mulchain") == 1
+
+    # rows8: three conclusive failures across windows -> .failed, then
+    # never attempted again
+    tw._run_experiments()
+    assert os.path.exists(tmp_path / "exp_rows8_1024.failed")
+    n = calls.count("rows8_1024")
+    tw._run_experiments()
+    assert calls.count("rows8_1024") == n
+    assert n == 3
