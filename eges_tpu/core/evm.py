@@ -19,8 +19,20 @@ Design choices vs the reference:
 * Storage writes accumulate in a per-frame cache and flush as one merge
   per touched account (``set_storage_many``), so SSTORE in a loop is
   O(1) amortized instead of O(account storage).
-* No gas refund counter, no SELFDESTRUCT refund, no access lists —
-  documented simplifications that keep the schedule monotone.
+* The interpreter is a GENERATOR driven by an explicit frame trampoline
+  (``_drive``): a CALL/CREATE opcode *yields* a sub-call request instead
+  of recursing, so Python stack depth stays O(1) at any EVM depth — the
+  full ``params.CallCreateDepth = 1024`` of the reference
+  (core/vm/evm.go:44) with no ``setrecursionlimit`` hack and no
+  interpreter-crash class (r5 verdict item 6).
+* Byzantium-rule gas refund counter: 15 000 per SSTORE nonzero->zero
+  (ref: core/vm/gas_table.go:117 gasSStore pre-Constantinople) and
+  24 000 per first SELFDESTRUCT of an address (params.SuicideRefundGas),
+  rolled back frame-wise on revert like the reference's journal; the
+  txn-level cap of gas_used/2 is applied in
+  :func:`eges_tpu.core.state.apply_txn` (core/state_transition.go
+  refundGas).  No access lists (post-Berlin; out of the reference's
+  chain-config scope).
 """
 
 from __future__ import annotations
@@ -34,15 +46,7 @@ from eges_tpu.crypto.keccak import keccak256
 U256 = 1 << 256
 MAXU = U256 - 1
 STACK_LIMIT = 1024
-CALL_DEPTH_LIMIT = 256  # the reference allows 1024 (params.CallCreateDepth);
-#                         capped lower here to stay inside Python recursion
-
-import sys as _sys
-
-if _sys.getrecursionlimit() < 4000:
-    # each EVM call level costs a handful of Python frames; the default
-    # 1000-frame limit sits below CALL_DEPTH_LIMIT's worst case
-    _sys.setrecursionlimit(4000)
+CALL_DEPTH_LIMIT = 1024  # params.CallCreateDepth (core/vm/evm.go:44)
 
 
 class EvmError(Exception):
@@ -87,6 +91,10 @@ G_CODE_DEPOSIT_BYTE = 200
 G_MEMORY_WORD = 3
 G_EXTCODE = 700
 G_SELF_DESTRUCT = 5_000
+# refunds (ref: params/protocol_params.go SstoreRefundGas /
+# SuicideRefundGas; accounting in core/vm/gas_table.go:117)
+R_SCLEAR = 15_000
+R_SELFDESTRUCT = 24_000
 
 
 @dataclass
@@ -127,6 +135,26 @@ class _Frame:
     swrites: dict = field(default_factory=dict)  # slot -> value cache
 
 
+@dataclass
+class _Task:
+    """One live frame on the trampoline's explicit stack: the suspended
+    interpreter generator plus everything needed to commit or roll back
+    when it finishes (the per-frame half of geth's journal)."""
+
+    kind: str              # "call" | "codecall" | "create"
+    gen: object            # suspended _run generator
+    frame: _Frame
+    depth: int
+    snapshot: object       # parent state: absorb target / restore point
+    frame_state: object    # overlay this frame runs on
+    log_mark: int
+    refund_mark: int
+    suicide_mark: frozenset
+    gas: int               # gas handed to the frame
+    to: bytes              # account that receives the storage write-set
+    new_addr: bytes | None = None
+
+
 def _words(n: int) -> int:
     return (n + 31) // 32
 
@@ -158,6 +186,11 @@ class EVM:
         # per-opcode hook (ref: vm.Config.Tracer -> interpreter.Run's
         # CaptureState) — see eges_tpu.core.tracer.StructLogTracer
         self.tracer = tracer
+        # Byzantium refund counter + self-destruct set (ref:
+        # state.GetRefund / HasSuicided); both roll back frame-wise on
+        # revert via the per-task marks, like the reference's journal
+        self.refund = 0
+        self.suicides: set[bytes] = set()
 
     # -- precompiles (ref: core/vm/contracts.go) ------------------------
 
@@ -325,115 +358,183 @@ class EVM:
             return b""
 
     # -- entry points ----------------------------------------------------
+    #
+    # call()/create() build a root request and hand it to the frame
+    # trampoline.  All nesting happens on an EXPLICIT task stack — a
+    # CALL opcode yields a request instead of recursing, so EVM depth
+    # 1024 costs 1024 suspended generators, not 1024 * k Python stack
+    # frames (the reference runs frames on goroutine stacks,
+    # core/vm/evm.go Call -> interpreter.Run; goroutines grow, CPython
+    # frames don't — hence this redesign rather than a recursion bump).
 
     def call(self, caller: bytes, to: bytes, value: int, data: bytes,
              gas: int, *, depth: int = 0, static: bool = False,
              origin: bytes | None = None) -> ExecResult:
         """Message call against ``to`` (ref: evm.Call, core/vm/evm.go)."""
         origin = origin if origin is not None else caller
-        if depth > CALL_DEPTH_LIMIT:
-            return ExecResult(False, gas)
-        if value and self.state.balance(caller) < value:
-            # insufficient balance fails the call WITHOUT consuming gas
-            # (ref: evm.Call ErrInsufficientBalance returns the gas)
-            return ExecResult(False, 0)
-        snapshot = self.state
-        frame_state = snapshot.copy()
-        prev_state, self.state = self.state, frame_state
-        log_mark = len(self.logs)
-        try:
-            pre = self._precompile(int.from_bytes(to, "big"), data, gas) \
-                if 1 <= int.from_bytes(to, "big") <= 8 else None
-            if value:
-                if static:
-                    raise EvmError("static value transfer")
-                frame_state.sub_balance(caller, value)
-                frame_state.add_balance(to, value)
-            if pre is not None:
-                out, gas_left = pre
-                snapshot.absorb(frame_state)
-                return ExecResult(True, gas - gas_left, out)
-            code = frame_state.code(to)
-            if not code:
-                snapshot.absorb(frame_state)
-                return ExecResult(True, 0, b"")
-            frame = _Frame(code=code, addr=to, caller=caller, origin=origin,
-                           value=value, data=data, gas=gas, static=static)
-            out = self._run(frame, depth)
-            if self.tracer is not None:
-                self.tracer.on_frame_end(depth, frame.gas)
-            frame_state.set_storage_many(to, frame.swrites)
-            snapshot.absorb(frame_state)
-            return ExecResult(True, gas - frame.gas, out)
-        except Revert as r:
-            del self.logs[log_mark:]
-            if self.tracer is not None:
-                self.tracer.on_fault(depth, getattr(r, "gas_left", 0),
-                                     "execution reverted")
-                if depth == 0:  # only the txn-level frame's revert data
-                    self.tracer.output = r.data  # is the trace's output
-            return ExecResult(False, gas - getattr(r, "gas_left", 0),
-                              r.data)
-        except (EvmError, StateError) as e:
-            del self.logs[log_mark:]
-            if self.tracer is not None:
-                self.tracer.on_fault(depth, 0, str(e) or "evm error")
-            return ExecResult(False, gas)  # all gas consumed
-        finally:
-            self.state = prev_state
+        return self._drive(
+            "call", (caller, to, value, data, gas, static, origin), depth)
 
     def create(self, caller: bytes, value: int, init_code: bytes,
                gas: int, nonce: int, *, depth: int = 0,
                origin: bytes | None = None) -> ExecResult:
         """Contract creation (ref: evm.Create)."""
-        from eges_tpu.core.state import contract_address
-
         origin = origin if origin is not None else caller
+        return self._drive(
+            "create", (caller, value, init_code, gas, nonce, origin), depth)
+
+    # -- frame trampoline -------------------------------------------------
+
+    def _drive(self, kind: str, args: tuple, depth: int) -> ExecResult:
+        """Run the frame machine to completion.
+
+        ``result`` carries a finished child's ExecResult into its
+        suspended parent generator; ``None`` starts a fresh one (the
+        two cases are exactly ``gen.send``'s contract)."""
+        first = self._begin(kind, args, depth)
+        if isinstance(first, ExecResult):
+            return first
+        stack: list[_Task] = [first]
+        result = None
+        while stack:
+            task = stack[-1]
+            try:
+                req = task.gen.send(result)
+                result = None
+            except StopIteration as si:
+                res = self._finish_ok(
+                    task, si.value if si.value is not None else b"")
+            except Revert as r:
+                res = self._finish_revert(task, r)
+            except (EvmError, StateError) as e:
+                res = self._finish_err(task, e)
+            else:
+                sub = self._begin(req[0], req[1], task.depth + 1)
+                if isinstance(sub, ExecResult):
+                    result = sub       # fast path: deliver immediately
+                else:
+                    stack.append(sub)  # result stays None: start child
+                continue
+            stack.pop()
+            result = res
+        return result
+
+    def _begin(self, kind: str, args: tuple, depth: int):
+        """Entry checks + frame setup for one call/create/codecall.
+
+        Returns an ExecResult for the fast/failure paths (depth, balance,
+        precompiles, empty code) or a :class:`_Task` to push.  Mirrors
+        evm.Call / evm.CallCode / evm.DelegateCall / evm.Create.  Depth
+        and balance failures RETURN the gas (gas_used = 0), per the
+        reference's ErrDepth/ErrInsufficientBalance handling — the old
+        depth path here consumed it, a parity bug."""
+        if kind == "create":
+            return self._begin_create(args, depth)
+        if kind == "call":
+            caller, to, value, data, gas, static, origin = args
+            code_addr = storage_addr = to
+        else:  # codecall: callee code in the caller's storage context
+            code_addr, storage_addr, value, data, gas, caller, origin, \
+                static = args
         if depth > CALL_DEPTH_LIMIT:
-            return ExecResult(False, gas)
-        if value and self.state.balance(caller) < value:
-            return ExecResult(False, 0)  # gas returned, like evm.Create
-        new_addr = contract_address(caller, nonce)
+            return ExecResult(False, 0)
+        if kind == "call" and value \
+                and self.state.balance(caller) < value:
+            return ExecResult(False, 0)
         snapshot = self.state
         frame_state = snapshot.copy()
-        prev_state, self.state = self.state, frame_state
-        log_mark = len(self.logs)
+        to_int = int.from_bytes(code_addr, "big")
         try:
-            if frame_state.code(new_addr) or frame_state.nonce(new_addr):
-                raise EvmError("contract collision")
-            if value:
+            if kind == "call" and value:
+                if static:
+                    raise EvmError("static value transfer")
                 frame_state.sub_balance(caller, value)
-                frame_state.add_balance(new_addr, value)
-            frame_state.bump_nonce(new_addr)
-            frame = _Frame(code=init_code, addr=new_addr, caller=caller,
-                           origin=origin, value=value, data=b"", gas=gas,
-                           static=False)
-            out = self._run(frame, depth)
-            if self.tracer is not None:
-                self.tracer.on_frame_end(depth, frame.gas)
-            deposit = G_CODE_DEPOSIT_BYTE * len(out)
-            if frame.gas < deposit:
-                raise EvmError("oog:code deposit")
-            frame.gas -= deposit
-            frame_state.set_storage_many(new_addr, frame.swrites)
-            frame_state.set_code(new_addr, bytes(out))
-            snapshot.absorb(frame_state)
-            return ExecResult(True, gas - frame.gas, b"", created=new_addr)
-        except Revert as r:
-            del self.logs[log_mark:]
-            if self.tracer is not None:
-                self.tracer.on_fault(depth, getattr(r, "gas_left", 0),
-                                     "execution reverted")
-                if depth == 0:  # constructor revert reason, as in call()
-                    self.tracer.output = r.data
-            return ExecResult(False, gas - getattr(r, "gas_left", 0), r.data)
-        except (EvmError, StateError) as e:
-            del self.logs[log_mark:]
-            if self.tracer is not None:
-                self.tracer.on_fault(depth, 0, str(e) or "evm error")
+                frame_state.add_balance(to, value)
+            if 1 <= to_int <= 8:
+                out, gas_left = self._precompile(to_int, data, gas)
+                snapshot.absorb(frame_state)
+                return ExecResult(True, gas - gas_left, out)
+        except (EvmError, StateError):
             return ExecResult(False, gas)
-        finally:
-            self.state = prev_state
+        code = frame_state.code(code_addr)
+        if not code:
+            snapshot.absorb(frame_state)
+            return ExecResult(True, 0, b"")
+        frame = _Frame(code=code, addr=storage_addr, caller=caller,
+                       origin=origin, value=value, data=data, gas=gas,
+                       static=static)
+        self.state = frame_state
+        return _Task(kind, self._run(frame, depth), frame, depth, snapshot,
+                     frame_state, len(self.logs), self.refund,
+                     frozenset(self.suicides), gas, storage_addr)
+
+    def _begin_create(self, args: tuple, depth: int):
+        from eges_tpu.core.state import contract_address
+
+        caller, value, init_code, gas, nonce, origin = args
+        if depth > CALL_DEPTH_LIMIT:
+            return ExecResult(False, 0)
+        if value and self.state.balance(caller) < value:
+            return ExecResult(False, 0)
+        new_addr = contract_address(caller, nonce)
+        snapshot = self.state
+        if snapshot.code(new_addr) or snapshot.nonce(new_addr):
+            # collision consumes all gas (evm.Create
+            # ErrContractAddressCollision)
+            return ExecResult(False, gas)
+        frame_state = snapshot.copy()
+        if value:
+            frame_state.sub_balance(caller, value)
+            frame_state.add_balance(new_addr, value)
+        frame_state.bump_nonce(new_addr)
+        frame = _Frame(code=init_code, addr=new_addr, caller=caller,
+                       origin=origin, value=value, data=b"", gas=gas,
+                       static=False)
+        self.state = frame_state
+        return _Task("create", self._run(frame, depth), frame, depth,
+                     snapshot, frame_state, len(self.logs), self.refund,
+                     frozenset(self.suicides), gas, new_addr, new_addr)
+
+    def _finish_ok(self, task: "_Task", out: bytes) -> ExecResult:
+        f = task.frame
+        if self.tracer is not None:
+            self.tracer.on_frame_end(task.depth, f.gas)
+        if task.kind == "create":
+            deposit = G_CODE_DEPOSIT_BYTE * len(out)
+            if f.gas < deposit:
+                return self._finish_err(task, EvmError("oog:code deposit"))
+            f.gas -= deposit
+            task.frame_state.set_storage_many(task.to, f.swrites)
+            task.frame_state.set_code(task.to, bytes(out))
+            task.snapshot.absorb(task.frame_state)
+            self.state = task.snapshot
+            return ExecResult(True, task.gas - f.gas, b"",
+                              created=task.new_addr)
+        task.frame_state.set_storage_many(task.to, f.swrites)
+        task.snapshot.absorb(task.frame_state)
+        self.state = task.snapshot
+        return ExecResult(True, task.gas - f.gas, out)
+
+    def _finish_revert(self, task: "_Task", r: Revert) -> ExecResult:
+        del self.logs[task.log_mark:]
+        self.refund = task.refund_mark
+        self.suicides = set(task.suicide_mark)
+        gas_left = getattr(r, "gas_left", 0)
+        if self.tracer is not None:
+            self.tracer.on_fault(task.depth, gas_left, "execution reverted")
+            if task.depth == 0:  # only the txn-level frame's revert data
+                self.tracer.output = r.data  # is the trace's output
+        self.state = task.snapshot
+        return ExecResult(False, task.gas - gas_left, r.data)
+
+    def _finish_err(self, task: "_Task", e: Exception) -> ExecResult:
+        del self.logs[task.log_mark:]
+        self.refund = task.refund_mark
+        self.suicides = set(task.suicide_mark)
+        if self.tracer is not None:
+            self.tracer.on_fault(task.depth, 0, str(e) or "evm error")
+        self.state = task.snapshot
+        return ExecResult(False, task.gas)  # all gas consumed
 
     def _flush_storage(self, f: "_Frame") -> None:
         """Push the frame's SSTORE cache into the live state before a
@@ -683,7 +784,15 @@ class EVM:
                 cur = f.swrites.get(slot)
                 if cur is None:
                     cur = self.state.storage_at(f.addr, slot)
-                use(G_SSTORE_SET if (cur == 0 and v != 0) else G_SSTORE_RESET)
+                # pre-Constantinople rules (gas_table.go:117 gasSStore):
+                # 0->nonzero SET, else RESET; nonzero->0 earns the
+                # 15 000 clear refund
+                if cur == 0 and v != 0:
+                    use(G_SSTORE_SET)
+                else:
+                    use(G_SSTORE_RESET)
+                    if cur != 0 and v == 0:
+                        self.refund += R_SCLEAR
                 f.swrites[slot] = v
             elif op == 0x56:  # JUMP
                 use(G_MID); dst = pop()
@@ -727,9 +836,9 @@ class EVM:
                 f.gas -= gas_for
                 self._flush_storage(f)
                 self.state.bump_nonce(f.addr)
-                res = self.create(f.addr, value, init, gas_for,
-                                  self.state.nonce(f.addr) - 1,
-                                  depth=depth + 1, origin=f.origin)
+                res = yield ("create", (f.addr, value, init, gas_for,
+                                        self.state.nonce(f.addr) - 1,
+                                        f.origin))
                 f.gas += gas_for - res.gas_used
                 f.ret = res.output if not res.success else b""
                 push(int.from_bytes(res.created, "big")
@@ -769,21 +878,20 @@ class EVM:
                     # (ref: evm.CallCode CanTransfer); gas is returned
                     res = ExecResult(False, 0)
                 elif op == 0xF1:  # CALL
-                    res = self.call(f.addr, to, value, data,
-                                    gas_for + stipend, depth=depth + 1,
-                                    static=f.static, origin=f.origin)
+                    res = yield ("call", (f.addr, to, value, data,
+                                          gas_for + stipend, f.static,
+                                          f.origin))
                 elif op == 0xF2:  # CALLCODE: callee code, our storage
-                    res = self._call_with_code(
-                        f, to, f.addr, value, data, gas_for + stipend,
-                        depth, caller=f.addr, static=f.static)
+                    res = yield ("codecall", (to, f.addr, value, data,
+                                              gas_for + stipend, f.addr,
+                                              f.origin, f.static))
                 elif op == 0xF4:  # DELEGATECALL: keep caller+value
-                    res = self._call_with_code(
-                        f, to, f.addr, f.value, data, gas_for, depth,
-                        caller=f.caller, static=f.static)
+                    res = yield ("codecall", (to, f.addr, f.value, data,
+                                              gas_for, f.caller,
+                                              f.origin, f.static))
                 else:  # STATICCALL
-                    res = self.call(f.addr, to, 0, data, gas_for,
-                                    depth=depth + 1, static=True,
-                                    origin=f.origin)
+                    res = yield ("call", (f.addr, to, 0, data, gas_for,
+                                          True, f.origin))
                 # leftover callee gas (incl. unused stipend) returns to
                 # the caller, matching the reference's accounting
                 # (contract.Gas += returnGas, core/vm/evm.go Call)
@@ -806,59 +914,33 @@ class EVM:
                 raise r
             elif op == 0xFE:  # INVALID
                 raise EvmError("invalid opcode 0xfe")
-            elif op == 0xFF:  # SELFDESTRUCT (simplified: sweep balance)
+            elif op == 0xFF:  # SELFDESTRUCT
                 if f.static:
                     raise EvmError("static selfdestruct")
-                use(G_SELF_DESTRUCT)
                 heir = pop().to_bytes(32, "big")[12:]
                 bal = self.state.balance(f.addr)
+                cost = G_SELF_DESTRUCT
+                if bal and not self.state.nonce(heir) \
+                        and not self.state.balance(heir) \
+                        and not self.state.code(heir):
+                    # sweeping into a non-existent account pays the
+                    # account-creation surcharge (gas_table.go
+                    # gasSelfdestruct, EIP-150 rules)
+                    cost += G_NEW_ACCOUNT
+                use(cost)
+                if f.addr not in self.suicides:
+                    # 24 000 once per address per txn
+                    # (params.SuicideRefundGas via HasSuicided)
+                    self.refund += R_SELFDESTRUCT
+                    self.suicides.add(f.addr)
                 if bal:
                     self.state.sub_balance(f.addr, bal)
                     self.state.add_balance(heir, bal)
+                # the account itself is deleted at txn finalization
+                # (state.apply_txn), matching Finalise-time deletion
                 return b""
             else:
                 raise EvmError(f"unknown opcode {op:#x}")
-
-    def _call_with_code(self, parent: _Frame, code_addr: bytes,
-                        storage_addr: bytes, value: int, data: bytes,
-                        gas: int, depth: int, *, caller: bytes,
-                        static: bool) -> ExecResult:
-        """CALLCODE/DELEGATECALL: run ``code_addr``'s code in
-        ``storage_addr``'s storage context (ref: evm.CallCode/DelegateCall)."""
-        if depth + 1 > CALL_DEPTH_LIMIT:
-            return ExecResult(False, gas)
-        snapshot = self.state
-        frame_state = snapshot.copy()
-        prev, self.state = self.state, frame_state
-        log_mark = len(self.logs)
-        try:
-            code = frame_state.code(code_addr)
-            pre = self._precompile(int.from_bytes(code_addr, "big"), data,
-                                   gas) \
-                if 1 <= int.from_bytes(code_addr, "big") <= 8 else None
-            if pre is not None:
-                out, gas_left = pre
-                snapshot.absorb(frame_state)
-                return ExecResult(True, gas - gas_left, out)
-            if not code:
-                snapshot.absorb(frame_state)
-                return ExecResult(True, 0, b"")
-            frame = _Frame(code=code, addr=storage_addr, caller=caller,
-                           origin=parent.origin, value=value, data=data,
-                           gas=gas, static=static)
-            out = self._run(frame, depth + 1)
-            frame_state.set_storage_many(storage_addr, frame.swrites)
-            snapshot.absorb(frame_state)
-            return ExecResult(True, gas - frame.gas, out)
-        except Revert as r:
-            del self.logs[log_mark:]
-            return ExecResult(False, gas - getattr(r, "gas_left", 0), r.data)
-        except (EvmError, StateError):
-            del self.logs[log_mark:]
-            return ExecResult(False, gas)
-        finally:
-            self.state = prev
-
 
 def _jumpdests(code: bytes) -> set[int]:
     """Valid JUMPDEST offsets (PUSH data bytes excluded)."""

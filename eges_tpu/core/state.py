@@ -72,6 +72,11 @@ class ContractStorage:
             self._root = self._trie.root()
         return self._root
 
+    def items(self):
+        """(hashed_slot_key, value_rlp) leaf pairs — the state-sync
+        serialization surface (see core/statesync.py)."""
+        return self._trie.items()
+
     # Account is a frozen dataclass: equality/hash flow through fields,
     # and a storage tree's identity IS its root commitment
     def __eq__(self, other):
@@ -180,7 +185,8 @@ class StateDB:
     collapses to "throw the overlay away" under the single insert funnel.
     """
 
-    __slots__ = ("_base", "_local", "_trie", "_dirty", "_root_cache",
+    __slots__ = ("_origin", "_base", "_local", "_trie", "_dirty",
+                 "_root_cache",
                  "_codes")
 
     # flatten overlay chains deeper than this so reads stay O(1)-ish
@@ -188,6 +194,7 @@ class StateDB:
 
     def __init__(self, accounts: dict[bytes, Account] | None = None):
         self._base: StateDB | None = None
+        self._origin: StateDB | None = None  # pre-flatten parent (absorb)
         # addr -> Account (live) | None (deleted/empty)
         self._local: dict[bytes, Account | None] = dict(accounts or {})
         from eges_tpu.core.trie import SecureIncrementalTrie
@@ -207,13 +214,30 @@ class StateDB:
 
     def copy(self) -> "StateDB":
         if self._depth() >= self._MAX_DEPTH:
-            # flatten SELF (not the child): reads stay O(1)-ish and the
-            # child keeps ``child._base is self``, which absorb() relies
-            # on (EVM frame commits)
-            self._local = dict(self.iter_accounts())
+            # Flatten SELF (not the child) so reads stay O(1)-ish.  Two
+            # invariants matter here (both broke silently before r5's
+            # depth-1024 EVM exposed them):
+            #  * deletion TOMBSTONES (None entries) must survive — a raw
+            #    overlay merge keeps them, iter_accounts() would drop
+            #    them and a parent absorb() would resurrect the account;
+            #  * our own parent link is consumed by the flatten, but the
+            #    EVM will still absorb() us into that parent when the
+            #    frame commits — record it in ``_origin`` so absorb can
+            #    verify lineage.
+            chain = []
+            s = self
+            while s is not None:
+                chain.append(s)
+                s = s._base
+            merged: dict[bytes, Account | None] = {}
+            for s in reversed(chain):       # oldest first, newest wins
+                merged.update(s._local)
+            self._local = merged
+            self._origin = self._base
             self._base = None
         child = StateDB.__new__(StateDB)
         child._base = self
+        child._origin = None
         child._local = {}
         child._trie = self._trie
         child._dirty = set(self._dirty)
@@ -316,7 +340,12 @@ class StateDB:
         a copy and either absorb (success) or drop (revert), replacing
         the reference's journal/revert machinery
         (core/state/journal.go)."""
-        assert child._base is self, "absorb requires a direct child"
+        # a child that flattened itself (deep EVM frames) carries the
+        # parent link in _origin instead; its _local then holds the
+        # complete merged view, which merges just as correctly
+        assert child._base is self \
+            or getattr(child, "_origin", None) is self, \
+            "absorb requires a direct child"
         for addr, acct in child._local.items():
             self._local[addr] = acct
             self._dirty.add(addr)
@@ -449,6 +478,17 @@ def apply_txn(state: StateDB, txn, sender: bytes, coinbase: bytes,
     else:
         res = e.call(sender, txn.to, txn.value, data, exec_gas)
     gas_used = intrinsic + min(res.gas_used, exec_gas)
+    # Byzantium refund counter, capped at half the gas used (ref:
+    # core/state_transition.go refundGas: refund = gasUsed/2 min
+    # state.GetRefund()).  A failed root frame rolled its refunds back
+    # to zero inside the EVM, so applying unconditionally is exact.
+    gas_used -= min(e.refund, gas_used // 2)
+    if res.success:
+        # accounts self-destructed by surviving frames are deleted at
+        # txn finalization (ref: StateDB.Finalise deleteEmptyObjects
+        # path for suicided objects); balances were swept at op time
+        for addr in e.suicides:
+            state.set_account(addr, Account())
     refund = (gas_limit - gas_used) * txn.gas_price
     if refund:
         state.add_balance(sender, refund)
