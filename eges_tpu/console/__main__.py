@@ -152,6 +152,12 @@ def main(argv=None) -> None:
         # (ref role: console/ otto surface).
         "true": True, "false": False, "null": None,
     }
+    # contract ABI helpers (encode_call/decode_output/selector): lets an
+    # operator do eth.call with real calldata from the console, the role
+    # geth's console fills via web3.eth.abi
+    from eges_tpu.core import abi as _abi
+
+    ns["abi"] = _abi
     if args.exec:
         print(eval(args.exec, ns))  # noqa: S307 - operator-driven REPL
         return
@@ -159,7 +165,18 @@ def main(argv=None) -> None:
     banner = (f"eges-tpu console — attached to {args.rpc}\n"
               "namespaces: rpc(method, *params), eth, thw, net, debug\n"
               "tab completes; history persists across sessions")
-    code.interact(banner=banner, local=ns)
+
+    class _Console(code.InteractiveConsole):
+        # the REPL shares ns across statements, so `true = 5` would
+        # rebind the JS-literal shim for the rest of the session; re-pin
+        # the three literals after every statement (r4 advisor finding)
+        def push(self, line, **kw):
+            more = super().push(line, **kw)
+            if not more:
+                ns["true"], ns["false"], ns["null"] = True, False, None
+            return more
+
+    _Console(locals=ns).interact(banner=banner)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,306 @@
+"""Contract ABI encoding/decoding (ref role: accounts/abi/abi.go:1,
+type.go, argument.go, event.go).
+
+Fills the last user-facing gap between ``eth_call``/``eth_estimateGas``
+and real contracts: without this, calldata had to be hand-packed
+(r5 verdict item 9).  Scope matches the reference package's v1 ABI:
+
+* elementary types — ``uint8..uint256``, ``int8..int256``, ``address``,
+  ``bool``, ``bytes1..bytes32``, ``bytes``, ``string``
+* composite types — fixed arrays ``T[k]``, dynamic arrays ``T[]``
+  (arbitrarily nested), and tuples ``(T1,T2,…)``
+* the head/tail encoding scheme: static values inline, dynamic values
+  as a 32-byte offset into the tail region
+* 4-byte function selectors (``keccak256(sig)[:4]``) and 32-byte event
+  topics
+
+Design vs the reference: geth builds reflection-driven Go struct
+marshalling on top of the scheme; here the surface is plain Python
+values (int/bytes/str/bool/list/tuple), which is what the RPC layer and
+console hand around anyway — no reflection layer to port.
+"""
+
+from __future__ import annotations
+
+import re
+
+from eges_tpu.crypto.keccak import keccak256
+
+__all__ = [
+    "AbiError", "encode", "decode", "selector", "event_topic",
+    "encode_call", "decode_output",
+]
+
+
+class AbiError(ValueError):
+    pass
+
+
+# -- type grammar -----------------------------------------------------------
+
+_ELEM = re.compile(r"^(uint|int|bytes|address|bool|string)([0-9]*)$")
+
+
+class _Type:
+    """Parsed ABI type: kind + size + element type for composites."""
+
+    __slots__ = ("kind", "size", "elem", "arity", "comps")
+
+    def __init__(self, kind, size=0, elem=None, arity=-1, comps=()):
+        self.kind = kind      # uint int address bool bytesN bytes string
+        self.size = size      # bits for u/int, bytes for bytesN
+        self.elem = elem      # element _Type for arrays
+        self.arity = arity    # fixed length, -1 = dynamic array
+        self.comps = comps    # component _Types for tuples
+
+    @property
+    def dynamic(self) -> bool:
+        if self.kind in ("bytes", "string"):
+            return True
+        if self.kind == "array":
+            return self.arity < 0 or self.elem.dynamic
+        if self.kind == "tuple":
+            return any(c.dynamic for c in self.comps)
+        return False
+
+    def head_words(self) -> int:
+        """Static footprint in 32-byte words (dynamic types head = 1)."""
+        if self.dynamic:
+            return 1
+        if self.kind == "array":
+            return self.arity * self.elem.head_words()
+        if self.kind == "tuple":
+            return sum(c.head_words() for c in self.comps)
+        return 1
+
+
+def _split_tuple(s: str) -> list[str]:
+    """Split 'a,b,(c,d)[2],e' at depth-0 commas."""
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur or parts:
+        parts.append("".join(cur))
+    return [p.strip() for p in parts if p.strip()]
+
+
+def parse_type(s: str) -> _Type:
+    s = s.strip()
+    # arrays bind outermost-last: strip ONE trailing [] / [k]
+    m = re.search(r"\[([0-9]*)\]$", s)
+    if m:
+        elem = parse_type(s[: m.start()])
+        return _Type("array", elem=elem,
+                     arity=int(m.group(1)) if m.group(1) else -1)
+    if s.startswith("(") and s.endswith(")"):
+        return _Type("tuple",
+                     comps=tuple(parse_type(p)
+                                 for p in _split_tuple(s[1:-1])))
+    m = _ELEM.match(s)
+    if not m:
+        raise AbiError(f"unsupported ABI type {s!r}")
+    base, num = m.group(1), m.group(2)
+    if base in ("address", "bool", "string"):
+        if num:
+            raise AbiError(f"unsupported ABI type {s!r}")
+        return _Type(base)
+    if base == "bytes":
+        if not num:
+            return _Type("bytes")
+        n = int(num)
+        if not 1 <= n <= 32:
+            raise AbiError(f"bytes{n} out of range")
+        return _Type("bytesN", size=n)
+    n = int(num) if num else 256
+    if n % 8 or not 8 <= n <= 256:
+        raise AbiError(f"{base}{n} out of range")
+    return _Type(base, size=n)
+
+
+# -- encoding ---------------------------------------------------------------
+
+def _enc_word(t: _Type, v) -> bytes:
+    if t.kind == "uint":
+        v = int(v)
+        if not 0 <= v < (1 << t.size):
+            raise AbiError(f"uint{t.size} out of range: {v}")
+        return v.to_bytes(32, "big")
+    if t.kind == "int":
+        v = int(v)
+        if not -(1 << (t.size - 1)) <= v < (1 << (t.size - 1)):
+            raise AbiError(f"int{t.size} out of range: {v}")
+        return (v % (1 << 256)).to_bytes(32, "big")
+    if t.kind == "address":
+        if isinstance(v, str):
+            v = bytes.fromhex(v.removeprefix("0x"))
+        if len(v) != 20:
+            raise AbiError("address must be 20 bytes")
+        return bytes(12) + bytes(v)
+    if t.kind == "bool":
+        return (1 if v else 0).to_bytes(32, "big")
+    if t.kind == "bytesN":
+        v = bytes(v)
+        if len(v) != t.size:
+            raise AbiError(f"bytes{t.size}: got {len(v)} bytes")
+        return v.ljust(32, b"\0")
+    raise AbiError(f"not a word type: {t.kind}")
+
+
+def _encode_one(t: _Type, v) -> bytes:
+    """Encode one value of (possibly composite, possibly dynamic) type
+    ``t`` — the recursive head/tail scheme of abi.Arguments.Pack."""
+    if t.kind in ("bytes", "string"):
+        raw = v.encode() if isinstance(v, str) else bytes(v)
+        n = len(raw)
+        pad = (-n) % 32
+        return n.to_bytes(32, "big") + raw + bytes(pad)
+    if t.kind == "array":
+        vs = list(v)
+        if t.arity >= 0 and len(vs) != t.arity:
+            raise AbiError(f"array arity {t.arity}, got {len(vs)}")
+        body = _encode_seq([t.elem] * len(vs), vs)
+        if t.arity < 0:
+            return len(vs).to_bytes(32, "big") + body
+        return body
+    if t.kind == "tuple":
+        vs = list(v)
+        if len(vs) != len(t.comps):
+            raise AbiError("tuple arity mismatch")
+        return _encode_seq(list(t.comps), vs)
+    return _enc_word(t, v)
+
+
+def _encode_seq(types: list[_Type], values: list) -> bytes:
+    """head || tail for a sequence (argument list / tuple / array)."""
+    head_len = 32 * sum(t.head_words() for t in types)
+    head, tail = [], []
+    off = head_len
+    for t, v in zip(types, values):
+        enc = _encode_one(t, v)
+        if t.dynamic:
+            head.append(off.to_bytes(32, "big"))
+            tail.append(enc)
+            off += len(enc)
+        else:
+            head.append(enc)
+    return b"".join(head) + b"".join(tail)
+
+
+def encode(types: list[str], values: list) -> bytes:
+    """ABI-encode ``values`` per ``types`` (abi.Arguments.Pack)."""
+    ts = [parse_type(s) for s in types]
+    if len(ts) != len(values):
+        raise AbiError("types/values length mismatch")
+    return _encode_seq(ts, list(values))
+
+
+# -- decoding ---------------------------------------------------------------
+
+def _word(data: bytes, off: int) -> bytes:
+    if off + 32 > len(data):
+        raise AbiError("ABI data truncated")
+    return data[off : off + 32]
+
+
+def _dec_word(t: _Type, w: bytes):
+    u = int.from_bytes(w, "big")
+    if t.kind == "uint":
+        return u
+    if t.kind == "int":
+        return u - (1 << 256) if u >> 255 else u
+    if t.kind == "address":
+        return w[12:]
+    if t.kind == "bool":
+        return bool(u)
+    if t.kind == "bytesN":
+        return w[: t.size]
+    raise AbiError(f"not a word type: {t.kind}")
+
+
+def _decode_one(t: _Type, data: bytes, off: int):
+    """Decode one value rooted at ``off`` (already offset-resolved)."""
+    if t.kind in ("bytes", "string"):
+        n = int.from_bytes(_word(data, off), "big")
+        if off + 32 + n > len(data):
+            raise AbiError("ABI data truncated")
+        raw = data[off + 32 : off + 32 + n]
+        return raw.decode("utf-8", "replace") if t.kind == "string" else raw
+    if t.kind == "array":
+        if t.arity < 0:
+            n = int.from_bytes(_word(data, off), "big")
+            if n > len(data) // 32:     # cheap bomb guard before alloc
+                raise AbiError("ABI array length exceeds payload")
+            return _decode_seq([t.elem] * n, data, off + 32)
+        return _decode_seq([t.elem] * t.arity, data, off)
+    if t.kind == "tuple":
+        return tuple(_decode_seq(list(t.comps), data, off))
+    return _dec_word(t, _word(data, off))
+
+
+def _decode_seq(types: list[_Type], data: bytes, base: int) -> list:
+    out = []
+    off = base
+    for t in types:
+        if t.dynamic:
+            rel = int.from_bytes(_word(data, off), "big")
+            if rel > len(data):
+                raise AbiError("ABI offset out of bounds")
+            out.append(_decode_one(t, data, base + rel))
+            off += 32
+        else:
+            out.append(_decode_one(t, data, off))
+            off += 32 * t.head_words()
+    return out
+
+
+def decode(types: list[str], data: bytes) -> list:
+    """ABI-decode ``data`` per ``types`` (abi.Arguments.Unpack)."""
+    return _decode_seq([parse_type(s) for s in types], bytes(data), 0)
+
+
+# -- selectors / call helpers ----------------------------------------------
+
+_SIG = re.compile(r"^(\w+)\((.*)\)$")
+
+
+def _canon_sig(sig: str) -> tuple[str, list[str]]:
+    m = _SIG.match(sig.strip())
+    if not m:
+        raise AbiError(f"bad function signature {sig!r}")
+    name, args = m.group(1), _split_tuple(m.group(2))
+    # canonicalize the aliases solidity accepts in source
+    canon = [re.sub(r"\bint\b", "int256",
+                    re.sub(r"\buint\b", "uint256", a)) for a in args]
+    return name, canon
+
+
+def selector(sig: str) -> bytes:
+    """4-byte function selector (abi.Method.ID)."""
+    name, args = _canon_sig(sig)
+    return keccak256(f"{name}({','.join(args)})".encode())[:4]
+
+
+def event_topic(sig: str) -> bytes:
+    """32-byte topic0 of an event (abi.Event.ID)."""
+    name, args = _canon_sig(sig)
+    return keccak256(f"{name}({','.join(args)})".encode())
+
+
+def encode_call(sig: str, values: list) -> bytes:
+    """selector ++ encoded args: ready-made ``eth_call`` calldata."""
+    _, args = _canon_sig(sig)
+    return selector(sig) + encode(args, values)
+
+
+def decode_output(types: list[str], data: bytes):
+    """Unpack an ``eth_call`` return; single-value results unwrap."""
+    vals = decode(types, data)
+    return vals[0] if len(vals) == 1 else vals

@@ -1,0 +1,148 @@
+"""Contract ABI tests (ref: accounts/abi/abi_test.go vectors + the
+public Solidity ABI spec examples), plus an end-to-end ``eth_call``
+with ABI-packed calldata through the RPC surface (r5 verdict item 9)."""
+
+import pytest
+
+from eges_tpu.core.abi import (
+    AbiError, decode, decode_output, encode, encode_call, event_topic,
+    selector,
+)
+
+
+# -- selectors: public known-answer vectors ---------------------------------
+
+def test_known_selectors():
+    assert selector("transfer(address,uint256)").hex() == "a9059cbb"
+    assert selector("balanceOf(address)").hex() == "70a08231"
+    # solidity spec examples
+    assert selector("baz(uint32,bool)").hex() == "cdcd77c0"
+    assert selector("sam(bytes,bool,uint256[])").hex() == "a5643bf2"
+    # uint/int aliases canonicalize before hashing
+    assert selector("sam(bytes,bool,uint[])").hex() == "a5643bf2"
+
+
+def test_event_topic():
+    assert event_topic("Transfer(address,address,uint256)").hex() == (
+        "ddf252ad1be2c89b69c2b068fc378daa952ba7f163c4a11628f55a4df523b3ef")
+
+
+# -- spec encoding examples -------------------------------------------------
+
+def test_spec_example_baz():
+    out = encode(["uint32", "bool"], [69, True])
+    assert out.hex() == (
+        "0000000000000000000000000000000000000000000000000000000000000045"
+        "0000000000000000000000000000000000000000000000000000000000000001")
+
+
+def test_spec_example_sam_dynamic():
+    # sam("dave", true, [1,2,3]) — head/tail layout from the spec
+    out = encode(["bytes", "bool", "uint256[]"], [b"dave", True, [1, 2, 3]])
+    words = [out[i : i + 32].hex() for i in range(0, len(out), 32)]
+    assert words[0].endswith("60")          # offset of "dave"
+    assert words[1].endswith("01")          # true
+    assert words[2].endswith("a0")          # offset of the array
+    assert words[3].endswith("04")          # len("dave")
+    assert words[4].startswith("64617665")  # "dave" left-aligned
+    assert words[5].endswith("03")          # array length
+    assert [int(w, 16) for w in words[6:]] == [1, 2, 3]
+
+
+def test_spec_example_f_mixed():
+    # f(uint256,uint32[],bytes10,bytes) with (0x123, [0x456,0x789],
+    # "1234567890", "Hello, world!") — offsets per the spec
+    out = encode(["uint256", "uint32[]", "bytes10", "bytes"],
+                 [0x123, [0x456, 0x789], b"1234567890", b"Hello, world!"])
+    words = [out[i : i + 32].hex() for i in range(0, len(out), 32)]
+    assert int(words[0], 16) == 0x123
+    assert int(words[1], 16) == 0x80        # offset of uint32[]
+    assert words[2].startswith(b"1234567890".hex())
+    assert int(words[3], 16) == 0xE0        # offset of bytes
+    assert int(words[4], 16) == 2           # array length
+
+
+# -- round-trips ------------------------------------------------------------
+
+@pytest.mark.parametrize("types,values", [
+    (["uint256"], [2**256 - 1]),
+    (["int256"], [-1]),
+    (["int8"], [-128]),
+    (["address"], [b"\x11" * 20]),
+    (["bool", "bool"], [True, False]),
+    (["bytes32"], [b"\xab" * 32]),
+    (["bytes"], [b""]),
+    (["bytes"], [b"\x00" * 61]),
+    (["string"], ["héllo wörld"]),
+    (["uint256[]"], [[1, 2, 3, 2**255]]),
+    (["uint8[3]"], [[1, 2, 3]]),
+    (["string[]"], [["a", "bb", "ccc"]]),
+    (["uint256[][2]"], [[[1], [2, 3]]]),
+    (["(uint256,address)"], [(7, b"\x22" * 20)]),
+    (["(uint256,string)[]"], [[(1, "x"), (2, "yy")]]),
+    (["uint256", "bytes", "uint256"], [5, b"mid", 6]),
+])
+def test_round_trip(types, values):
+    enc = encode(types, values)
+    dec = decode(types, enc)
+    # normalize: tuples stay tuples, arrays come back as lists
+    def norm(v):
+        if isinstance(v, tuple):
+            return tuple(norm(x) for x in v)
+        if isinstance(v, list):
+            return [norm(x) for x in v]
+        return v
+    assert [norm(v) for v in dec] == [norm(v) for v in values]
+
+
+def test_errors():
+    with pytest.raises(AbiError):
+        encode(["uint8"], [256])
+    with pytest.raises(AbiError):
+        encode(["uint256"], [-1])
+    with pytest.raises(AbiError):
+        encode(["uint16[2]"], [[1]])
+    with pytest.raises(AbiError):
+        parse_bad = encode(["uint7"], [1])
+    with pytest.raises(AbiError):
+        decode(["uint256"], b"\x01")        # truncated
+    with pytest.raises(AbiError):
+        # declared array length far beyond the payload: bomb guard
+        decode(["uint256[]"], (32).to_bytes(32, "big")
+               + (2**200).to_bytes(32, "big"))
+
+
+# -- end-to-end: ABI-packed eth_call through the RPC surface ---------------
+
+def test_eth_call_with_abi_calldata():
+    from eges_tpu.core.chain import BlockChain, make_genesis
+    from eges_tpu.core.state import contract_address
+    from eges_tpu.core.types import Header, Transaction, new_block
+    from eges_tpu.crypto import secp256k1 as secp
+    from eges_tpu.rpc.server import RpcServer
+
+    priv = bytes([9]) * 32
+    addr = secp.pubkey_to_address(secp.privkey_to_pubkey(priv))
+    # add(uint256,uint256): returns calldata[4] + calldata[36]
+    runtime = bytes.fromhex("60043560243501600052" "60206000f3")
+    init = (bytes([0x60, len(runtime), 0x60, 0x0C, 0x60, 0x00, 0x39,
+                   0x60, len(runtime), 0x60, 0x00, 0xF3]) + runtime)
+    chain = BlockChain(genesis=make_genesis(alloc={addr: 10**19}),
+                       alloc={addr: 10**19})
+    t = Transaction(nonce=0, gas_price=2, gas_limit=500_000, to=None,
+                    value=0, payload=init).signed(priv)
+    kept, root, rroot, gas, bloom = chain.execute_preview(
+        [t], coinbase=bytes(20))
+    head = chain.head()
+    blk = new_block(Header(parent_hash=head.hash, number=1,
+                           time=head.header.time + 1, root=root,
+                           receipt_hash=rroot, gas_used=gas, bloom=bloom),
+                    txs=kept)
+    assert chain.offer(blk), chain.last_error
+    caddr = contract_address(addr, 0)
+
+    calldata = encode_call("add(uint256,uint256)", [2, 40])
+    out = RpcServer(chain).dispatch("eth_call", [{
+        "from": "0x" + addr.hex(), "to": "0x" + caddr.hex(),
+        "data": "0x" + calldata.hex()}])
+    assert decode_output(["uint256"], bytes.fromhex(out[2:])) == 42
