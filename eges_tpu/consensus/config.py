@@ -104,6 +104,13 @@ class NodeConfig:
     privkey: bytes = b""                # consensus signing key (32 bytes)
     #                                     — required when the chain runs
     #                                     with signed_votes
+    fast_sync: bool = False             # --syncmode fast: a late joiner
+    #                                     downloads the state at a pivot
+    #                                     block (root-verified against a
+    #                                     quorum-certified header) and
+    #                                     replays only the tail — O(state)
+    #                                     not O(chain).  Requires
+    #                                     signed_votes for the cert check.
 
     # TPU-native addition: verify signatures in device batches of up to
     # this many rows (the reference has no analogue — it verifies one

@@ -34,6 +34,8 @@ UDP_BLOCKS = 0x04      # backfill reply (this build; see BlockFetchReq)
 UDP_GET_BLOCKS = 0x05  # peer-directed backfill request (sync protocol)
 UDP_GET_HEADERS = 0x06  # header-first skeleton request (same req shape)
 UDP_HEADERS = 0x07      # header+cert reply (see HeadersReply)
+UDP_GET_STATE = 0x08    # fast-sync state page request (StateFetchReq)
+UDP_STATE = 0x09        # fast-sync state page reply (StateChunkReply)
 
 # Election sub-codes (ref: consensus/geec/election/election_go.go:15-18)
 MSG_ELECT = 0x01
@@ -57,6 +59,8 @@ GOSSIP_GET_HEADERS = 0x19  # header-first skeleton request (broadcast
 #                            fallback, cf. GetBlockHeadersMsg
 #                            eth/protocol.go:67)
 GOSSIP_HEADERS_REPLY = 0x1A  # header+cert batches over TCP
+GOSSIP_GET_STATE = 0x1B      # fast-sync state request, broadcast fallback
+GOSSIP_STATE_REPLY = 0x1C    # fast-sync state page over TCP (big chunks)
 
 
 @dataclass(frozen=True)
@@ -296,6 +300,72 @@ class TxnsMsg:
 
 
 @dataclass(frozen=True)
+class StateFetchReq:
+    """Fast-sync state request (ref role: eth/downloader/statesync.go:1
+    state download; GetNodeDataMsg in eth/protocol.go — redesigned at
+    ACCOUNT granularity instead of trie-node granularity, since this
+    build's snapshots are in-memory account maps, not a node database).
+
+    ``block_num = 0`` lets the SERVER choose the pivot (its head minus a
+    stability lag) — the first reply pins it and the joiner keeps asking
+    for that block.  ``cursor`` indexes into the pivot snapshot's
+    address-sorted account list."""
+
+    block_num: int
+    cursor: int
+    ip: str
+    port: int
+
+    def to_rlp(self) -> list:
+        return [self.block_num, self.cursor, self.ip.encode(), self.port]
+
+    @classmethod
+    def from_rlp(cls, item: list) -> "StateFetchReq":
+        blk, cur, ip, port = item
+        return cls(block_num=rlp.decode_uint(blk),
+                   cursor=rlp.decode_uint(cur), ip=ip.decode(),
+                   port=rlp.decode_uint(port))
+
+
+@dataclass(frozen=True)
+class StateChunkReply:
+    """One page of the pivot state snapshot.
+
+    ``accounts`` is a tuple of
+    ``(addr, nonce, balance, code_hash, ((hashed_slot, value_rlp)…))``
+    in address-sorted order starting at ``cursor``; ``codes`` carries the
+    bytecode blobs for any code hashes first referenced in this page.
+    Nothing in a reply is trusted: the joiner rebuilds the account and
+    storage tries and verifies the final root against a
+    quorum-CERTIFIED pivot header before adopting anything."""
+
+    block_num: int
+    root: bytes
+    cursor: int
+    total: int
+    accounts: tuple
+    codes: tuple
+
+    def to_rlp(self) -> list:
+        return [self.block_num, self.root, self.cursor, self.total,
+                [[a, n, b, ch, [[k, v] for k, v in slots]]
+                 for a, n, b, ch, slots in self.accounts],
+                list(self.codes)]
+
+    @classmethod
+    def from_rlp(cls, item: list) -> "StateChunkReply":
+        blk, root, cur, total, accounts, codes = item
+        return cls(
+            block_num=rlp.decode_uint(blk), root=bytes(root),
+            cursor=rlp.decode_uint(cur), total=rlp.decode_uint(total),
+            accounts=tuple(
+                (bytes(a), rlp.decode_uint(n), rlp.decode_uint(b),
+                 bytes(ch), tuple((bytes(k), bytes(v)) for k, v in slots))
+                for a, n, b, ch, slots in accounts),
+            codes=tuple(bytes(c) for c in codes))
+
+
+@dataclass(frozen=True)
 class UdpEnvelope:
     """Direct-plane envelope (ref: core/geecCore/Types.go:68-72)."""
 
@@ -321,6 +391,8 @@ _DIRECT_BODY = {
     UDP_GET_BLOCKS: BlockFetchReq,
     UDP_GET_HEADERS: BlockFetchReq,
     UDP_HEADERS: HeadersReply,
+    UDP_GET_STATE: StateFetchReq,
+    UDP_STATE: StateChunkReply,
 }
 
 
@@ -346,6 +418,8 @@ _GOSSIP_BODY = {
     GOSSIP_TXNS: TxnsMsg,
     GOSSIP_GET_HEADERS: BlockFetchReq,
     GOSSIP_HEADERS_REPLY: HeadersReply,
+    GOSSIP_GET_STATE: StateFetchReq,
+    GOSSIP_STATE_REPLY: StateChunkReply,
 }
 
 

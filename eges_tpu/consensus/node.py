@@ -124,6 +124,12 @@ class GeecNode:
         # certificate verification, mismatches drop
         self._sync_skel: dict[int, bytes] = {}
         self._skel_req_upto = 0  # header-request watermark
+        # fast-sync (statesync.go role): live download state, one-shot
+        # per session — a failed/poisoned attempt falls back to full
+        # replay rather than looping against a byzantine serving peer
+        self._fs: dict | None = None
+        self._fs_done = False
+        self._snap_cache: tuple | None = None  # serving-side page cache
         self.geec_txn_sink = None  # app-layer callback for confirmed geec txns
         self.txpool = None  # optional TxPool; proposals drain it
 
@@ -246,6 +252,10 @@ class GeecNode:
             self._serve_header_fetch(msg)
         elif code == M.GOSSIP_HEADERS_REPLY:
             self._handle_headers_reply(msg)
+        elif code == M.GOSSIP_GET_STATE:
+            self._serve_state_fetch(msg)
+        elif code == M.GOSSIP_STATE_REPLY:
+            self._handle_state_chunk(msg)
         elif code == M.GOSSIP_TXNS:
             self._handle_txns(msg)
 
@@ -268,6 +278,10 @@ class GeecNode:
             self._serve_header_fetch(msg)
         elif code == M.UDP_HEADERS:
             self._handle_headers_reply(msg)
+        elif code == M.UDP_GET_STATE:
+            self._serve_state_fetch(msg)
+        elif code == M.UDP_STATE:
+            self._handle_state_chunk(msg)
 
     def on_geec_txn(self, payload: bytes) -> None:
         """UDP txn ingest (ref: consensus/geec/geec_api.go:28-41)."""
@@ -961,6 +975,14 @@ class GeecNode:
     HDR_FANOUT = 2         # concurrent header lanes
     SKEL_AHEAD = 4096      # skeleton prefetch horizon past the head
     SKEL_MAX = 16384       # pinned hashes cap (32B each)
+    # fast-sync knobs (statesync.go role)
+    FASTSYNC_MIN_GAP = 128   # replaying fewer blocks than this is cheaper
+    #                          than a state download round-trip
+    PIVOT_LAG = 32           # serve state this far behind head: deep
+    #                          enough to be reorg-stable, shallow enough
+    #                          that the tail replay stays short
+    STATE_PAGE_BYTES = 36_000  # per-reply account payload budget (UDP)
+    STATE_PAGE_MAX = 512       # accounts per page cap
 
     def _request_backfill(self, target: int, start: int | None = None) -> None:
         """Start (or extend) a sync toward ``target``.
@@ -972,6 +994,17 @@ class GeecNode:
         after SYNC_MAX_STALL rotations is abandoned (a forged confirm
         number must not keep the node polling forever)."""
         self._sync_target = max(getattr(self, "_sync_target", 0), target)
+        # fast-sync entry (statesync.go role): a large-enough gap on a
+        # fast_sync node downloads the pivot STATE instead of replaying
+        # every block; certificates (signed votes) are what let the
+        # joiner trust the pivot root, so unsigned chains always replay
+        if (self.cfg.fast_sync and self._signing and not self._fs_done
+                and target - self.chain.height() > self.FASTSYNC_MIN_GAP):
+            if self._fs is None:
+                self._fastsync_start(target)
+            return
+        if self._fs is not None:
+            return  # the state download owns sync until it resolves
         if "backfill" not in self._timers:
             self._sync_progress = False
             self._sync_tick(start=start, retry=0)
@@ -1195,6 +1228,204 @@ class GeecNode:
             lambda t: M.HeadersReply(headers=t),
             M.UDP_HEADERS, M.GOSSIP_HEADERS_REPLY, max_items=128)
 
+    # ------------------------------------------------------------------
+    # fast sync (the fast/state-sync mode of the reference downloader,
+    # ref: eth/downloader/statesync.go:1, downloader.go:1353 — account-
+    # granular pages instead of trie nodes; design in core/statesync.py)
+    # ------------------------------------------------------------------
+
+    def _fastsync_start(self, target: int) -> None:
+        self._fs = {"target": target, "pivot": 0, "root": b"",
+                    "accounts": [], "codes": [], "total": None,
+                    "headers": {}, "block": None, "progress": False}
+        self._log("FASTSYNC start", gap=target - self.chain.height())
+        self._fastsync_tick(retry=0)
+
+    def _fastsync_abort(self, why: str) -> None:
+        """Fall back to full replay — once per session; a byzantine or
+        pruned serving peer can delay a fast sync, never wedge it."""
+        fs, self._fs = self._fs, None
+        self._fs_done = True
+        self._cancel_timer("fastsync")
+        self._log("FASTSYNC abandoned", why=why)
+        if fs is not None:
+            self._request_backfill(fs["target"])
+
+    def _fastsync_tick(self, retry: int) -> None:
+        fs = self._fs
+        if fs is None:
+            return
+        if fs["progress"]:
+            retry = 0
+            fs["progress"] = False
+        elif retry >= self.SYNC_MAX_STALL:
+            self._fastsync_abort("no serving peer")
+            return
+        req = M.StateFetchReq(block_num=fs["pivot"],
+                              cursor=len(fs["accounts"]),
+                              ip=self.cfg.consensus_ip,
+                              port=self.cfg.consensus_port)
+        peer = self._pick_sync_peer(retry)
+        if peer is not None and retry % 3 != 2:
+            self.transport.send_direct(
+                peer.ip, peer.port,
+                M.pack_direct(M.UDP_GET_STATE, self.coinbase, req))
+        else:
+            self.transport.gossip(M.pack_gossip(M.GOSSIP_GET_STATE, req))
+        if fs["pivot"]:
+            # the pivot header (for the certified root) and the pivot
+            # block (the new head) ride the existing sync lanes
+            breq = M.BlockFetchReq(start=fs["pivot"], count=1,
+                                   ip=self.cfg.consensus_ip,
+                                   port=self.cfg.consensus_port)
+            if fs["pivot"] not in fs["headers"]:
+                peer2 = self._pick_sync_peer(retry + 1)
+                if peer2 is not None:
+                    self.transport.send_direct(
+                        peer2.ip, peer2.port,
+                        M.pack_direct(M.UDP_GET_HEADERS, self.coinbase,
+                                      breq))
+                else:
+                    self.transport.gossip(
+                        M.pack_gossip(M.GOSSIP_GET_HEADERS, breq))
+            if fs["block"] is None:
+                peer3 = self._pick_sync_peer(retry + 2)
+                if peer3 is not None:
+                    self.transport.send_direct(
+                        peer3.ip, peer3.port,
+                        M.pack_direct(M.UDP_GET_BLOCKS, self.coinbase,
+                                      breq))
+                else:
+                    self.transport.gossip(
+                        M.pack_gossip(M.GOSSIP_GET_BLOCKS, breq))
+        self._set_timer("fastsync", self.ccfg.validate_timeout_ms / 1e3,
+                        lambda: self._fastsync_tick(retry + 1))
+
+    def _handle_state_chunk(self, reply: M.StateChunkReply) -> None:
+        fs = self._fs
+        if fs is None:
+            return
+        if fs["pivot"] == 0:
+            if reply.cursor != 0 or reply.block_num <= self.chain.height():
+                return
+            fs["pivot"], fs["root"] = reply.block_num, reply.root
+        elif reply.block_num != fs["pivot"] or reply.root != fs["root"]:
+            if reply.cursor == 0 and reply.block_num > fs["pivot"]:
+                # server pruned our pivot and re-anchored: restart there
+                fs.update(pivot=reply.block_num, root=reply.root,
+                          accounts=[], codes=[], total=None, block=None)
+            else:
+                return
+        if reply.cursor != len(fs["accounts"]):
+            return  # duplicate or out-of-order page; the tick re-asks
+        fs["accounts"].extend(reply.accounts)
+        fs["codes"].extend(reply.codes)
+        fs["total"] = reply.total
+        fs["progress"] = True
+        self._fastsync_maybe_finish()
+        if self._fs is not None:
+            self._fastsync_tick(retry=0)  # next page immediately
+
+    def _fastsync_take_blocks(self, blocks) -> None:
+        """During a state download the block lanes only feed the pivot
+        block; everything else re-fetches after adoption."""
+        fs = self._fs
+        want = [b for b in blocks if b.number == fs["pivot"]]
+        if not want or fs["block"] is not None:
+            return
+        ok = self._filter_certified(want)
+        if ok:
+            fs["block"] = ok[0]
+            fs["progress"] = True
+            self._fastsync_maybe_finish()
+
+    def _fastsync_maybe_finish(self) -> None:
+        from eges_tpu.core import statesync as _ss
+
+        fs = self._fs
+        if (fs is None or fs["total"] is None
+                or len(fs["accounts"]) < fs["total"]):
+            return
+        hdr = fs["headers"].get(fs["pivot"])
+        blk = fs["block"]
+        if hdr is None or blk is None:
+            return  # the tick keeps requesting them
+        if blk.hash != hdr.hash:
+            fs["block"] = None  # block from a liar peer; re-fetch
+            return
+        state = _ss.assemble(fs["accounts"], fs["codes"])
+        if state.root() != hdr.root:
+            # pages were poisoned: certificates bound the header, the
+            # rebuilt tries disagree — nothing was adopted
+            self._fastsync_abort("state root mismatch vs certified header")
+            return
+        target = fs["target"]
+        pivot = fs["pivot"]
+        self.chain.adopt_snapshot(blk, state)
+        self._fs = None
+        self._fs_done = True
+        self._cancel_timer("fastsync")
+        self._log("FASTSYNC adopted", pivot=pivot,
+                  root=hdr.root.hex()[:12], accounts=len(state),
+                  target=target)
+        self._request_backfill(max(target, pivot), start=pivot + 1)
+
+    def _serve_state_fetch(self, req: M.StateFetchReq) -> None:
+        """Serve one address-sorted page of a pivot state snapshot.
+
+        The pivot is head−PIVOT_LAG on first contact (block_num=0); on
+        later pages the exact requested block, falling back to a fresh
+        cursor-0 pivot when ours got pruned (the joiner restarts).  The
+        flattened account list is cached per pivot hash — paging is a
+        slice, not a re-walk."""
+        from eges_tpu.core import rlp as rlp_mod
+        from eges_tpu.core import statesync as _ss
+
+        height = self.chain.height()
+        n, cursor = req.block_num, req.cursor
+        blk = state = None
+        if n:
+            blk = self.chain.get_block_by_number(n)
+            state = self.chain.state_at(blk.hash) if blk else None
+        if state is None:
+            n, cursor = max(1, height - self.PIVOT_LAG), 0
+            while n <= height:
+                blk = self.chain.get_block_by_number(n)
+                state = self.chain.state_at(blk.hash) if blk else None
+                if state is not None:
+                    break
+                n += 1
+        if state is None or blk is None:
+            return
+        cache = self._snap_cache
+        if cache is None or cache[0] != blk.hash:
+            accounts = _ss.snapshot_accounts(state)
+            self._snap_cache = (blk.hash, accounts)
+        else:
+            accounts = cache[1]
+        if cursor > len(accounts):
+            return
+        page, size = [], 0
+        for item in accounts[cursor:]:
+            enc = len(rlp_mod.encode(
+                [item[0], item[1], item[2], item[3],
+                 [[k, v] for k, v in item[4]]]))
+            if page and (size + enc > self.STATE_PAGE_BYTES
+                         or len(page) >= self.STATE_PAGE_MAX):
+                break
+            page.append(item)
+            size += enc
+        reply = M.StateChunkReply(
+            block_num=n, root=blk.header.root, cursor=cursor,
+            total=len(accounts), accounts=tuple(page),
+            codes=_ss.codes_for(state, page))
+        packed = M.pack_direct(M.UDP_STATE, self.coinbase, reply)
+        if len(packed) <= self.UDP_BUDGET + 1024:
+            self.transport.send_direct(req.ip, req.port, packed)
+        else:
+            self.transport.gossip(M.pack_gossip(M.GOSSIP_STATE_REPLY,
+                                                reply))
+
     def _handle_headers_reply(self, reply: M.HeadersReply) -> None:
         """Pin the verified skeleton: batch-verify every certificate in
         the reply (one device batch for the lot) and remember the header
@@ -1219,6 +1450,13 @@ class GeecNode:
             if (ok and c is not None and c.confidence > 0
                     and self._cert_binds_hash(c)):
                 self._sync_skel[h.number] = h.hash
+                if self._fs is not None:
+                    # fast sync needs the certified HEADER (its root is
+                    # what the downloaded state verifies against)
+                    self._fs["headers"][h.number] = h
+                    if h.number == self._fs["pivot"]:
+                        self._fs["progress"] = True
+                        self._fastsync_maybe_finish()
 
     def _filter_certified(self, blocks) -> list:
         """Drop backfilled blocks whose quorum confirm doesn't verify or
@@ -1246,6 +1484,11 @@ class GeecNode:
         reorg, then extend normally.  If the fork is deeper than the
         reply's overlap, re-request further back (doubling window)."""
         blocks = sorted(reply.blocks, key=lambda b: b.number)
+        if self._fs is not None:
+            # a state download is in flight: block lanes only feed the
+            # pivot; the tail re-fetches after adoption
+            self._fastsync_take_blocks(blocks)
+            return
         if self._signing:
             # header-first fast path: a body hashing onto a pinned
             # (pre-verified) skeleton entry needs no certificate work.

@@ -1,0 +1,102 @@
+"""Fast-sync state snapshots: serialize, page, rebuild, verify.
+
+Fills the fast/state-sync role of the reference downloader
+(eth/downloader/statesync.go:1, the pivot handling in
+eth/downloader/downloader.go:1353): a late joiner downloads the state
+AT a pivot block and root-verifies it instead of replaying the whole
+chain — O(state), not O(chain).
+
+Redesign vs the reference: geth syncs at TRIE-NODE granularity (each
+response is a bag of hash-addressed nodes healed into a node database).
+This build's state lives in in-memory persistent tries with no
+node-hash database, so pages are ACCOUNT-granular: address-sorted
+``(addr, nonce, balance, code_hash, ((hashed_slot, value_rlp)…))``
+entries plus the referenced code blobs.  Verification is strictly
+end-to-end — the joiner rebuilds the account and storage tries and
+compares the final root against a quorum-certified pivot header, so a
+byzantine serving peer can delay a fast sync but never poison one.
+
+The same serialization doubles as the FileStore's durable snapshot
+sidecar, which is what lets a fast-synced node RESTART without the
+ancestors it never downloaded (SURVEY §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+from eges_tpu.core import rlp
+from eges_tpu.core.state import (
+    Account, ContractStorage, EMPTY_CODE_HASH, StateDB,
+)
+from eges_tpu.core.trie import SecureIncrementalTrie
+
+
+class StateSyncError(Exception):
+    pass
+
+
+def snapshot_accounts(state: StateDB) -> list[tuple]:
+    """Address-sorted serializable view of a state snapshot:
+    ``(addr, nonce, balance, code_hash, ((hashed_slot, value_rlp)…))``."""
+    out = []
+    for addr, a in sorted(state.iter_accounts()):
+        slots = tuple(sorted(a.storage.items()))
+        out.append((addr, a.nonce, a.balance, a.code_hash, slots))
+    return out
+
+
+def codes_for(state: StateDB, accounts) -> tuple[bytes, ...]:
+    """Deduped bytecode blobs referenced by ``accounts`` (one page)."""
+    seen: dict[bytes, bytes] = {}
+    for addr, _n, _b, ch, _s in accounts:
+        if ch != EMPTY_CODE_HASH and ch not in seen:
+            code = state.code(addr)
+            if code:
+                seen[ch] = code
+    return tuple(seen.values())
+
+
+def assemble(accounts, codes) -> StateDB:
+    """Rebuild a StateDB from downloaded pages.
+
+    Storage tries are rebuilt from their hashed-key pairs; code blobs
+    re-hash through ``set_code``.  NOTHING here is trusted — a wrong
+    slot, balance, or code blob lands in the rebuilt tries and shifts
+    ``root()``, which the caller must compare against a certified
+    header before adopting."""
+    from eges_tpu.crypto.keccak import keccak256
+
+    code_by_hash = {keccak256(c): c for c in codes}
+    accts: dict[bytes, Account] = {}
+    for addr, nonce, balance, ch, slots in accounts:
+        storage = (ContractStorage(
+            SecureIncrementalTrie.from_hashed_pairs(slots))
+            if slots else Account().storage)
+        accts[bytes(addr)] = Account(nonce=nonce, balance=balance,
+                                     code_hash=bytes(ch), storage=storage)
+    st = StateDB(accts)
+    for addr, _n, _b, ch, _s in accounts:
+        if ch != EMPTY_CODE_HASH:
+            # a missing/corrupt blob makes code_hash diverge -> the
+            # final root check rejects the whole snapshot
+            st.set_code(bytes(addr), code_by_hash.get(bytes(ch), b""))
+    return st
+
+
+# -- durable snapshot sidecar (FileStore restart path) ----------------------
+
+def encode_snapshot(block_hash: bytes, state: StateDB) -> bytes:
+    accounts = snapshot_accounts(state)
+    codes = codes_for(state, accounts)
+    return rlp.encode([
+        block_hash,
+        [[a, n, b, ch, [[k, v] for k, v in slots]]
+         for a, n, b, ch, slots in accounts],
+        list(codes)])
+
+
+def decode_snapshot(data: bytes) -> tuple[bytes, StateDB]:
+    block_hash, accounts, codes = rlp.decode(data)
+    items = [(bytes(a), rlp.decode_uint(n), rlp.decode_uint(b), bytes(ch),
+              tuple((bytes(k), bytes(v)) for k, v in slots))
+             for a, n, b, ch, slots in accounts]
+    return bytes(block_hash), assemble(items, [bytes(c) for c in codes])

@@ -417,6 +417,28 @@ class IncrementalTrie:
     def get(self, key: bytes):
         return _get(self._root, tuple(_nibbles(key)))
 
+    def items(self):
+        """Yield ``(key, value)`` over every leaf, keys re-packed from
+        nibble paths.  This is the state-sync SERVING walk (ref role:
+        trie.Iterator in eth/downloader/statesync.go's source side); on
+        a secure trie the keys that come back are the hashed ones."""
+        def walk(node, path):
+            if node is None:
+                return
+            if isinstance(node, _Leaf):
+                yield path + node.path, node.value
+            elif isinstance(node, _Ext):
+                yield from walk(node.child, path + node.path)
+            else:  # _Branch
+                if node.value:
+                    yield path, node.value
+                for i, ch in enumerate(node.children):
+                    if ch is not None:
+                        yield from walk(ch, path + (i,))
+        for nibs, val in walk(self._root, ()):
+            yield (bytes((nibs[i] << 4) | nibs[i + 1]
+                         for i in range(0, len(nibs), 2)), val)
+
     def root(self) -> bytes:
         if self._root is None:
             return EMPTY_ROOT
@@ -439,6 +461,18 @@ class SecureIncrementalTrie:
 
     def get(self, key: bytes):
         return self._t.get(keccak256(key))
+
+    def items(self):
+        """(hashed_key, value) pairs — see IncrementalTrie.items."""
+        return self._t.items()
+
+    @classmethod
+    def from_hashed_pairs(cls, pairs) -> "SecureIncrementalTrie":
+        """Rebuild from ``(hashed_key, value)`` pairs as served by
+        ``items()`` — the state-sync RECEIVING side.  The caller proves
+        integrity by comparing ``root()`` against a certified
+        commitment; nothing here trusts the pairs."""
+        return cls(IncrementalTrie.from_pairs(dict(pairs)))
 
     def root(self) -> bytes:
         return self._t.root()

@@ -46,6 +46,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--breakdown", action="store_true")
     p.add_argument("--failureTest", action="store_true")
     p.add_argument("--totalNodes", type=int, default=3)
+    p.add_argument("--syncmode", default="full", choices=["full", "fast"],
+                   help="fast: a late joiner downloads the state at a "
+                        "quorum-certified pivot block and replays only "
+                        "the tail — O(state) not O(chain) (ref: "
+                        "eth/downloader/statesync.go role)")
     # transport
     p.add_argument("--gossipIP", default="127.0.0.1")
     p.add_argument("--gossipPort", type=int, default=6190)
@@ -98,7 +103,7 @@ def main(argv=None) -> None:
         n_acceptors=args.nAcceptors, block_timeout_s=args.blockTimeout,
         txn_per_block=args.txnPerBlock, txn_size=args.txnSize,
         breakdown=args.breakdown, failure_test=args.failureTest,
-        total_nodes=args.totalNodes)
+        total_nodes=args.totalNodes, fast_sync=args.syncmode == "fast")
     cfg = ServiceConfig(
         datadir=args.datadir, genesis_path=args.genesis, key_hex=args.keyhex,
         gossip_ip=args.gossipIP, gossip_port=args.gossipPort,

@@ -35,7 +35,8 @@ class SimCluster:
                  backoff_time_ms: float = 0.0, reg_timeout_s: float = 10.0,
                  drop_rate: float = 0.0, failure_test: bool = False,
                  verifier=None, mine=None, signed: bool = True,
-                 alloc: dict | None = None, txpool: bool = False):
+                 alloc: dict | None = None, txpool: bool = False,
+                 fast_sync: set | None = None, defer: set | None = None):
         self.clock = SimClock()
         self.net = SimNet(self.clock, seed=seed, drop_rate=drop_rate)
         self.nodes: list[SimNode] = []
@@ -57,6 +58,7 @@ class SimCluster:
                                signed_votes=signed)
         genesis = make_genesis(alloc=alloc)
 
+        self._deferred: set[int] = set(defer or ())
         for i in range(n_nodes):
             name = f"node{i}"
             ncfg = NodeConfig(
@@ -65,7 +67,8 @@ class SimCluster:
                 n_acceptors=n_acceptors, txn_per_block=txn_per_block,
                 txn_size=txn_size, block_timeout_s=block_timeout_s,
                 total_nodes=n_nodes, failure_test=failure_test,
-                privkey=privs[i] if signed else b"")
+                privkey=privs[i] if signed else b"",
+                fast_sync=bool(fast_sync and i in fast_sync))
             chain = BlockChain(genesis=genesis, verifier=verifier,
                                alloc=alloc)
             node = GeecNode(chain, self.clock, None, ncfg, ccfg,
@@ -74,16 +77,32 @@ class SimCluster:
             if txpool:
                 from eges_tpu.core.txpool import TxPool
                 node.txpool = TxPool(self.clock, verifier=verifier)
-            transport = self.net.join(name, ncfg.consensus_ip,
-                                      ncfg.consensus_port,
-                                      node.on_gossip, node.on_direct)
-            node.transport = transport
+            if i not in self._deferred:
+                # deferred nodes (late joiners) stay OFF the network —
+                # no transport join, no gossip — until start_deferred()
+                transport = self.net.join(name, ncfg.consensus_ip,
+                                          ncfg.consensus_port,
+                                          node.on_gossip, node.on_direct)
+                node.transport = transport
             self.nodes.append(SimNode(name=name, priv=privs[i],
                                       addr=addrs[i], chain=chain, node=node))
 
     def start(self) -> None:
-        for sn in self.nodes:
-            sn.node.start()
+        for i, sn in enumerate(self.nodes):
+            if i not in self._deferred:
+                sn.node.start()
+
+    def start_deferred(self, i: int) -> None:
+        """Bring a deferred node online mid-run: the late-joiner leg of
+        the sync scenarios (fast sync's raison d'être)."""
+        assert i in self._deferred, f"node{i} was not deferred"
+        self._deferred.discard(i)
+        sn = self.nodes[i]
+        ncfg = sn.node.cfg
+        sn.node.transport = self.net.join(
+            sn.name, ncfg.consensus_ip, ncfg.consensus_port,
+            sn.node.on_gossip, sn.node.on_direct)
+        sn.node.start()
 
     def run(self, seconds: float, stop_condition=None) -> None:
         self.clock.run_until(self.clock.now() + seconds, stop_condition)
