@@ -84,8 +84,24 @@ def _child(deadline: float, max_batch: int) -> None:
     # biggest batch — throughput grows with rows (54.0k/s at 16384 vs
     # 3.3k/s at 256, r4) because per-dispatch overhead amortizes — then
     # backfills the 1024-row p50/p99 operating point if budget remains.
+    #
+    # Under a TIGHT budget (the driver's 420 s, r5) there is no room for
+    # a throwaway 256-row gate compile: go straight to the headline
+    # batch and run the correctness gate on ITS output — the gate
+    # asserts on whichever batch completes first either way.  Two big
+    # compiles (16384 + 1024) fit where three would not, so the driver
+    # line carries both the throughput point and the p50 deliverable.
+    # ...but ONLY for a real accelerator: the CPU fallback's number is
+    # batch-independent and each of its 1024-row calls takes ~17 s, so
+    # it keeps the cheap 256-row gate first and stops there (r5 fix: a
+    # 1024-first CPU child produced nothing inside a 130 s fallback).
+    tight = left() <= 360 and "CPU" not in device.upper()
+    order = (16384, 1024) if tight else (256, 16384, 1024, 4096)
+    # clamp to the caller's cap instead of skipping past it — a tight
+    # run with max_batch < 1024 must still measure SOMETHING
+    order = tuple(dict.fromkeys(min(b, max_batch) for b in order))
     first = True
-    for batch in (256, 16384, 1024, 4096):
+    for batch in order:
         if batch > max_batch:
             continue
         # After the first graph is proven, require slack for a fresh
@@ -187,6 +203,64 @@ def _child(deadline: float, max_batch: int) -> None:
 # parent: baseline + race the backends, print progressive JSON lines
 # ---------------------------------------------------------------------------
 
+_PROBE_SRC = (
+    "import jax, json\n"
+    "d = jax.devices()[0]\n"
+    "print('PROBE ' + json.dumps({'platform': d.platform,"
+    " 'device': str(d)}), flush=True)\n"
+)
+
+
+def _probe_tpu(timeout_s: float) -> dict | None:
+    """Ask a killable child what platform JAX sees.
+
+    The axon tunnel's failure mode is a HANG, not an error —
+    ``jax.devices()`` blocks for many minutes when the tunnel is down
+    (r3 postmortem), so the probe runs in its own process group and is
+    SIGKILLed on timeout.  Returns the device info dict when a real
+    accelerator answered, None for down/CPU-only."""
+    import signal
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _PROBE_SRC], env=env, cwd=_REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        out, _ = proc.communicate()
+    for line in out.decode(errors="replace").splitlines():
+        if line.startswith("PROBE "):
+            try:
+                info = json.loads(line[len("PROBE "):])
+            except ValueError:
+                continue
+            if info.get("platform") not in ("cpu", "interpreter"):
+                return info
+    return None
+
+
+def _watcher_capture() -> dict | None:
+    """Condensed view of the watcher's best on-hardware capture
+    (BENCH_tpu_capture.json), attached to CPU-fallback lines as
+    PROVENANCE-LABELLED context — never merged into value/vs_baseline."""
+    try:
+        with open(os.path.join(_REPO, "BENCH_tpu_capture.json")) as f:
+            cap = json.load(f)
+    except Exception:
+        return None
+    keep = ("value", "unit", "vs_baseline", "batch", "device",
+            "captured_at", "p50_latency_ms_at_1024",
+            "p99_latency_ms_at_1024", "variant")
+    return {k: cap[k] for k in keep if k in cap}
+
+
 def _cpu_baseline() -> float | None:
     """Single-threaded native C++ recover rate (the per-call hot path the
     reference serializes through); None when the lib isn't built."""
@@ -236,6 +310,7 @@ def main() -> None:
 
     best: dict = {}      # kind -> best stage result for that backend
     printed = [0]
+    probe_state: dict = {}   # filled by the probe loop below
 
     def compose() -> dict | None:
         res = best.get("tpu") or best.get("cpu")
@@ -254,6 +329,16 @@ def main() -> None:
             "cpu_baseline_ref_class_per_s": REF_CLASS_CPU_PER_S,
             "elapsed_s": round(time.monotonic() - t_start, 1),
         }
+        if probe_state:
+            out["tpu_probe"] = dict(probe_state)
+        if "tpu" not in best:
+            # CPU-fallback line: attach the watcher's best hardware
+            # capture as labelled provenance so the line explains what
+            # the chip DID measure when the tunnel was last alive —
+            # value/vs_baseline above remain the honest CPU numbers.
+            cap = _watcher_capture()
+            if cap:
+                out["watcher_tpu_capture"] = cap
         for k, name in (("p50_ms", "p50_latency_ms_at_1024"),
                         ("p99_ms", "p99_latency_ms_at_1024")):
             if k in res:
@@ -327,11 +412,45 @@ def main() -> None:
                 if not drain(kind, fd):
                     break
 
-    # reserve enough of the budget for a CPU fallback compile+measure
+    # The tunnel is a resource that appears for minutes, not hours (r4
+    # verdict): never hand the TPU child the budget while the tunnel is
+    # DOWN — a hung jax.devices() would eat it all and the round would
+    # record an unexplained CPU number (the r1–r4 failure mode).  Probe
+    # in a killable child first; while the tunnel is down keep probing
+    # for as long as the budget allows (leaving room for the CPU
+    # fallback), and put the probe history in every output line
+    # (tpu_probe.waited_s / .tunnel) so a CPU line is self-explaining.
     tpu_only = "--tpu-only" in sys.argv
-    reserve = 0.0 if tpu_only else min(240.0, budget * 0.55)
-    run_child("tpu", deadline - reserve, max_batch)
-    if "tpu" not in best and time.monotonic() < deadline - 20:
+    cpu_fallback_s = 0.0 if tpu_only else 110.0
+    probe_timeout = 75.0  # a down tunnel HANGS the probe for all of it
+    t_wait0 = time.monotonic()
+    info, probes = None, 0
+    while True:
+        info = _probe_tpu(probe_timeout)
+        probes += 1
+        if info is not None:
+            break
+        if (deadline - time.monotonic() - cpu_fallback_s
+                < probe_timeout + 15):
+            break
+        time.sleep(15.0)
+    probe_state.update({
+        "tunnel": "up" if info else "down",
+        "waited_s": round(time.monotonic() - t_wait0, 1),
+        "probes": probes,
+    })
+    if info is not None:
+        probe_state["device_seen"] = info.get("device")
+        # tunnel is up: the whole remaining budget belongs to the TPU
+        # child — progressive emission means a flap mid-stage still
+        # leaves every finished stage on stdout, and a probe-confirmed
+        # backend producing nothing at all is rarer than the fallback
+        # is valuable.
+        run_child("tpu", deadline, max_batch)
+    if ("tpu" not in best and not tpu_only
+            and time.monotonic() < deadline - 20):
+        # --tpu-only callers (the watcher) filter for accelerator lines
+        # anyway — never hand them a CPU measurement to mis-bank
         run_child("cpu", deadline, min(max_batch, 1024))
 
     if printed[0] == 0:
@@ -341,6 +460,8 @@ def main() -> None:
             "metric": "secp256k1_ecrecover_verifies_per_sec_per_chip",
             "value": 0.0, "unit": "verifies/s", "vs_baseline": 0.0,
             "error": "no backend produced a result within budget",
+            "tpu_probe": dict(probe_state),
+            "watcher_tpu_capture": _watcher_capture(),
             "cpu_baseline_measured_per_s":
                 round(measured, 1) if measured else None,
         }), flush=True)

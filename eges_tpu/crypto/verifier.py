@@ -211,6 +211,12 @@ def default_verifier() -> BatchVerifier:
     """Process-wide verifier on the default device set: a 1-axis mesh over
     all local devices if there are several, else single-device."""
     devs = jax.devices()
+    # surface WHICH device serves the batches through thw_metrics so a
+    # cluster run's >95%-on-device claim names its hardware (BASELINE
+    # config 4 needs "TPU v5 lite0" in the evidence, not an inference)
+    from eges_tpu.utils.metrics import DEFAULT as metrics
+
+    metrics.gauge("verifier.device_name").set(str(devs[0]))
     if len(devs) > 1:
         mesh = jax.sharding.Mesh(np.array(devs), ("dp",))
         return BatchVerifier(mesh=mesh)

@@ -74,21 +74,33 @@ def _pin_gloo_loopback() -> None:
     the hostname resolves to 127.0.0.1.  The dry run is strictly
     localhost, so pin both ends to loopback.  Harmless on real
     multi-host TPO deployments: those use the native ICI/DCN stack, not
-    the CPU gloo transport."""
-    from jax._src import distributed, xla_bridge
-    from jaxlib import xla_client
+    the CPU gloo transport.
 
-    def make(*_a, **_kw):
-        collectives = xla_client._xla.make_gloo_tcp_collectives(
-            distributed_client=distributed.global_state.client,
-            hostname="127.0.0.1")
-        return xla_bridge.make_cpu_client(collectives=collectives)
+    Uses jax PRIVATE internals (jax._src.{distributed,xla_bridge},
+    xla_client._xla.make_gloo_tcp_collectives) — written against the
+    baked-in jax 0.5.x; a jax upgrade may rename any of them.  That
+    must degrade to the default gloo factory with a readable log line,
+    not an opaque dryrun crash (r4 advisor finding)."""
+    try:
+        from jax._src import distributed, xla_bridge
+        from jaxlib import xla_client
 
-    # same flags as jax's own cpu registration; the factory table is
-    # keyed by name, so this simply replaces the default factory (it
-    # must run before the first backend use or jax raises)
-    xla_bridge.register_backend_factory("cpu", make, priority=0,
-                                        fail_quietly=False)
+        def make(*_a, **_kw):
+            collectives = xla_client._xla.make_gloo_tcp_collectives(
+                distributed_client=distributed.global_state.client,
+                hostname="127.0.0.1")
+            return xla_bridge.make_cpu_client(collectives=collectives)
+
+        # same flags as jax's own cpu registration; the factory table is
+        # keyed by name, so this simply replaces the default factory (it
+        # must run before the first backend use or jax raises)
+        xla_bridge.register_backend_factory("cpu", make, priority=0,
+                                            fail_quietly=False)
+    except Exception as exc:  # AttributeError/ImportError on jax bump
+        print(f"multihost: gloo loopback pin unavailable on this jax "
+              f"version ({exc!r}); using the default gloo factory — "
+              f"cross-process connects may pick a non-loopback NIC",
+              file=sys.stderr, flush=True)
 
 
 def global_mesh(axis: str = "dp"):

@@ -1,0 +1,202 @@
+"""Decompose the ~65 ms fixed p50 floor of the tunnel backend.
+
+Round-4 anchor (axon tunnel, fused v2 pipeline): per-call wall time at
+batch B fits ~65 ms + ~14.5 us/row, and the 65 ms intercept is NOT
+explained by entry-instruction count (641 vs 164 instructions: same
+time).  BASELINE.md's north star is p50 < 50 ms @1024, which is
+unreachable while the floor stands — so before optimizing anything,
+find out WHERE the floor lives:
+
+  rtt       upload (8,128)f32 + download, no executable at all
+            -> pure tunnel transfer round-trip
+  nop       jit(x+1) on (8,128), pre-uploaded distinct inputs
+            -> minimum cost of ONE executable dispatch
+  chain{K}  jit of K dependent (tanh(x @ w)) steps, K = 16/64/256
+            -> slope = per-entry-instruction cost; intercept = floor
+  pallasnop one pallas_call copy kernel
+            -> does a Mosaic kernel dispatch cost more than an XLA op?
+  out3      x+1 returning THREE arrays
+            -> per-output-buffer handling cost
+  chain64d  chain64 with donate_argnums=(0,)
+            -> does aliasing/donation change the dispatch path?
+
+Measurement protocol (see the r4 postmortem in VERIFICATION.md): every
+config runs in its OWN child process — `block_until_ready` has been
+observed returning early in multi-executable processes on this backend
+(profile_stages artifact), and repeat-content dispatches are memoized
+server-side, so each timed call uses a never-repeated input uploaded
+before the timed region.  The parent only aggregates.
+
+Reference hot path this ultimately serves:
+crypto/secp256k1/secp256.go:105 (per-call cgo recover) — our batched
+replacement's p50 is gated by this floor, not by arithmetic.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+CONFIGS = ("rtt", "nop", "pallasnop", "out3",
+           "chain16", "chain64", "chain256", "chain64d")
+CALLS = 14          # timed calls per config (each on fresh content)
+SHAPE = (8, 128)    # one native VPU tile: transfer cost is negligible
+
+
+def _median_ms(xs: list[float]) -> float:
+    return round(statistics.median(xs) * 1e3, 2)
+
+
+def _child(name: str) -> None:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print("device:", dev, flush=True)
+    rng = np.random.default_rng(int.from_bytes(os.urandom(4), "big"))
+
+    def fresh() -> np.ndarray:
+        return rng.standard_normal(SHAPE, dtype=np.float32)
+
+    if name == "rtt":
+        ups, downs = [], []
+        for _ in range(CALLS):
+            h = fresh()
+            t0 = time.perf_counter()
+            d = jax.device_put(h)
+            jax.block_until_ready(d)
+            ups.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            np.asarray(d)
+            downs.append(time.perf_counter() - t0)
+        print("FLOOR " + json.dumps({
+            "config": name, "upload_ms": _median_ms(ups),
+            "download_ms": _median_ms(downs)}), flush=True)
+        return
+
+    k = 0
+    donate = name.endswith("d")
+    base = name[:-1] if donate else name
+    if base.startswith("chain"):
+        k = int(base[len("chain"):])
+        w = jnp.asarray(rng.standard_normal((SHAPE[1], SHAPE[1]),
+                                            dtype=np.float32))
+
+        def f(x):
+            # k dependent dot+tanh steps, unrolled: ~k entry
+            # computations that XLA cannot collapse (data dependence)
+            for _ in range(k):
+                x = jnp.tanh(x @ w)
+            return x
+    elif name == "nop":
+        def f(x):
+            return x + 1.0
+    elif name == "out3":
+        def f(x):
+            return x + 1.0, x + 2.0, x * 2.0
+    elif name == "pallasnop":
+        from jax.experimental import pallas as pl
+
+        def _kern(x_ref, o_ref):
+            o_ref[...] = x_ref[...] + 1.0
+
+        # real Mosaic on tpu/axon; interpret elsewhere so the CPU smoke
+        # run of this harness exercises the same code path
+        interp = jax.default_backend() not in ("tpu", "axon")
+
+        def f(x):
+            return pl.pallas_call(
+                _kern, interpret=interp,
+                out_shape=jax.ShapeDtypeStruct(SHAPE, jnp.float32))(x)
+    else:
+        raise SystemExit(f"unknown config {name}")
+
+    fn = jax.jit(f, donate_argnums=(0,) if donate else ())
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(jnp.asarray(fresh())))
+    compile_s = time.perf_counter() - t0
+
+    # pre-upload one distinct input per timed call (donated buffers are
+    # consumed, so fresh uploads are required there regardless)
+    inputs = [jax.device_put(fresh()) for _ in range(CALLS)]
+    jax.block_until_ready(inputs)
+    lats = []
+    for x in inputs:
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(x))
+        lats.append(time.perf_counter() - t0)
+    print("FLOOR " + json.dumps({
+        "config": name, "k": k, "compile_s": round(compile_s, 1),
+        "per_call_ms": _median_ms(lats),
+        "min_ms": round(min(lats) * 1e3, 2),
+        "max_ms": round(max(lats) * 1e3, 2)}), flush=True)
+
+
+def main() -> None:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    results: dict[str, dict] = {}
+    for name in CONFIGS:
+        t0 = time.perf_counter()
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--child", name],
+                env=env, capture_output=True, text=True, timeout=300)
+        except subprocess.TimeoutExpired:
+            # a hung config is the tunnel's signature failure mode —
+            # lose the config, keep the sweep (and the exit-0 that the
+            # watcher's inconclusive/conclusive split relies on)
+            print(f"FLOOR-FAIL {name} timeout after "
+                  f"{time.perf_counter() - t0:.0f}s", flush=True)
+            continue
+        for line in proc.stdout.splitlines():
+            if line.startswith("device:"):
+                print(line, flush=True)  # watcher's done-marker anchor
+            if line.startswith("FLOOR "):
+                results[name] = json.loads(line[len("FLOOR "):])
+                print(line, flush=True)
+        if name not in results:
+            print(f"FLOOR-FAIL {name} rc={proc.returncode} "
+                  f"({time.perf_counter() - t0:.0f}s): "
+                  f"{(proc.stdout + proc.stderr)[-300:]!r}", flush=True)
+
+    # attribution: slope over the chain sweep vs the nop intercept
+    ks = sorted(r["k"] for n, r in results.items()
+                if n.startswith("chain") and not n.endswith("d"))
+    if len(ks) >= 2 and "nop" in results:
+        import numpy as np
+
+        xs = np.array(ks, dtype=float)
+        ys = np.array([results[f"chain{k}"]["per_call_ms"] for k in ks])
+        slope, intercept = np.polyfit(xs, ys, 1)
+        print("VERDICT " + json.dumps({
+            "dispatch_floor_ms": results["nop"]["per_call_ms"],
+            "per_instruction_us": round(slope * 1e3, 2),
+            "chain_intercept_ms": round(float(intercept), 2),
+            "pallas_vs_xla_ms": round(
+                results.get("pallasnop", {}).get("per_call_ms", -1)
+                - results["nop"]["per_call_ms"], 2),
+            "three_outputs_extra_ms": round(
+                results.get("out3", {}).get("per_call_ms", -1)
+                - results["nop"]["per_call_ms"], 2),
+            "donation_delta_ms": round(
+                results.get("chain64d", {}).get("per_call_ms", -1)
+                - results.get("chain64", {}).get("per_call_ms", 0), 2),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--child":
+        _child(sys.argv[2])
+    else:
+        main()

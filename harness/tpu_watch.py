@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -47,13 +48,6 @@ PROBE_PERIOD_S = float(os.environ.get("TPU_WATCH_PERIOD", "150"))
 SETTLED_PERIOD_S = 1800.0          # after a full capture: re-confirm slowly
 WARM_TIMEOUT_S = 420.0             # per-bucket compile child
 BENCH_BUDGET_S = float(os.environ.get("TPU_WATCH_BENCH_BUDGET", "1200"))
-
-_PROBE_SRC = (
-    "import jax, json\n"
-    "d = jax.devices()[0]\n"
-    "print('PROBE ' + json.dumps({'platform': d.platform,"
-    " 'device': str(d)}), flush=True)\n"
-)
 
 _WARM_SRC = """
 import os, sys, time, json
@@ -104,14 +98,13 @@ def _run_child(argv: list[str], timeout: float,
 
 
 def probe() -> dict | None:
-    rc, out = _run_child([sys.executable, "-c", _PROBE_SRC],
-                         PROBE_TIMEOUT_S)
-    for line in out.splitlines():
-        if line.startswith("PROBE "):
-            info = json.loads(line[len("PROBE "):])
-            if info["platform"] not in ("cpu", "interpreter"):
-                return info
-    return None
+    # single source of truth for the killable-probe pattern: bench.py
+    # carries it (the driver runs bench standalone; the watcher always
+    # has the repo on its path) — a fix there must not miss a copy here
+    sys.path.insert(0, _REPO)
+    from bench import _probe_tpu
+
+    return _probe_tpu(PROBE_TIMEOUT_S)
 
 
 def warm(batch: int, variant: str = "") -> bool:
@@ -369,41 +362,85 @@ def _run_experiments() -> None:
     jobs = [
         ("mulchain", [sys.executable,
                       os.path.join(_REPO, "harness/profile_mulchain.py")],
-         env),
+         env, 600),
         ("lane1024", [sys.executable,
                       os.path.join(_REPO, "harness/measure_recover.py"),
                       "1024"],
-         {**env, "EGES_TPU_LANE_BLOCK": "1024"}),
+         {**env, "EGES_TPU_LANE_BLOCK": "1024"}, 600),
         # (8,128)-packed limb rows for the ladder + pow kernels (8x VPU
         # sublane utilization if layout is the bound); measure_recover's
         # correctness gate vets it before the timing means anything
         ("rows8_1024", [sys.executable,
                         os.path.join(_REPO, "harness/measure_recover.py"),
                         "1024"],
-         {**env, "EGES_TPU_LANE_BLOCK": "1024", "EGES_TPU_ROWS8": "1"}),
+         {**env, "EGES_TPU_LANE_BLOCK": "1024", "EGES_TPU_ROWS8": "1"}, 600),
+        # where does the ~65 ms fixed p50 floor live?  (r5 verdict
+        # item 2: only a measured decomposition settles it)
+        ("floor", [sys.executable,
+                   os.path.join(_REPO, "harness/profile_floor.py")],
+         env, 900),
+        # compile-time A/B (r5 verdict item 4): keccak rounds rolled
+        # onto the pallas grid (24x smaller Mosaic body) vs the bench's
+        # own unrolled-default compile_s at the same batch
+        ("kgrid16384", [sys.executable,
+                        os.path.join(_REPO, "harness/measure_recover.py"),
+                        "16384"],
+         {**env, "EGES_TPU_KECCAK_GRID": "1"}, 900),
+        # BASELINE config 4 on hardware: real-socket cluster, node 0 on
+        # the live chip (>95% of its verifies on device).  Long budget:
+        # the device node's two bucket graphs are fresh ~100 s tunnel
+        # compiles before it even serves RPC.
+        ("jaxload", [sys.executable,
+                     os.path.join(_REPO, "harness/cluster.py"), "loadtest",
+                     "--dir", "/tmp/eges_jaxload", "--nodes", "3",
+                     "--seconds", "120", "--jaxNode", "0", "--ambientJax"],
+         env, 1800),
     ]
     with open(outp, "a") as f:
-        for name, argv, jenv in jobs:
-            # per-job markers: done = rc 0 AND a TPU device string in
-            # the output (a CPU-fallback success must not bank a
-            # meaningless number); anything else counts one attempt —
-            # transient tunnel errors exit rc=1, indistinguishable from
-            # deterministic failures, so each job gets 3 attempts
-            # before its .failed marker, not a first-strike ban
+        for name, argv, jenv, job_timeout in jobs:
+            # per-job markers: done = rc 0 AND the harness's own
+            # "device: ...TPU..." line in the output (anchored — a
+            # CPU-fallback run whose logs merely MENTION 'TPU', e.g. a
+            # libtpu warning, must not bank a meaningless measurement;
+            # r4 advisor finding).  Only CONCLUSIVE failures (rc not in
+            # {0, -9}) count toward the 3-attempt ban: a CPU-fallback
+            # rc==0 and a timeout/kill rc==-9 are both inconclusive —
+            # the job simply never ran on hardware — and retry on the
+            # next window instead of burning attempts.
             done = os.path.join(_DIR, f"exp_{name}.done")
             failed = os.path.join(_DIR, f"exp_{name}.failed")
             tries_p = os.path.join(_DIR, f"exp_{name}.tries")
             if os.path.exists(done) or os.path.exists(failed):
                 continue
-            rc, out = _run_child(argv, 600, jenv)
+            rc, out = _run_child(argv, job_timeout, jenv)
             f.write(f"=== {name} rc={rc} at "
                     f"{time.strftime('%H:%M:%S')} ===\n{out}\n")
             f.flush()  # a kill during job 2 must not lose job 1
-            on_tpu = "TPU" in out
+            on_tpu = re.search(r"^device:.*TPU", out, re.M) is not None
             _log(f"experiment {name}: rc={rc} on_tpu={on_tpu}")
             if rc == 0 and on_tpu:
                 open(done, "w").write(time.strftime("%H:%M:%S"))
+                try:
+                    os.unlink(tries_p)  # stale attempts mustn't linger
+                except OSError:
+                    pass
                 continue
+            if rc == -9:
+                # timeout is USUALLY a tunnel flap (inconclusive), but a
+                # job that deterministically exceeds its 600 s budget
+                # must not hog every future window's sequential queue:
+                # ban after 4 straight timeouts via its own counter
+                slow_p = os.path.join(_DIR, f"exp_{name}.timeouts")
+                try:
+                    slow = int(open(slow_p).read()) + 1
+                except Exception:
+                    slow = 1
+                open(slow_p, "w").write(str(slow))
+                if slow >= 4:
+                    open(failed, "w").write(f"rc=-9 timeouts={slow}")
+                continue
+            if rc == 0:
+                continue  # CPU fallback: inconclusive, no attempt spent
             tries = 1
             try:
                 tries = int(open(tries_p).read()) + 1

@@ -16,10 +16,23 @@ def _load(tmp_path, monkeypatch, outcomes):
     calls = []
 
     def fake_run_child(argv, timeout, env=None):
-        name = "mulchain" if "mulchain" in " ".join(argv) else (
-            "rows8_1024" if env and env.get("EGES_TPU_ROWS8") == "1"
-            else "lane1024")
+        joined = " ".join(argv)
+        if "profile_mulchain" in joined:
+            name = "mulchain"
+        elif "profile_floor" in joined:
+            name = "floor"
+        elif "cluster.py" in joined:
+            name = "jaxload"
+        elif env and env.get("EGES_TPU_ROWS8") == "1":
+            name = "rows8_1024"
+        elif env and env.get("EGES_TPU_KECCAK_GRID") == "1":
+            name = "kgrid16384"
+        else:
+            name = "lane1024"
         calls.append(name)
+        if name not in outcomes:
+            # jobs a test doesn't script: inconclusive CPU fallback
+            return 0, "device: TFRT_CPU_0\nunscripted"
         rc, out = outcomes[name].pop(0)
         return rc, out
 
@@ -52,3 +65,54 @@ def test_experiment_done_requires_tpu_device(tmp_path, monkeypatch):
     tw._run_experiments()
     assert calls.count("rows8_1024") == n
     assert n == 3
+
+
+def test_tpu_mention_in_cpu_log_does_not_conclude(tmp_path, monkeypatch):
+    # a CPU run whose log MENTIONS TPU (e.g. libtpu's "no TPU found"
+    # warning) must not bank a .done — the check anchors on the
+    # harness's own "device: ...TPU" line (r4 advisor finding)
+    tw, calls = _load(tmp_path, monkeypatch, {
+        "mulchain": [(0, "warning: no TPU detected, using CPU\n"
+                         "device: TFRT_CPU_0\nok")],
+        "lane1024": [(0, "device: TPU v5 lite0\nok")],
+        "rows8_1024": [(0, "device: TPU v5 lite0\nok")],
+    })
+    tw._run_experiments()
+    assert not os.path.exists(tmp_path / "exp_mulchain.done")
+    assert not os.path.exists(tmp_path / "exp_mulchain.failed")
+
+
+def test_inconclusive_runs_do_not_burn_attempts(tmp_path, monkeypatch):
+    # CPU-fallback rc==0 and timeout rc==-9 are INCONCLUSIVE: the job
+    # never ran on hardware, so no attempt is spent — two fallbacks
+    # plus one real failure must NOT permanently ban the experiment
+    # (r4 advisor finding)
+    tw, calls = _load(tmp_path, monkeypatch, {
+        "mulchain": [(0, "device: TFRT_CPU_0\ncpu"),   # inconclusive
+                     (-9, "killed"),                    # inconclusive
+                     (1, "boom"),                       # attempt 1
+                     (0, "device: TPU v5 lite0\nok")],  # done
+        "lane1024": [(0, "device: TPU v5 lite0\nok")],
+        "rows8_1024": [(0, "device: TPU v5 lite0\nok")],
+    })
+    for _ in range(4):
+        tw._run_experiments()
+    assert os.path.exists(tmp_path / "exp_mulchain.done")
+    assert not os.path.exists(tmp_path / "exp_mulchain.failed")
+    # the one conclusive failure left a tries file; success removed it
+    assert not os.path.exists(tmp_path / "exp_mulchain.tries")
+
+
+def test_chronic_timeouts_eventually_ban(tmp_path, monkeypatch):
+    # rc==-9 is inconclusive for a FLAP, but a job that times out on
+    # FOUR straight windows is deterministically too slow — it must
+    # stop hogging the sequential queue (r5 review finding)
+    tw, calls = _load(tmp_path, monkeypatch, {
+        "mulchain": [(-9, "killed")] * 4,
+        "lane1024": [(0, "device: TPU v5 lite0\nok")],
+        "rows8_1024": [(0, "device: TPU v5 lite0\nok")],
+    })
+    for _ in range(4):
+        tw._run_experiments()
+    assert os.path.exists(tmp_path / "exp_mulchain.failed")
+    assert "timeouts=4" in open(tmp_path / "exp_mulchain.failed").read()
