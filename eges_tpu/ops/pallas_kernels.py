@@ -1710,6 +1710,74 @@ def recover_finish_pallas(X, Y, Z, zi_raw, ok_in, *, interpret=None):
     return qx.T[:B], qy.T[:B], ok[0, :B], words
 
 
+def _keccak_round_kernel(w_ref, st_ref):
+    """ONE keccak-f round per grid step (grid = (batch, 24)).
+
+    The unrolled 24-round body is the largest Mosaic kernel in the
+    pipeline (~3.6k vector ops) and a prime suspect for the ~150 s
+    per-batch-size compile on the tunnel backend (r5 verdict item 4):
+    rolling rounds onto the grid gives Mosaic a 24x smaller body to
+    compile while keeping ONE pallas_call.  The 25x2 u32 state lives in
+    the output ref, revisited across round steps (rounds are the minor
+    grid dim, so the block stays resident); the final digest rows are
+    gathered by the wrapper.  Gated by EGES_TPU_KECCAK_GRID until the
+    on-chip compile-time A/B picks a default."""
+    r = pl.program_id(1)
+
+    @pl.when(r == 0)
+    def _init():
+        zero = jnp.zeros_like(w_ref[0, :])
+        for l in range(25):
+            st_ref[l, :] = w_ref[2 * l, :] if l < 17 else zero
+            st_ref[25 + l, :] = w_ref[2 * l + 1, :] if l < 17 else zero
+
+    lo = [st_ref[l, :] for l in range(25)]
+    hi = [st_ref[25 + l, :] for l in range(25)]
+    # theta
+    clo = [lo[x] ^ lo[x + 5] ^ lo[x + 10] ^ lo[x + 15] ^ lo[x + 20]
+           for x in range(5)]
+    chi_ = [hi[x] ^ hi[x + 5] ^ hi[x + 10] ^ hi[x + 15] ^ hi[x + 20]
+            for x in range(5)]
+    for x in range(5):
+        rl, rh = _k_rot64(clo[(x + 1) % 5], chi_[(x + 1) % 5], 1, jnp)
+        dlo, dhi = clo[(x + 4) % 5] ^ rl, chi_[(x + 4) % 5] ^ rh
+        for y in range(5):
+            lo[x + 5 * y] = lo[x + 5 * y] ^ dlo
+            hi[x + 5 * y] = hi[x + 5 * y] ^ dhi
+    # rho + pi
+    blo, bhi = [None] * 25, [None] * 25
+    for x in range(5):
+        for y in range(5):
+            dl = y + 5 * ((2 * x + 3 * y) % 5)
+            blo[dl], bhi[dl] = _k_rot64(lo[x + 5 * y], hi[x + 5 * y],
+                                        _KECCAK_ROT[x][y], jnp)
+    # chi
+    for y in range(5):
+        row_l = [blo[x + 5 * y] for x in range(5)]
+        row_h = [bhi[x + 5 * y] for x in range(5)]
+        for x in range(5):
+            lo[x + 5 * y] = row_l[x] ^ (~row_l[(x + 1) % 5]
+                                        & row_l[(x + 2) % 5])
+            hi[x + 5 * y] = row_h[x] ^ (~row_h[(x + 1) % 5]
+                                        & row_h[(x + 2) % 5])
+    # iota — the only per-round constant: a 24-way scalar select chain
+    # beats plumbing an SMEM table through the call for 2 u32s
+    rc_lo = jnp.uint32(0)
+    rc_hi = jnp.uint32(0)
+    for i, c in enumerate(_KECCAK_RC):
+        rc_lo = jnp.where(r == i, jnp.uint32(c & 0xFFFFFFFF), rc_lo)
+        rc_hi = jnp.where(r == i, jnp.uint32(c >> 32), rc_hi)
+    lo[0] = lo[0] ^ rc_lo
+    hi[0] = hi[0] ^ rc_hi
+    for l in range(25):
+        st_ref[l, :] = lo[l]
+        st_ref[25 + l, :] = hi[l]
+
+
+def keccak_grid_enabled() -> bool:
+    return os.environ.get("EGES_TPU_KECCAK_GRID", "") == "1"
+
+
 def keccak_rows_pallas(words: jnp.ndarray, *,
                        interpret: bool | None = None) -> jnp.ndarray:
     """``[34, wide]`` block words (already limb-major) -> ``[8, wide]``
@@ -1718,6 +1786,17 @@ def keccak_rows_pallas(words: jnp.ndarray, *,
     if interpret is None:
         interpret = _default_interpret()
     wide = words.shape[1]
+    if keccak_grid_enabled():
+        st = pl.pallas_call(
+            _keccak_round_kernel,
+            out_shape=jax.ShapeDtypeStruct((50, wide), jnp.uint32),
+            grid=(wide // LANE_BLOCK, 24),
+            in_specs=[pl.BlockSpec((34, LANE_BLOCK), lambda b, r: (0, b))],
+            out_specs=pl.BlockSpec((50, LANE_BLOCK), lambda b, r: (0, b)),
+            interpret=interpret,
+        )(words)
+        # digest order lo0 hi0 lo1 hi1 … (squeeze order of the flat twin)
+        return st[jnp.array([0, 25, 1, 26, 2, 27, 3, 28]), :]
     return pl.pallas_call(
         _keccak_kernel,
         out_shape=jax.ShapeDtypeStruct((8, wide), jnp.uint32),

@@ -330,6 +330,42 @@ def test_keccak_kernel_math_matches_golden():
         assert bytes(digests[i]) == keccak256(m), f"msg {i}"
 
 
+def test_keccak_grid_variant_matches_golden(monkeypatch):
+    """The round-per-grid-step keccak (EGES_TPU_KECCAK_GRID=1, the r5
+    compile-time experiment) must be bit-identical to the unrolled
+    kernel and the host golden — interpret mode exercises the same
+    program_id/when/state-carry structure Mosaic compiles on chip."""
+    import jax.numpy as jnp
+
+    from eges_tpu.crypto.keccak import keccak256
+    from eges_tpu.ops import pallas_kernels as pk
+    from eges_tpu.ops.keccak_tpu import RATE
+
+    monkeypatch.setenv("EGES_TPU_KECCAK_GRID", "1")
+    assert pk.keccak_grid_enabled()
+    msgs = [bytes(range(64)), b"\x00" * 64, b"\xff" * 64,
+            rng.randbytes(64), rng.randbytes(32), b""]
+    wide = pk.LANE_BLOCK
+    words = np.zeros((wide, 34), np.uint32)
+    for i, m in enumerate(msgs):
+        buf = bytearray(RATE)
+        buf[: len(m)] = m
+        buf[len(m)] ^= 0x01
+        buf[RATE - 1] ^= 0x80
+        words[i] = np.frombuffer(bytes(buf), "<u4")
+    got = np.ascontiguousarray(
+        np.asarray(pk.keccak_rows_pallas(jnp.asarray(words.T),
+                                         interpret=True)).T)
+    digests = got.astype("<u4").view(np.uint8).reshape(wide, 32)
+    for i, m in enumerate(msgs):
+        assert bytes(digests[i]) == keccak256(m), f"msg {i}"
+    # and bit-identical to the unrolled kernel on the whole block
+    monkeypatch.delenv("EGES_TPU_KECCAK_GRID")
+    base = np.asarray(pk.keccak_rows_pallas(jnp.asarray(words.T),
+                                            interpret=True))
+    np.testing.assert_array_equal(got.T, base)
+
+
 def test_k_fn_mul_matches_graph_path():
     """The in-kernel mod-N multiply (numpy namespace) is bit-identical
     to OrderN.mul — canonical outputs, random + extreme operands."""
