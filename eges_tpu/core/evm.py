@@ -116,6 +116,9 @@ class ExecResult:
     output: bytes = b""
     logs: tuple = ()
     created: bytes | None = None
+    reverted: bool = False  # REVERT opcode vs any other failure — the
+    #                         tracers report the two differently, as the
+    #                         reference does (vm.ErrExecutionReverted)
 
 
 @dataclass
@@ -373,7 +376,8 @@ class EVM:
         """Message call against ``to`` (ref: evm.Call, core/vm/evm.go)."""
         origin = origin if origin is not None else caller
         return self._drive(
-            "call", (caller, to, value, data, gas, static, origin), depth)
+            "call", (caller, to, value, data, gas, static, origin), depth,
+            "CALL")
 
     def create(self, caller: bytes, value: int, init_code: bytes,
                gas: int, nonce: int, *, depth: int = 0,
@@ -381,18 +385,56 @@ class EVM:
         """Contract creation (ref: evm.Create)."""
         origin = origin if origin is not None else caller
         return self._drive(
-            "create", (caller, value, init_code, gas, nonce, origin), depth)
+            "create", (caller, value, init_code, gas, nonce, origin), depth,
+            "CREATE")
 
     # -- frame trampoline -------------------------------------------------
 
-    def _drive(self, kind: str, args: tuple, depth: int) -> ExecResult:
+    def _trace_enter(self, kind: str, typ: str, args: tuple,
+                     depth: int) -> None:
+        """Frame-boundary tracer hook (ref: vm.EVMLogger CaptureEnter) —
+        the call-tree tracers (callTracer/prestateTracer/4byteTracer)
+        build on these rather than on per-opcode steps."""
+        t = self.tracer
+        if t is None or not hasattr(t, "on_enter"):
+            return
+        if kind == "create":
+            from eges_tpu.core.state import contract_address
+
+            caller, value, init_code, gas, nonce, _origin = args
+            new_addr = contract_address(caller, nonce)
+            # context = the address the init code's SSTOREs land on,
+            # so prestate attribution is correct for creations too
+            t.on_enter(dict(type=typ, frm=caller, to=None,
+                            context=new_addr, value=value,
+                            input=init_code, gas=gas, depth=depth))
+        elif kind == "call":
+            caller, to, value, data, gas, _st, _or = args
+            t.on_enter(dict(type=typ, frm=caller, to=to, context=to,
+                            value=value, input=data, gas=gas, depth=depth))
+        else:  # codecall: callee code in the caller's storage context
+            code_addr, storage_addr, value, data, gas, caller, _or, \
+                _st = args
+            t.on_enter(dict(type=typ, frm=caller, to=code_addr,
+                            context=storage_addr, value=value, input=data,
+                            gas=gas, depth=depth))
+
+    def _trace_exit(self, res: ExecResult, depth: int) -> None:
+        t = self.tracer
+        if t is not None and hasattr(t, "on_exit"):
+            t.on_exit(res, depth)
+
+    def _drive(self, kind: str, args: tuple, depth: int,
+               typ: str = "CALL") -> ExecResult:
         """Run the frame machine to completion.
 
         ``result`` carries a finished child's ExecResult into its
         suspended parent generator; ``None`` starts a fresh one (the
         two cases are exactly ``gen.send``'s contract)."""
+        self._trace_enter(kind, typ, args, depth)
         first = self._begin(kind, args, depth)
         if isinstance(first, ExecResult):
+            self._trace_exit(first, depth)
             return first
         stack: list[_Task] = [first]
         result = None
@@ -409,13 +451,16 @@ class EVM:
             except (EvmError, StateError) as e:
                 res = self._finish_err(task, e)
             else:
+                self._trace_enter(req[0], req[2], req[1], task.depth + 1)
                 sub = self._begin(req[0], req[1], task.depth + 1)
                 if isinstance(sub, ExecResult):
+                    self._trace_exit(sub, task.depth + 1)
                     result = sub       # fast path: deliver immediately
                 else:
                     stack.append(sub)  # result stays None: start child
                 continue
             stack.pop()
+            self._trace_exit(res, task.depth)
             result = res
         return result
 
@@ -525,7 +570,8 @@ class EVM:
             if task.depth == 0:  # only the txn-level frame's revert data
                 self.tracer.output = r.data  # is the trace's output
         self.state = task.snapshot
-        return ExecResult(False, task.gas - gas_left, r.data)
+        return ExecResult(False, task.gas - gas_left, r.data,
+                          reverted=True)
 
     def _finish_err(self, task: "_Task", e: Exception) -> ExecResult:
         del self.logs[task.log_mark:]
@@ -838,7 +884,7 @@ class EVM:
                 self.state.bump_nonce(f.addr)
                 res = yield ("create", (f.addr, value, init, gas_for,
                                         self.state.nonce(f.addr) - 1,
-                                        f.origin))
+                                        f.origin), "CREATE")
                 f.gas += gas_for - res.gas_used
                 f.ret = res.output if not res.success else b""
                 push(int.from_bytes(res.created, "big")
@@ -880,18 +926,20 @@ class EVM:
                 elif op == 0xF1:  # CALL
                     res = yield ("call", (f.addr, to, value, data,
                                           gas_for + stipend, f.static,
-                                          f.origin))
+                                          f.origin), "CALL")
                 elif op == 0xF2:  # CALLCODE: callee code, our storage
                     res = yield ("codecall", (to, f.addr, value, data,
                                               gas_for + stipend, f.addr,
-                                              f.origin, f.static))
+                                              f.origin, f.static),
+                                 "CALLCODE")
                 elif op == 0xF4:  # DELEGATECALL: keep caller+value
                     res = yield ("codecall", (to, f.addr, f.value, data,
                                               gas_for, f.caller,
-                                              f.origin, f.static))
+                                              f.origin, f.static),
+                                 "DELEGATECALL")
                 else:  # STATICCALL
                     res = yield ("call", (f.addr, to, 0, data, gas_for,
-                                          True, f.origin))
+                                          True, f.origin), "STATICCALL")
                 # leftover callee gas (incl. unused stipend) returns to
                 # the caller, matching the reference's accounting
                 # (contract.Gas += returnGas, core/vm/evm.go Call)

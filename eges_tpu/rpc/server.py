@@ -564,7 +564,9 @@ class RpcServer:
         re-execute untraced to reconstruct the exact pre-state, then the
         target runs with per-opcode capture."""
         from eges_tpu.core.state import apply_txn, block_ctx, recover_senders
-        from eges_tpu.core.tracer import StructLogTracer
+        from eges_tpu.core.tracer import (
+            CallTracer, FourByteTracer, PrestateTracer, StructLogTracer,
+        )
 
         found = self.chain.lookup_txn(bytes.fromhex(txh_hex[2:]))
         if found is None:
@@ -583,8 +585,28 @@ class RpcServer:
                           blk.header.coinbase, gas, ctx=ctx,
                           verifier=self.chain.verifier)
             gas = r.cumulative_gas_used
-        tracer = StructLogTracer(
-            with_stack=not (config or {}).get("disableStack", False))
+        # named tracers (the bundled-tracer surface of the reference,
+        # eth/tracers/internal/tracers/*.js selected via config.tracer;
+        # native Python here — see core/tracer.py design note)
+        name = (config or {}).get("tracer", "")
+        if name == "callTracer":
+            tracer = CallTracer()
+        elif name == "prestateTracer":
+            # the traced txn runs on a COPY so ``state`` stays the
+            # untouched pre-state reference the tracer reads from
+            tracer = PrestateTracer(state, coinbase=blk.header.coinbase)
+            state = state.copy()
+        elif name == "4byteTracer":
+            tracer = FourByteTracer()
+        elif name:
+            raise RpcError(-32602, f"unknown tracer {name!r}; built-ins: "
+                                   "callTracer, prestateTracer, "
+                                   "4byteTracer (custom tracers are "
+                                   "Python FrameTracer subclasses, not "
+                                   "JS — core/tracer.py)")
+        else:
+            tracer = StructLogTracer(
+                with_stack=not (config or {}).get("disableStack", False))
         r = apply_txn(state, blk.transactions[index], senders[index],
                       blk.header.coinbase, gas, ctx=ctx,
                       verifier=self.chain.verifier, tracer=tracer)

@@ -1,0 +1,187 @@
+"""Named tracers over debug_traceTransaction (the bundled-tracer role
+of the reference, eth/tracers/internal/tracers/*.js — native Python
+equivalents selected by config.tracer; r5 addition to close VERDICT
+missing #3).  The scenario contract makes a nested CALL so the call
+tree has real structure, reads+writes storage so prestate has slots,
+and carries ABI calldata so 4byte has a selector to count."""
+
+from eges_tpu.core.chain import BlockChain, make_genesis
+from eges_tpu.core.state import contract_address
+from eges_tpu.core.types import Header, Transaction, new_block
+from eges_tpu.crypto import secp256k1 as secp
+from eges_tpu.rpc.server import RpcServer
+
+PRIV = bytes([11]) * 32
+ADDR = secp.pubkey_to_address(secp.privkey_to_pubkey(PRIV))
+ETH = 10**18
+
+# inner contract: SLOAD(0); +1; SSTORE(0); return the new value
+INNER = bytes.fromhex("600054600101806000556000526020" "6000f3")
+# outer contract: CALL(inner, all gas, no data, out 32B at 0) then
+# return inner's answer — gives the call tree a depth-2 node
+def _outer(inner_addr: bytes) -> bytes:
+    return (bytes.fromhex("6020 6000 6000 6000 6000".replace(" ", ""))
+            + b"\x73" + inner_addr + b"\x5a\xf1"
+            + bytes.fromhex("50 6020 6000 f3".replace(" ", "")))
+
+
+def _deploy_and_call():
+    chain = BlockChain(genesis=make_genesis(alloc={ADDR: 10 * ETH}),
+                       alloc={ADDR: 10 * ETH})
+    inner_addr = contract_address(ADDR, 0)
+    outer_addr = contract_address(ADDR, 1)
+
+    def init_for(runtime: bytes) -> bytes:
+        return (bytes([0x60, len(runtime), 0x60, 0x0C, 0x60, 0x00, 0x39,
+                       0x60, len(runtime), 0x60, 0x00, 0xF3]) + runtime)
+
+    def signed(nonce, to, payload=b""):
+        return Transaction(nonce=nonce, gas_price=2, gas_limit=500_000,
+                           to=to, value=0, payload=payload).signed(PRIV)
+
+    txs = [signed(0, None, init_for(INNER)),
+           signed(1, None, init_for(_outer(inner_addr))),
+           # the traced txn: ABI-shaped calldata (poke(uint256))
+           signed(2, outer_addr,
+                  bytes.fromhex("deadbeef") + (7).to_bytes(32, "big"))]
+    kept, root, rroot, gas, bloom = chain.execute_preview(
+        txs, coinbase=bytes(20))
+    assert len(kept) == 3
+    head = chain.head()
+    blk = new_block(Header(parent_hash=head.hash, number=1,
+                           time=head.header.time + 1, root=root,
+                           receipt_hash=rroot, gas_used=gas, bloom=bloom),
+                    txs=kept)
+    assert chain.offer(blk), chain.last_error
+    return chain, kept[2].hash, inner_addr, outer_addr
+
+
+def test_call_tracer_builds_nested_tree():
+    chain, txh, inner_addr, outer_addr = _deploy_and_call()
+    rpc = RpcServer(chain)
+    tree = rpc.dispatch("debug_traceTransaction",
+                        ["0x" + txh.hex(), {"tracer": "callTracer"}])
+    assert tree["type"] == "CALL"
+    assert tree["from"] == "0x" + ADDR.hex()
+    assert tree["to"] == "0x" + outer_addr.hex()
+    assert tree["input"].startswith("0xdeadbeef")
+    assert "error" not in tree
+    assert int(tree["gasUsed"], 16) > 21_000   # txn-level, intrinsic incl
+    (sub,) = tree["calls"]
+    assert sub["type"] == "CALL"
+    assert sub["from"] == "0x" + outer_addr.hex()
+    assert sub["to"] == "0x" + inner_addr.hex()
+    assert int(sub["gasUsed"], 16) > 20_000    # the SSTORE happened there
+    assert sub["output"].endswith("01")        # counter became 1
+    assert "calls" not in sub                  # leaf
+
+
+def test_prestate_tracer_reports_pre_values():
+    chain, txh, inner_addr, outer_addr = _deploy_and_call()
+    rpc = RpcServer(chain)
+    pre = rpc.dispatch("debug_traceTransaction",
+                       ["0x" + txh.hex(), {"tracer": "prestateTracer"}])
+    sender = pre["0x" + ADDR.hex()]
+    assert int(sender["balance"], 16) > 9 * ETH
+    assert sender["nonce"] == 2                # before the traced txn
+    inner = pre["0x" + inner_addr.hex()]
+    assert inner["code"].startswith("0x600054")
+    slot0 = inner["storage"]["0x" + bytes(32).hex()]
+    assert int(slot0, 16) == 0                 # PRE value, not post (1)
+    # the mutation really happened on-chain afterwards
+    assert chain.head_state().storage_at(inner_addr, 0) == 1
+    # coinbase is included
+    assert ("0x" + bytes(20).hex()) in pre
+
+
+def test_4byte_tracer_counts_selectors():
+    chain, txh, _inner, _outer = _deploy_and_call()
+    rpc = RpcServer(chain)
+    counts = rpc.dispatch("debug_traceTransaction",
+                          ["0x" + txh.hex(), {"tracer": "4byteTracer"}])
+    assert counts == {"0xdeadbeef-32": 1}      # inner call carries no data
+
+
+def test_call_tracer_delegatecall_and_bare_revert():
+    # the reverter: SSTORE then REVERT(0,0) — no reason data
+    reverter = bytes.fromhex("6001600055" "60006000fd")
+    chain = BlockChain(genesis=make_genesis(alloc={ADDR: 10 * ETH}),
+                       alloc={ADDR: 10 * ETH})
+    rev_addr = contract_address(ADDR, 0)
+    # outer DELEGATECALLs the reverter, then STOPs (swallowing the fail)
+    outer = (bytes.fromhex("6000 6000 6000 6000".replace(" ", ""))
+             + b"\x73" + rev_addr + b"\x5a\xf4"
+             + bytes.fromhex("50 00".replace(" ", "")))
+    out_addr = contract_address(ADDR, 1)
+
+    def init_for(rt):
+        return (bytes([0x60, len(rt), 0x60, 0x0C, 0x60, 0x00, 0x39,
+                       0x60, len(rt), 0x60, 0x00, 0xF3]) + rt)
+
+    def signed(nonce, to, payload=b""):
+        return Transaction(nonce=nonce, gas_price=2, gas_limit=500_000,
+                           to=to, value=0, payload=payload).signed(PRIV)
+
+    txs = [signed(0, None, init_for(reverter)),
+           signed(1, None, init_for(outer)), signed(2, out_addr)]
+    kept, root, rroot, gas, bloom = chain.execute_preview(
+        txs, coinbase=bytes(20))
+    head = chain.head()
+    blk = new_block(Header(parent_hash=head.hash, number=1,
+                           time=head.header.time + 1, root=root,
+                           receipt_hash=rroot, gas_used=gas, bloom=bloom),
+                    txs=kept)
+    assert chain.offer(blk), chain.last_error
+    tree = RpcServer(chain).dispatch(
+        "debug_traceTransaction",
+        ["0x" + kept[2].hash.hex(), {"tracer": "callTracer"}])
+    (sub,) = tree["calls"]
+    assert sub["type"] == "DELEGATECALL"
+    assert "value" not in sub          # no transfer on DELEGATECALL
+    assert sub["error"] == "execution reverted"  # bare REVERT, no data
+    assert "error" not in tree         # the outer frame swallowed it
+
+
+def test_prestate_attributes_create_init_storage():
+    # a creation whose INIT code SSTOREs: the slot must be attributed
+    # to the soon-to-be contract address, not to an empty account
+    init = bytes.fromhex("602a600055" "60006000f3")   # SSTORE(0,42)
+    chain = BlockChain(genesis=make_genesis(alloc={ADDR: 10 * ETH}),
+                       alloc={ADDR: 10 * ETH})
+    t = Transaction(nonce=0, gas_price=2, gas_limit=500_000, to=None,
+                    value=0, payload=init).signed(PRIV)
+    kept, root, rroot, gas, bloom = chain.execute_preview(
+        [t], coinbase=bytes(20))
+    head = chain.head()
+    blk = new_block(Header(parent_hash=head.hash, number=1,
+                           time=head.header.time + 1, root=root,
+                           receipt_hash=rroot, gas_used=gas, bloom=bloom),
+                    txs=kept)
+    assert chain.offer(blk), chain.last_error
+    pre = RpcServer(chain).dispatch(
+        "debug_traceTransaction",
+        ["0x" + kept[0].hash.hex(), {"tracer": "prestateTracer"}])
+    created = contract_address(ADDR, 0)
+    ent = pre["0x" + created.hex()]
+    assert ent["storage"]["0x" + bytes(32).hex()].endswith("00")  # pre=0
+    assert "0x" not in pre             # no bogus empty-address entry
+
+
+def test_unknown_tracer_rejected_with_builtin_list():
+    chain, txh, _i, _o = _deploy_and_call()
+    rpc = RpcServer(chain)
+    import pytest
+
+    from eges_tpu.rpc.server import RpcError
+
+    with pytest.raises(RpcError, match="callTracer"):
+        rpc.dispatch("debug_traceTransaction",
+                     ["0x" + txh.hex(), {"tracer": "evilTracer"}])
+
+
+def test_struct_log_default_still_works():
+    chain, txh, _i, _o = _deploy_and_call()
+    rpc = RpcServer(chain)
+    out = rpc.dispatch("debug_traceTransaction", ["0x" + txh.hex()])
+    assert out["failed"] is False
+    assert any(e["op"] == "SSTORE" for e in out["structLogs"])
