@@ -159,6 +159,13 @@ def _child(deadline: float, max_batch: int) -> None:
         dt = time.monotonic() - t0
         res = {"batch": batch, "per_sec": batch * n_iters / dt,
                "compile_s": round(compile_s, 1)}
+        # tail latencies for EVERY bucket (matching the runtime
+        # verifier.device_seconds histograms), not just the 1024 point —
+        # BENCH_*.json consumers get the full batch->tail curve
+        from eges_tpu.utils.metrics import percentile
+        srt = sorted(lats)
+        res["p50_ms"] = round(percentile(srt, 50) * 1e3, 3)
+        res["p99_ms"] = round(percentile(srt, 99) * 1e3, 3)
         # emit the throughput result BEFORE the latency extras: on a
         # slow backend the 30-call latency loop can outlive the budget,
         # and being killed mid-latency must not lose the stage
@@ -182,9 +189,8 @@ def _child(deadline: float, max_batch: int) -> None:
                 jax.block_until_ready(fn(a, b))
                 lats.append(time.monotonic() - t1)
             lats.sort()
-            res["p50_ms"] = round(lats[len(lats) // 2] * 1e3, 3)
-            res["p99_ms"] = round(lats[min(len(lats) - 1,
-                                           int(len(lats) * 0.99))] * 1e3, 3)
+            res["p50_ms"] = round(percentile(lats, 50) * 1e3, 3)
+            res["p99_ms"] = round(percentile(lats, 99) * 1e3, 3)
             emit(res)
 
         if res["per_sec"] < 500 and "CPU" in device.upper():
@@ -309,11 +315,15 @@ def main() -> None:
     denom = max(measured or 0.0, REF_CLASS_CPU_PER_S)
 
     best: dict = {}      # kind -> best stage result for that backend
+    # kind -> {batch(str): {p50_ms, p99_ms}} — every stage's tails, not
+    # just the winning batch's
+    lat_by_batch: dict = {"tpu": {}, "cpu": {}}
     printed = [0]
     probe_state: dict = {}   # filled by the probe loop below
 
     def compose() -> dict | None:
-        res = best.get("tpu") or best.get("cpu")
+        kind = "tpu" if best.get("tpu") else "cpu"
+        res = best.get(kind)
         if not res:
             return None
         out = {
@@ -339,9 +349,15 @@ def main() -> None:
             cap = _watcher_capture()
             if cap:
                 out["watcher_tpu_capture"] = cap
+        if lat_by_batch[kind]:
+            out["latency_ms_by_batch"] = dict(sorted(
+                lat_by_batch[kind].items(), key=lambda kv: int(kv[0])))
+        at_1024 = lat_by_batch[kind].get("1024", {})
         for k, name in (("p50_ms", "p50_latency_ms_at_1024"),
                         ("p99_ms", "p99_latency_ms_at_1024")):
-            if k in res:
+            if k in at_1024:
+                out[name] = at_1024[k]
+            elif k in res:
                 out[name] = res[k]
         return out
 
@@ -364,6 +380,9 @@ def main() -> None:
             res = json.loads(line[len("RESULT "):])
         except ValueError:
             return
+        if "p50_ms" in res:
+            lat_by_batch[kind][str(res["batch"])] = {
+                k: res[k] for k in ("p50_ms", "p99_ms") if k in res}
         cur = best.get(kind)
         if cur is None or res["per_sec"] >= cur["per_sec"]:
             merged = dict(cur or {})  # carry earlier p50/p99 forward

@@ -58,10 +58,10 @@ class ChainGeecConfig:
         if "bootstrap" in obj and "signed_votes" not in obj:
             # consensus-critical default: a genesis that omits the key is
             # ambiguous across build generations — pin it explicitly
-            import sys
-            print("WARNING: genesis thw section omits 'signed_votes'; "
-                  "defaulting to true — pin it explicitly so every node "
-                  "generation agrees", file=sys.stderr)
+            from eges_tpu.utils.log import get_logger
+            get_logger("geec.config").warn(
+                "genesis thw section omits 'signed_votes'; defaulting to "
+                "true — pin it explicitly so every node generation agrees")
         return cls(
             bootstrap=tuple(BootstrapNode.from_json(n)
                             for n in obj.get("bootstrap", [])),

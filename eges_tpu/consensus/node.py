@@ -46,6 +46,7 @@ from eges_tpu.consensus.working_block import (
     WB_CURRENT, WB_FUTURE, WB_PASSED,
 )
 from eges_tpu.core.chain import BlockChain
+from eges_tpu.utils import tracing
 from eges_tpu.core.types import (
     Block, ConfirmBlockMsg, Header, QueryBlockMsg, Registration, Transaction,
     fake_txn, EMPTY_ADDR, new_block,
@@ -143,6 +144,7 @@ class GeecNode:
         self._validate_req: M.ValidateRequest | None = None
         self._seal_t0 = 0.0
         self._elect_t = 0.0
+        self._ack_t = 0.0
 
         # timers
         self._timers: dict[str, object] = {}
@@ -227,11 +229,27 @@ class GeecNode:
         for name in list(self._timers):
             self._cancel_timer(name)
 
+    def _breakdown(self, phase: str, dt: float, **kw) -> None:
+        """One phase timing, three sinks: the legacy ``[Breakdown]`` log
+        line (only under --breakdown, so grep.py-style harvesting keeps
+        working), a percentile histogram, and a finished span."""
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+        metrics.histogram(f"consensus.phase_seconds;phase={phase}").observe(dt)
+        tracing.DEFAULT.record_span(f"consensus.{phase}", dt,
+                                    node=self.coinbase.hex()[:8], **kw)
+        if self.cfg.breakdown:
+            self._log("breakdown", phase=phase, dt=dt, **kw)
+
     # ------------------------------------------------------------------
     # inbound dispatch
     # ------------------------------------------------------------------
 
     def on_gossip(self, data: bytes) -> None:
+        ctx, data = tracing.extract(data)
+        with tracing.DEFAULT.activate(ctx):
+            self._on_gossip(data)
+
+    def _on_gossip(self, data: bytes) -> None:
         try:
             code, msg = M.unpack_gossip(data)
         except Exception:
@@ -260,6 +278,11 @@ class GeecNode:
             self._handle_txns(msg)
 
     def on_direct(self, data: bytes) -> None:
+        ctx, data = tracing.extract(data)
+        with tracing.DEFAULT.activate(ctx):
+            self._on_direct(data)
+
+    def _on_direct(self, data: bytes) -> None:
         try:
             code, author, msg = M.unpack_direct(data)
         except Exception:
@@ -406,10 +429,8 @@ class GeecNode:
         wb.is_proposer = True
         wb.validate_threshold = self.membership.validate_threshold()
         self._cancel_timer("election")
-        if self.cfg.breakdown:
-            self._log("breakdown", phase="election",
-                      dt=self.clock.now() - self._elect_t,
-                      blk=wb.blk_num)
+        self._breakdown("election", self.clock.now() - self._elect_t,
+                        blk=wb.blk_num)
         if self._proposal_version > 0:
             # recovered leader: query what happened first
             self._start_query(wb.blk_num, self._proposal_version)
@@ -543,9 +564,8 @@ class GeecNode:
                 wb.validate_cert = cert
             wb.validate_succeeded = True
             self._cancel_timer("validate")
-            if self.cfg.breakdown:
-                self._log("breakdown", phase="ack",
-                          dt=self.clock.now() - self._ack_t, blk=wb.blk_num)
+            self._breakdown("ack", self.clock.now() - self._ack_t,
+                            blk=wb.blk_num)
             self._phase = BACKOFF
             supporters = tuple(wb.validate_replies.keys())
             self._set_timer("backoff", self.ccfg.backoff_time_ms / 1e3,
@@ -575,9 +595,8 @@ class GeecNode:
         self._proposal_geec_txns = []  # included in the sealed block
         from eges_tpu.utils.metrics import DEFAULT as metrics
         metrics.counter("consensus.sealed").inc()
-        if self.cfg.breakdown:
-            self._log("breakdown", phase="seal_total",
-                      dt=self.clock.now() - self._seal_t0, blk=block.number)
+        self._breakdown("seal_total", self.clock.now() - self._seal_t0,
+                        blk=block.number)
         self.chain.offer(sealed)  # our own insert funnel
         self.transport.gossip(M.pack_gossip(M.GOSSIP_CONFIRM_BLOCK, confirm))
 
@@ -1580,7 +1599,7 @@ class GeecNode:
         confirmed blocks", SURVEY §5 checkpoint/resume)."""
         self.trust_rands[blk.number] = blk.header.trust_rand
         if self.txpool is not None and blk.transactions:
-            self.txpool.remove_included(blk.transactions)
+            self.txpool.remove_included(blk.transactions, block=blk.number)
         if blk.geec_txns:
             # drop geec txns the landed block already included — from the
             # pending queue AND from any in-flight proposal's drained list

@@ -693,11 +693,17 @@ class BlockChain:
         self._remember_state(block.hash, block.number, state, receipts)
         self._index_txns(block, receipts)
         self.bloom_index.add(block.number, block.header.bloom)
-        metrics.timer("chain.insert").update(time.monotonic() - t0)
+        from eges_tpu.utils import tracing
+
+        dt = time.monotonic() - t0
+        metrics.timer("chain.insert").update(dt)
+        metrics.histogram("chain.insert_seconds").observe(dt)
         metrics.counter("chain.blocks").inc()
         metrics.counter("chain.txns").inc(len(block.transactions))
         metrics.counter("chain.geec_txns").inc(len(block.geec_txns))
         metrics.gauge("chain.height").set(block.number)
+        tracing.DEFAULT.record_span("chain.insert", dt, number=block.number,
+                                    txns=len(block.transactions))
         for fn in self._listeners:
             fn(block)
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 import numpy as np
 
 from eges_tpu.core.types import Transaction
+from eges_tpu.utils import tracing
 
 
 class TxPool:
@@ -48,19 +49,33 @@ class TxPool:
         self._timer = None
         self.stats = {"admitted": 0, "rejected": 0, "duplicate": 0,
                       "batches": 0}
+        # distributed-tracing linkage: per-txn SpanContext captured at
+        # ingest.  The flush runs on a clock callback where contextvars
+        # don't survive, so the context is carried here explicitly and
+        # re-parented at admit / commit time.
+        self.owner = ""  # identifies this pool's node in span attrs
+        self._ingest_ctx: dict[bytes, tracing.SpanContext] = {}
+        self._INGEST_CTX_CAP = 8192
 
     # -- ingest -----------------------------------------------------------
 
     def add_remotes(self, txns) -> None:
         """Queue remote txns for batched admission
         (ref: TxPool.AddRemotes core/tx_pool.go:551)."""
-        for t in txns:
-            h = t.hash
-            if h in self._known:
-                self.stats["duplicate"] += 1
-                continue
-            self._known.add(h)
-            self._queue.append(t)
+        fresh = 0
+        with tracing.DEFAULT.span("txpool.ingest", owner=self.owner) as sp:
+            ctx = sp.context()
+            for t in txns:
+                h = t.hash
+                if h in self._known:
+                    self.stats["duplicate"] += 1
+                    continue
+                self._known.add(h)
+                self._queue.append(t)
+                if len(self._ingest_ctx) < self._INGEST_CTX_CAP:
+                    self._ingest_ctx[h] = ctx
+                fresh += 1
+            sp.set_attr("fresh", fresh)
         if len(self._queue) >= self.max_batch:
             self._flush()
         elif self._queue and self._timer is None:
@@ -114,6 +129,16 @@ class TxPool:
     PRICE_BUMP_PCT = 10
 
     def _admit(self, t: Transaction, sender: bytes) -> None:
+        # re-enter the txn's ingest trace: the flush that got us here ran
+        # on a clock callback, outside any ambient span context
+        ctx = self._ingest_ctx.get(t.hash) \
+            or tracing.DEFAULT.current_context()
+        with tracing.DEFAULT.span("txpool.admit", parent=ctx,
+                                  owner=self.owner,
+                                  tx=t.hash.hex()[:16]) as sp:
+            self._admit_traced(t, sender, sp)
+
+    def _admit_traced(self, t: Transaction, sender: bytes, sp) -> None:
         by_nonce = self.pending.setdefault(sender, {})
         old = by_nonce.get(t.nonce)
         if old is None and len(self._by_hash) >= self.max_pending:
@@ -121,6 +146,7 @@ class TxPool:
             # keeps the pool size constant and must stay possible even
             # when full (ref: core/tx_pool.go admits replacements)
             self.stats["rejected"] += 1
+            sp.set_attr("outcome", "rejected")
             if not by_nonce:
                 del self.pending[sender]
             return
@@ -128,6 +154,7 @@ class TxPool:
             # price-bump replacement (ref: core/tx_pool.go:571+)
             if t.gas_price * 100 < old.gas_price * (100 + self.PRICE_BUMP_PCT):
                 self.stats["duplicate"] += 1
+                sp.set_attr("outcome", "duplicate")
                 return
             self._by_hash.pop(old.hash, None)
             self._dead.add(old.hash)
@@ -137,7 +164,10 @@ class TxPool:
         self._by_hash[t.hash] = (sender, t.nonce)
         self._maybe_compact()
         self.stats["admitted"] += 1
+        sp.set_attr("outcome", "admitted")
         if self.on_admitted is not None:
+            # still inside the admit span: a broadcast hook fired here
+            # injects this trace into the outbound gossip envelope
             self.on_admitted(t, sender)
 
     def _maybe_compact(self) -> None:
@@ -219,10 +249,20 @@ class TxPool:
                     if not by_nonce:
                         del self.pending[sender]
             self._dead.add(t.hash)
+            self._ingest_ctx.pop(t.hash, None)
         self._maybe_compact()
 
-    def remove_included(self, txns) -> None:
-        """Drop txns included in a canonical block."""
+    def remove_included(self, txns, block: int | None = None) -> None:
+        """Drop txns included in a canonical block; closes each txn's
+        trace with a ``tx.commit`` span so ingest -> admit -> commit is
+        one linked trace even across nodes."""
+        for t in txns:
+            ctx = self._ingest_ctx.get(t.hash)
+            if ctx is not None:
+                tracing.DEFAULT.record_span(
+                    "tx.commit", 0.0, parent=ctx, owner=self.owner,
+                    tx=t.hash.hex()[:16],
+                    **({"block": block} if block is not None else {}))
         self._evict(txns)
         if (self.journal_path and
                 self._journal_count > max(64, 4 * len(self._by_hash))):

@@ -497,8 +497,11 @@ class GossipPlane:
                 pass
             return
         from eges_tpu.core import rlp
+        from eges_tpu.utils import tracing
 
-        proto = self._code_to_proto.get(rlp.peek_first_uint(data))
+        # peek past a possible trace header; handlers strip it themselves
+        proto = self._code_to_proto.get(
+            rlp.peek_first_uint(tracing.payload_of(data)))
         if proto is None:
             # a code outside every protocol we registered: out of
             # contract, score it (ref: p2p/peer.go invalid msg code)
@@ -615,8 +618,10 @@ class GossipPlane:
         proto = None
         if self.protocols is not None:
             from eges_tpu.core import rlp
+            from eges_tpu.utils import tracing
 
-            proto = self._code_to_proto.get(rlp.peek_first_uint(data))
+            proto = self._code_to_proto.get(
+                rlp.peek_first_uint(tracing.payload_of(data)))
         now = time.monotonic()
         for peer, sess in list(self._writers.items()):
             if proto is not None and sess.shared is None \
@@ -651,13 +656,17 @@ class SocketTransport:
         self._direct = direct
 
     def gossip(self, data: bytes) -> None:
+        from eges_tpu.utils import tracing
         from eges_tpu.utils.metrics import DEFAULT as metrics
+        data = tracing.inject_current(data)
         metrics.counter("net.gossip_bytes").inc(len(data))
         metrics.counter("net.gossip_msgs").inc()
         self._gossip.broadcast(data)
 
     def send_direct(self, ip: str, port: int, data: bytes) -> None:
+        from eges_tpu.utils import tracing
         from eges_tpu.utils.metrics import DEFAULT as metrics
+        data = tracing.inject_current(data)
         metrics.counter("net.direct_bytes").inc(len(data))
         metrics.counter("net.direct_msgs").inc()
         self._direct.send(ip, port, data)

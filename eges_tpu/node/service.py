@@ -243,6 +243,7 @@ class NodeService:
         self.txpool = TxPool(
             self.clock, verifier=verifier,
             journal_path=os.path.join(cfg.datadir, "transactions.rlp"))
+        self.txpool.owner = self.coinbase.hex()[:8]
         loaded = self.txpool.load_journal()
         if loaded:
             self.log.geec("txpool journal", reloaded=loaded)
@@ -319,6 +320,14 @@ class NodeService:
                     self.log.geec("metrics", **{
                         k.replace(".", "_"): v for k, v in snap.items()
                         if not isinstance(v, dict)})
+                # drain finished spans to the datadir so multi-node runs
+                # leave per-node JSONL dumps breakdown_report.py can merge
+                from eges_tpu.utils import tracing
+                try:
+                    tracing.DEFAULT.dump(
+                        os.path.join(self.cfg.datadir, "spans.jsonl"))
+                except OSError:
+                    pass
             await asyncio.sleep(0.5)
 
     async def run_forever(self) -> None:
@@ -329,6 +338,12 @@ class NodeService:
     def close(self) -> None:
         if self._height_task is not None:
             self._height_task.cancel()
+        from eges_tpu.utils import tracing
+        try:
+            tracing.DEFAULT.dump(
+                os.path.join(self.cfg.datadir, "spans.jsonl"))
+        except OSError:
+            pass
         if self.discovery is not None:
             self.discovery.close()
         if self.rpc is not None:

@@ -18,8 +18,12 @@ Methods:
   eth_getFilterChanges, eth_uninstallFilter, eth_sendRawTransaction,
   net_version, web3_clientVersion,
   thw_register, thw_membership, thw_status, thw_pendingGeecTxns,
-  thw_metrics, debug_startProfile, debug_stopProfile, debug_stacks,
-  debug_stats
+  thw_metrics, thw_traces, debug_startProfile, debug_stopProfile,
+  debug_stacks, debug_stats
+
+Plain HTTP ``GET /metrics`` on the same port serves the whole metrics
+registry in Prometheus text exposition format (the pull-based analogue
+of the reference's influxdb push exporters behind ``--metrics``).
 """
 
 from __future__ import annotations
@@ -282,7 +286,22 @@ class RpcServer:
             if self.txpool is not None:
                 out["txpool"] = dict(self.txpool.stats,
                                      pending=len(self.txpool))
+            from eges_tpu.utils import tracing
+            out["tracing"] = tracing.DEFAULT.stats()
             return out
+        if method == "thw_traces":
+            # finished spans from the in-process ring buffer; params:
+            # [] | [limit] | [{"limit": n, "trace": "<32-hex id>"}]
+            from eges_tpu.utils import tracing
+            limit, trace = 256, None
+            if params:
+                p = params[0]
+                if isinstance(p, dict):
+                    limit = int(p.get("limit", limit))
+                    trace = p.get("trace")
+                else:
+                    limit = int(p)
+            return tracing.DEFAULT.finished(limit=limit, trace=trace)
         if method.startswith("debug_"):
             return self._debug(method, params)
         raise RpcError(-32601, f"method {method} not found")
@@ -647,6 +666,11 @@ class RpcServer:
                 line = await reader.readline()
                 if not line:
                     break
+                try:
+                    http_method, path, _ = \
+                        line.decode("latin-1").split(" ", 2)
+                except ValueError:
+                    http_method, path = "POST", "/"
                 headers = {}
                 while True:
                     h = await reader.readline()
@@ -659,6 +683,23 @@ class RpcServer:
                     return
                 length = int(headers.get("content-length", 0))
                 body = await reader.readexactly(length) if length else b""
+                if http_method == "GET":
+                    # Prometheus scrape endpoint; everything else 404s
+                    if path.split("?", 1)[0] == "/metrics":
+                        from eges_tpu.utils.metrics import prometheus_text
+                        resp = prometheus_text().encode()
+                        writer.write(
+                            b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; "
+                            b"version=0.0.4; charset=utf-8\r\n"
+                            + f"Content-Length: {len(resp)}\r\n".encode()
+                            + b"Connection: keep-alive\r\n\r\n" + resp)
+                    else:
+                        writer.write(
+                            b"HTTP/1.1 404 Not Found\r\n"
+                            b"Content-Length: 0\r\n"
+                            b"Connection: keep-alive\r\n\r\n")
+                    await writer.drain()
+                    continue
                 resp = self._handle_body(body)
                 writer.write(
                     b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"

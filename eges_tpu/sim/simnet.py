@@ -73,13 +73,17 @@ class SimTransport:
         self.node_id = node_id
 
     def gossip(self, data: bytes) -> None:
+        from eges_tpu.utils import tracing
         from eges_tpu.utils.metrics import DEFAULT as metrics
+        data = tracing.inject_current(data)
         metrics.counter("net.gossip_bytes").inc(len(data))
         metrics.counter("net.gossip_msgs").inc()
         self._net.deliver_gossip(self.node_id, data)
 
     def send_direct(self, ip: str, port: int, data: bytes) -> None:
+        from eges_tpu.utils import tracing
         from eges_tpu.utils.metrics import DEFAULT as metrics
+        data = tracing.inject_current(data)
         metrics.counter("net.direct_bytes").inc(len(data))
         metrics.counter("net.direct_msgs").inc()
         self._net.deliver_direct(self.node_id, (ip, port), data)

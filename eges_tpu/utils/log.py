@@ -42,21 +42,37 @@ class GeecLogger(logging.LoggerAdapter):
 
     def breakdown(self, phase: str, dt: float, **kw) -> None:
         """Phase timing lines (ref: '[Breakdown 1] Election time',
-        consensus/geec/geec.go:313-317)."""
-        self.logger.info("[Breakdown] %s time=%.6fs %s", phase, dt, _fmt_kv(kw))
+        consensus/geec/geec.go:313-317).  Logged at GEEC level: these
+        lines exist to be harvested from logs (grep.py workflow), so the
+        default verbosity must not filter them."""
+        self.logger.log(GEEC, "[Breakdown] %s time=%.6fs %s", phase, dt,
+                        _fmt_kv(kw))
 
 
 def get_logger(name: str, verbosity: int = 3,
                stream=None) -> GeecLogger:
-    """Verbosity mapping follows geth --verbosity: 1=error..5=trace."""
+    """Verbosity mapping follows geth --verbosity: 1=error..5=trace.
+
+    Idempotent: repeated calls re-level the existing handler instead of
+    keeping the first level forever, and a different ``stream`` retargets
+    that handler rather than stacking a second one (which used to
+    double every log line).
+    """
     level = {1: logging.ERROR, 2: logging.WARNING, 3: GEEC,
              4: logging.DEBUG, 5: 1}.get(verbosity, GEEC)
     logger = logging.getLogger(name)
     logger.setLevel(level)
-    if not logger.handlers:
+    ours = [h for h in logger.handlers if getattr(h, "_geec", False)]
+    if not ours:
         h = logging.StreamHandler(stream or sys.stdout)
+        h._geec = True
         h.setFormatter(logging.Formatter(
             "%(asctime)s %(levelname)-5s %(name)s %(message)s",
             datefmt="%H:%M:%S"))
         logger.addHandler(h)
+        ours = [h]
+    for h in ours:
+        h.setLevel(level)
+        if stream is not None and h.stream is not stream:
+            h.setStream(stream)
     return GeecLogger(logger, {})

@@ -1,27 +1,55 @@
-"""Metrics registry: counters / gauges / meters / timers.
+"""Metrics registry: counters / gauges / meters / timers / histograms.
 
 Role parity with the reference's ``metrics/`` fork (ref:
 metrics/metrics.go:25 ``--metrics`` flag; instrumented in p2p/metrics.go,
 eth/metrics.go, eth/downloader/metrics.go).  In-process registry with
 snapshot export; the RPC layer and harness read snapshots instead of the
-reference's influxdb/librato push exporters.
+reference's influxdb/librato push exporters, and ``prometheus_text``
+renders the whole registry in Prometheus text exposition format 0.0.4
+for the RPC server's ``GET /metrics``.
+
+Label convention: the registry is flat, so labeled series are encoded in
+the metric name as ``family;key=value,key2=value2`` (e.g.
+``verifier.device_seconds;bucket=128``).  The Prometheus exporter parses
+that back into real labels; ``snapshot()`` keeps the flat names.
 """
 
 from __future__ import annotations
 
+import random
+import re
 import threading
 import time
-from collections import defaultdict, deque
+from collections import deque
+
+
+def percentile(sorted_vals, q: float) -> float:
+    """Linear-interpolation percentile over a pre-sorted sequence,
+    matching numpy.percentile's default method."""
+    n = len(sorted_vals)
+    if n == 0:
+        return 0.0
+    if n == 1:
+        return float(sorted_vals[0])
+    rank = (q / 100.0) * (n - 1)
+    lo = int(rank)
+    frac = rank - lo
+    if lo + 1 >= n:
+        return float(sorted_vals[-1])
+    return float(sorted_vals[lo]) + frac * (
+        float(sorted_vals[lo + 1]) - float(sorted_vals[lo]))
 
 
 class Counter:
-    __slots__ = ("value",)
+    __slots__ = ("value", "_lock")
 
     def __init__(self):
         self.value = 0
+        self._lock = threading.Lock()
 
     def inc(self, n: int = 1) -> None:
-        self.value += n
+        with self._lock:
+            self.value += n
 
 
 class Gauge:
@@ -40,17 +68,19 @@ class Meter:
 
     def __init__(self, clock=time.monotonic):
         self._clock = clock
+        self._lock = threading.Lock()
         self.count = 0
         self._start = clock()
         self._window: deque[tuple[float, int]] = deque()
 
     def mark(self, n: int = 1) -> None:
-        self.count += n
-        now = self._clock()
-        self._window.append((now, n))
-        cutoff = now - 60.0
-        while self._window and self._window[0][0] < cutoff:
-            self._window.popleft()
+        with self._lock:
+            self.count += n
+            now = self._clock()
+            self._window.append((now, n))
+            cutoff = now - 60.0
+            while self._window and self._window[0][0] < cutoff:
+                self._window.popleft()
 
     @property
     def rate_mean(self) -> float:
@@ -59,7 +89,8 @@ class Meter:
 
     @property
     def rate_1m(self) -> float:
-        return sum(n for _, n in self._window) / 60.0
+        with self._lock:
+            return sum(n for _, n in self._window) / 60.0
 
 
 class Timer:
@@ -67,16 +98,18 @@ class Timer:
 
     def __init__(self, clock=time.monotonic):
         self._clock = clock
+        self._lock = threading.Lock()
         self.count = 0
         self.total = 0.0
         self.min = float("inf")
         self.max = 0.0
 
     def update(self, seconds: float) -> None:
-        self.count += 1
-        self.total += seconds
-        self.min = min(self.min, seconds)
-        self.max = max(self.max, seconds)
+        with self._lock:
+            self.count += 1
+            self.total += seconds
+            self.min = min(self.min, seconds)
+            self.max = max(self.max, seconds)
 
     def time(self):
         t0 = self._clock()
@@ -90,6 +123,55 @@ class Timer:
                 timer.update(timer._clock() - t0)
 
         return _Ctx()
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class Histogram:
+    """Reservoir-sampled distribution (Vitter's Algorithm R, fixed-size
+    uniform reservoir) with exact count/total/min/max and interpolated
+    percentiles over the sample.
+
+    A seeded PRNG keeps test runs deterministic; below ``reservoir``
+    observations the percentiles are exact.
+    """
+
+    RESERVOIR = 1024
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rng = random.Random(0x5eed)
+        self._sample: list[float] = []
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.min = min(self.min, v)
+            self.max = max(self.max, v)
+            if len(self._sample) < self.RESERVOIR:
+                self._sample.append(v)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self.RESERVOIR:
+                    self._sample[j] = v
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            vals = sorted(self._sample)
+        return percentile(vals, q)
+
+    def percentiles(self, qs=(50.0, 95.0, 99.0)) -> dict[float, float]:
+        with self._lock:
+            vals = sorted(self._sample)
+        return {q: percentile(vals, q) for q in qs}
 
     @property
     def mean(self) -> float:
@@ -121,23 +203,156 @@ class Registry:
     def timer(self, name: str) -> Timer:
         return self._get(name, Timer)
 
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
     def snapshot(self) -> dict:
-        out = {}
         with self._lock:
-            for name, m in sorted(self._metrics.items()):
-                if isinstance(m, Counter):
-                    out[name] = m.value
-                elif isinstance(m, Gauge):
-                    out[name] = m.value
-                elif isinstance(m, Meter):
-                    out[name] = {"count": m.count,
-                                 "rate_mean": round(m.rate_mean, 3),
-                                 "rate_1m": round(m.rate_1m, 3)}
-                elif isinstance(m, Timer):
-                    out[name] = {"count": m.count,
-                                 "mean_s": round(m.mean, 6),
-                                 "max_s": round(m.max, 6)}
+            metrics = sorted(self._metrics.items())
+        out = {}
+        for name, m in metrics:
+            if isinstance(m, Counter):
+                out[name] = m.value
+            elif isinstance(m, Gauge):
+                out[name] = m.value
+            elif isinstance(m, Meter):
+                out[name] = {"count": m.count,
+                             "rate_mean": round(m.rate_mean, 3),
+                             "rate_1m": round(m.rate_1m, 3)}
+            elif isinstance(m, Timer):
+                out[name] = {"count": m.count,
+                             "mean_s": round(m.mean, 6),
+                             "min_s": round(m.min, 6) if m.count else 0.0,
+                             "max_s": round(m.max, 6)}
+            elif isinstance(m, Histogram):
+                ps = m.percentiles()
+                out[name] = {"count": m.count,
+                             "mean": round(m.mean, 6),
+                             "min": round(m.min, 6) if m.count else 0.0,
+                             "max": round(m.max, 6),
+                             "p50": round(ps[50.0], 6),
+                             "p95": round(ps[95.0], 6),
+                             "p99": round(ps[99.0], 6)}
         return out
+
+
+# -- Prometheus text exposition (format 0.0.4) --------------------------
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _split_labels(name: str) -> tuple[str, dict[str, str]]:
+    """``family;k=v,k2=v2`` -> (family, {k: v})."""
+    if ";" not in name:
+        return name, {}
+    family, _, rest = name.partition(";")
+    labels = {}
+    for pair in rest.split(","):
+        if "=" in pair:
+            k, _, v = pair.partition("=")
+            labels[k.strip()] = v.strip()
+    return family, labels
+
+
+def _prom_name(family: str) -> str:
+    name = _NAME_RE.sub("_", family)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    quoted = ",".join(
+        '%s="%s"' % (_prom_name(k),
+                     str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        for k, v in sorted(labels.items()))
+    return "{" + quoted + "}"
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    return repr(f) if f != int(f) else str(int(f))
+
+
+def prometheus_text(registry: "Registry | None" = None) -> str:
+    """Render the registry in Prometheus text format.
+
+    Counters/Meters become ``counter`` families, numeric Gauges become
+    ``gauge``, Timers and Histograms become ``summary`` families (with
+    quantile samples for Histograms).  Non-numeric gauges (e.g.
+    ``verifier.device_name``) become ``<name>_info{value="..."} 1``.
+    """
+    reg = registry if registry is not None else DEFAULT
+    with reg._lock:
+        metrics = sorted(reg._metrics.items())
+
+    families: dict[str, list[tuple[str, dict, object]]] = {}
+    for name, m in metrics:
+        family, labels = _split_labels(name)
+        families.setdefault(_prom_name(family), []).append((name, labels, m))
+
+    lines: list[str] = []
+    for fam in sorted(families):
+        members = families[fam]
+        kind = type(members[0][2])
+        if kind is Counter:
+            lines.append(f"# TYPE {fam} counter")
+            for _, labels, m in members:
+                lines.append(f"{fam}{_fmt_labels(labels)} "
+                             f"{_fmt_value(m.value)}")
+        elif kind is Gauge:
+            numeric = [(lb, m) for _, lb, m in members
+                       if isinstance(m.value, (int, float))]
+            info = [(lb, m) for _, lb, m in members
+                    if not isinstance(m.value, (int, float))]
+            if numeric:
+                lines.append(f"# TYPE {fam} gauge")
+                for labels, m in numeric:
+                    lines.append(f"{fam}{_fmt_labels(labels)} "
+                                 f"{_fmt_value(m.value)}")
+            if info:
+                lines.append(f"# TYPE {fam}_info gauge")
+                for labels, m in info:
+                    lb = dict(labels)
+                    lb["value"] = str(m.value)
+                    lines.append(f"{fam}_info{_fmt_labels(lb)} 1")
+        elif kind is Meter:
+            lines.append(f"# TYPE {fam}_total counter")
+            for _, labels, m in members:
+                lines.append(f"{fam}_total{_fmt_labels(labels)} {m.count}")
+            lines.append(f"# TYPE {fam}_rate_1m gauge")
+            for _, labels, m in members:
+                lines.append(f"{fam}_rate_1m{_fmt_labels(labels)} "
+                             f"{_fmt_value(m.rate_1m)}")
+        elif kind is Timer:
+            lines.append(f"# TYPE {fam} summary")
+            for _, labels, m in members:
+                lb = _fmt_labels(labels)
+                lines.append(f"{fam}_count{lb} {m.count}")
+                lines.append(f"{fam}_sum{lb} {_fmt_value(m.total)}")
+        elif kind is Histogram:
+            lines.append(f"# TYPE {fam} summary")
+            for _, labels, m in members:
+                ps = m.percentiles()
+                for q, key in ((50.0, "0.5"), (95.0, "0.95"), (99.0, "0.99")):
+                    qlb = dict(labels)
+                    qlb["quantile"] = key
+                    lines.append(f"{fam}{_fmt_labels(qlb)} "
+                                 f"{_fmt_value(ps[q])}")
+                qlb = dict(labels)
+                qlb["quantile"] = "1"
+                mx = m.max if m.count else 0.0
+                lines.append(f"{fam}{_fmt_labels(qlb)} {_fmt_value(mx)}")
+                lb = _fmt_labels(labels)
+                lines.append(f"{fam}_count{lb} {m.count}")
+                lines.append(f"{fam}_sum{lb} {_fmt_value(m.total)}")
+    return "\n".join(lines) + "\n"
 
 
 DEFAULT = Registry()

@@ -1,0 +1,122 @@
+"""Per-phase latency report: the ``grep.py`` analog with percentiles.
+
+The reference harvested consensus phase timings by grepping
+``[Breakdown N]`` lines out of node logs (SURVEY §5).  This tool merges
+BOTH observability generations of this build into one table:
+
+* legacy ``[Breakdown] <phase> time=<x>s`` log lines (still emitted
+  under ``--breakdown``), and
+* span dumps (``spans.jsonl`` — JSONL rows the tracer writes to each
+  node's datadir; span names like ``consensus.election`` or
+  ``verifier.batch``).
+
+Log-harvested phases keep their bare name (``election``); span rows key
+by their full span name, so the two sources never double-count even
+when they describe the same phase.
+
+Usage:
+    python harness/breakdown_report.py /tmp/geec-cluster
+    python harness/breakdown_report.py node0.log node1/spans.jsonl ...
+
+A directory argument scans the ``harness/cluster.py`` layout:
+``node*.log`` plus ``node*/spans.jsonl`` beneath it.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from eges_tpu.utils.metrics import percentile  # noqa: E402
+
+BREAKDOWN_RE = re.compile(r"\[Breakdown\]\s+(\S+)\s+time=([0-9.eE+-]+)s")
+
+
+def _expand(paths) -> list[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob.glob(os.path.join(p, "node*.log"))))
+            out.extend(sorted(glob.glob(os.path.join(p, "node*",
+                                                     "spans.jsonl"))))
+            out.extend(sorted(glob.glob(os.path.join(p, "spans.jsonl"))))
+        else:
+            out.append(p)
+    return out
+
+
+def _parse_log(path: str, phases: dict[str, list[float]]) -> None:
+    with open(path, "r", encoding="utf-8", errors="replace") as fh:
+        for line in fh:
+            m = BREAKDOWN_RE.search(line)
+            if m:
+                phases.setdefault(m.group(1), []).append(float(m.group(2)))
+
+
+def _parse_spans(path: str, phases: dict[str, list[float]]) -> None:
+    with open(path, "r", encoding="utf-8", errors="replace") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue  # torn tail of a live dump file
+            name = row.get("name")
+            dur = row.get("duration_s")
+            if name is not None and dur is not None:
+                phases.setdefault(name, []).append(float(dur))
+
+
+def collect(paths) -> dict[str, list[float]]:
+    """Phase name -> observed durations (seconds), merged from every
+    log file and span dump in ``paths`` (dirs are expanded)."""
+    phases: dict[str, list[float]] = {}
+    for path in _expand(paths):
+        try:
+            if path.endswith(".jsonl"):
+                _parse_spans(path, phases)
+            else:
+                _parse_log(path, phases)
+        except OSError as e:
+            print(f"breakdown_report: skipping {path}: {e}",
+                  file=sys.stderr)
+    return phases
+
+
+def render(phases: dict[str, list[float]]) -> str:
+    header = (f"{'phase':<28} {'count':>7} {'mean_ms':>10} "
+              f"{'p50_ms':>10} {'p99_ms':>10} {'max_ms':>10}")
+    lines = [header, "-" * len(header)]
+    for name in sorted(phases):
+        vals = sorted(phases[name])
+        ms = [v * 1e3 for v in vals]
+        lines.append(
+            f"{name:<28} {len(ms):>7} {sum(ms) / len(ms):>10.3f} "
+            f"{percentile(ms, 50):>10.3f} {percentile(ms, 99):>10.3f} "
+            f"{ms[-1]:>10.3f}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(__doc__)
+        return 2
+    phases = collect(argv)
+    if not phases:
+        print("no [Breakdown] lines or span rows found", file=sys.stderr)
+        return 1
+    print(render(phases))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,134 @@
+"""Observability smoke tests for tier-1.
+
+Scrapes ``GET /metrics`` over a real HTTP socket and asserts the
+verifier histograms are populated after one device batch, plus the
+logging-first lint: no bare ``print(`` in ``eges_tpu/`` outside CLI
+entry points.
+"""
+
+import asyncio
+import json
+import os
+import re
+import socket
+import threading
+
+import numpy as np
+
+from eges_tpu.core.chain import BlockChain, make_genesis
+from eges_tpu.rpc.server import RpcServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _start_rpc(chain):
+    ready = threading.Event()
+    box = {}
+
+    def serve():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        box["loop"] = loop
+        rpc = RpcServer(chain, port=0)
+        loop.run_until_complete(rpc.start())
+        box["port"] = rpc._server.sockets[0].getsockname()[1]
+        ready.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    assert ready.wait(10)
+    return box
+
+
+def _http(port: int, request: bytes) -> bytes:
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    s.settimeout(10)
+    s.sendall(request)
+    resp = b""
+    while b"\r\n\r\n" not in resp:
+        resp += s.recv(65536)
+    head, _, body = resp.partition(b"\r\n\r\n")
+    m = re.search(rb"Content-Length: (\d+)", head)
+    want = int(m.group(1)) if m else 0
+    while len(body) < want:
+        body += s.recv(65536)
+    s.close()
+    return head + b"\r\n\r\n" + body
+
+
+def test_metrics_endpoint_serves_verifier_histograms():
+    from eges_tpu.crypto.verifier import BatchVerifier
+
+    # one real device batch populates the verifier histogram families
+    # (single-device facade: the mesh path needs jax.shard_map, broken
+    # on this jax version — see test_ring_parallel)
+    v = BatchVerifier()
+    v.ecrecover(np.zeros((1, 65), np.uint8), np.zeros((1, 32), np.uint8))
+
+    chain = BlockChain(genesis=make_genesis())
+    box = _start_rpc(chain)
+    resp = _http(box["port"],
+                 b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+    head, _, body = resp.partition(b"\r\n\r\n")
+    assert head.startswith(b"HTTP/1.1 200 OK")
+    assert b"text/plain; version=0.0.4" in head
+    text = body.decode()
+    for q in ("0.5", "0.95", "0.99"):
+        assert f'verifier_device_seconds{{quantile="{q}"}}' in text
+    assert re.search(r'verifier_device_seconds_count \d+', text)
+    assert re.search(
+        r'verifier_device_seconds\{bucket="\d+",quantile="0\.99"\}', text)
+    assert "verifier_h2d_seconds" in text
+    assert "verifier_pad_waste" in text
+    # unknown GET paths 404 without wedging the keep-alive loop
+    resp = _http(box["port"], b"GET /nope HTTP/1.1\r\nHost: x\r\n\r\n")
+    assert resp.startswith(b"HTTP/1.1 404")
+    # JSON-RPC POST still works on the same port, and thw_traces answers
+    payload = json.dumps({"jsonrpc": "2.0", "id": 1,
+                          "method": "thw_traces", "params": [8]}).encode()
+    resp = _http(box["port"],
+                 b"POST / HTTP/1.1\r\nHost: x\r\n"
+                 b"Content-Length: %d\r\n\r\n" % len(payload) + payload)
+    out = json.loads(resp.partition(b"\r\n\r\n")[2])
+    assert "result" in out and isinstance(out["result"], list)
+    box["loop"].call_soon_threadsafe(box["loop"].stop)
+
+
+def test_thw_metrics_carries_tracing_and_percentiles():
+    chain = BlockChain(genesis=make_genesis())
+    rpc = RpcServer(chain)
+    out = rpc.dispatch("thw_metrics", [])
+    assert set(out["tracing"]) == {"started", "buffered", "dropped",
+                                   "capacity"}
+    dev = out.get("verifier.device_seconds")
+    if dev is not None:  # populated when the verifier test ran first
+        assert {"p50", "p95", "p99"} <= set(dev)
+
+
+# CLI entry points may print; library code must log (SURVEY §5
+# "observability is logging-first").  multihost's dryrun prints are
+# grepped by the multi-process harness driving it.
+PRINT_ALLOWED = ("__main__.py", os.path.join("parallel", "multihost.py"))
+
+BARE_PRINT = re.compile(r"^\s*print\(")
+
+
+def test_no_bare_print_in_library_code():
+    offenders = []
+    pkg = os.path.join(REPO, "eges_tpu")
+    for root, _dirs, files in os.walk(pkg):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            rel = os.path.relpath(path, pkg)
+            if rel.endswith(PRINT_ALLOWED):
+                continue
+            with open(path, "r", encoding="utf-8") as fh:
+                for i, line in enumerate(fh, 1):
+                    if BARE_PRINT.match(line):
+                        offenders.append(f"{rel}:{i}")
+    assert not offenders, (
+        "bare print( in library code (use eges_tpu.utils.log): "
+        + ", ".join(offenders))
