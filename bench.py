@@ -46,6 +46,55 @@ REF_CLASS_CPU_PER_S = 16_000.0  # mid of 12-20k/s/core (BASELINE.md)
 DEFAULT_BUDGET_S = 420.0
 
 
+def _git_rev() -> str | None:
+    """Current commit hash straight from ``.git`` (no subprocess — the
+    bench parent stays import-light and a missing git binary must not
+    fail a measurement)."""
+    try:
+        head = os.path.join(_REPO, ".git", "HEAD")
+        with open(head, "r", encoding="utf-8") as fh:
+            ref = fh.read().strip()
+        if ref.startswith("ref: "):
+            with open(os.path.join(_REPO, ".git", *ref[5:].split("/")),
+                      "r", encoding="utf-8") as fh:
+                return fh.read().strip()[:40] or None
+        return ref[:40] or None
+    except OSError:
+        return None
+
+
+def _provenance() -> dict:
+    """Stamp fields for every bench line: platform, git revision, and a
+    CALLER-SUPPLIED timestamp (``--timestamp=<v>`` or BENCH_TIMESTAMP
+    env — never ambient wall-clock, so re-running a recorded bench
+    reproduces the line byte-for-byte)."""
+    import platform as _platform
+
+    ts = os.environ.get("BENCH_TIMESTAMP")
+    for a in sys.argv[1:]:
+        if a.startswith("--timestamp="):
+            ts = a[len("--timestamp="):]
+    out = {"platform": "%s-%s" % (sys.platform, _platform.machine()),
+           "git_rev": _git_rev()}
+    if ts is not None:
+        out["timestamp"] = ts
+    return out
+
+
+def _append_history(line: dict) -> None:
+    """Append the round's final line to ``harness/bench_history.jsonl``
+    (BENCH_HISTORY overrides the path) — the series
+    ``harness/check_regression.py`` gates on."""
+    path = os.environ.get(
+        "BENCH_HISTORY", os.path.join(_REPO, "harness",
+                                      "bench_history.jsonl"))
+    try:
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(line, sort_keys=True) + "\n")
+    except OSError:
+        pass  # an unwritable history file must not fail the bench
+
+
 # ---------------------------------------------------------------------------
 # child: runs on one backend, emits "RESULT {...}" lines per stage
 # ---------------------------------------------------------------------------
@@ -339,6 +388,7 @@ def main() -> None:
             "cpu_baseline_ref_class_per_s": REF_CLASS_CPU_PER_S,
             "elapsed_s": round(time.monotonic() - t_start, 1),
         }
+        out.update(_provenance())
         if probe_state:
             out["tpu_probe"] = dict(probe_state)
         if "tpu" not in best:
@@ -475,7 +525,7 @@ def main() -> None:
     if printed[0] == 0:
         # nothing measured anywhere: still print a parseable line so the
         # driver records the failure mode instead of a timeout
-        print(json.dumps({
+        fail = {
             "metric": "secp256k1_ecrecover_verifies_per_sec_per_chip",
             "value": 0.0, "unit": "verifies/s", "vs_baseline": 0.0,
             "error": "no backend produced a result within budget",
@@ -483,9 +533,15 @@ def main() -> None:
             "watcher_tpu_capture": _watcher_capture(),
             "cpu_baseline_measured_per_s":
                 round(measured, 1) if measured else None,
-        }), flush=True)
+        }
+        fail.update(_provenance())
+        print(json.dumps(fail), flush=True)
+        _append_history(fail)
     else:
         flush_line()
+        final = compose()
+        if final:
+            _append_history(final)
 
 
 if __name__ == "__main__":

@@ -67,6 +67,20 @@ class Membership:
         self._members: dict[bytes, Member] = {}
         self._sorted_addrs: list[bytes] = []
         self._flat: bytes | None = None  # packed sorted addrs (native path)
+        # owning GeecNode attaches its event journal (utils/journal.py)
+        # so the TTL economy shows up in the consensus observatory
+        self.journal = None
+
+    def _record(self, type: str, **attrs) -> None:
+        if self.journal is not None:
+            self.journal.record(type, **attrs)
+
+    def _update_gauges(self) -> None:
+        from eges_tpu.utils import metrics
+
+        metrics.DEFAULT.gauge("membership.size").set(len(self._members))
+        min_ttl = min((m.ttl for m in self._members.values()), default=0)
+        metrics.DEFAULT.gauge("membership.min_ttl").set(min_ttl)
 
     # -- registry ---------------------------------------------------------
 
@@ -91,16 +105,23 @@ class Membership:
             existing.ttl = min(existing.ttl + member.ttl, self.max_ttl)
             existing.ip = member.ip or existing.ip
             existing.port = member.port or existing.port
+            self._record("member_renewed", addr=member.addr.hex()[:8],
+                         ttl=existing.ttl)
+            self._update_gauges()
             return
         self._members[member.addr] = member
         bisect.insort(self._sorted_addrs, member.addr)
         self._flat = None
+        self._record("member_registered", addr=member.addr.hex()[:8],
+                     ttl=member.ttl, joined_block=member.joined_block)
+        self._update_gauges()
 
     def remove(self, addr: bytes) -> None:
         if addr in self._members:
             del self._members[addr]
             self._sorted_addrs.remove(addr)
             self._flat = None
+            self._update_gauges()
 
     # -- windows ----------------------------------------------------------
 
@@ -179,6 +200,7 @@ class Membership:
             m = self._members.get(addr)
             if m is not None:
                 m.ttl = min(m.ttl + self.bonus_ttl, self.max_ttl)
+        self._update_gauges()
 
     def decay(self) -> list[bytes]:
         """Periodic TTL decay + eviction; returns evicted addresses.
@@ -189,8 +211,10 @@ class Membership:
             if m.ttl <= self.ttl_interval:
                 self.remove(addr)
                 evicted.append(addr)
+                self._record("member_expired", addr=addr.hex()[:8])
             else:
                 m.ttl -= self.ttl_interval
+        self._update_gauges()
         return evicted
 
     def needs_renewal(self, addr: bytes) -> bool:

@@ -85,6 +85,18 @@ class GeecNode:
         self.coinbase = node_cfg.coinbase
         self._log = log or (lambda *a, **k: None)
 
+        # structured protocol event journal (utils/journal.py): one per
+        # node, virtual-time aware, shared with this node's chain /
+        # membership / txpool so every control-plane decision lands in
+        # one replayable stream
+        from eges_tpu.utils.journal import Journal
+        self.journal = Journal(node=self.coinbase.hex()[:8],
+                               clock=clock.now)
+        self.elections_won = 0
+        self.elections_lost = 0
+        self._last_commit_t = clock.now()
+        chain.journal = self.journal
+
         # signed-vote mode (ChainGeecConfig.signed_votes): every election
         # vote / ACK / query reply / confirm carries a secp256k1 signature
         # and quorum tallies run through the device batch verifier —
@@ -96,6 +108,7 @@ class GeecNode:
         tp = ttl_params(node_cfg.total_nodes)
         self.membership = Membership(node_cfg.n_candidates,
                                      node_cfg.n_acceptors, **tp)
+        self.membership.journal = self.journal
         # genesis bootstrap membership (ref: geec_state.go:275-289)
         for bn in chain_cfg.bootstrap:
             self.membership.add(Member(addr=bn.account, ip=bn.ip, port=bn.port,
@@ -133,6 +146,7 @@ class GeecNode:
         self._snap_cache: tuple | None = None  # serving-side page cache
         self.geec_txn_sink = None  # app-layer callback for confirmed geec txns
         self.txpool = None  # optional TxPool; proposals drain it
+        #                     (property: attaching one wires the journal)
 
         # deferred messages for future working blocks (Wait() analogue)
         self._deferred: list[tuple[int, object]] = []  # (blk_num, thunk)
@@ -152,9 +166,13 @@ class GeecNode:
 
         chain.add_listener(self._on_new_block)
         # restart path: rebuild membership/trust-rand/working-block state
-        # from the durable chain (blocks already canonical are final here)
+        # from the durable chain (blocks already canonical are final here;
+        # the journal stays quiet — replayed history is not live protocol
+        # activity and would double-count in the observatory)
+        self.journal.enabled = False
         for n in range(1, chain.height() + 1):
             self._ingest_block(chain.get_block_by_number(n), replay=True)
+        self.journal.enabled = True
         self.max_confirmed_block = chain.height()
         if self.coinbase in self.membership:
             self.registered = True
@@ -240,6 +258,26 @@ class GeecNode:
         if self.cfg.breakdown:
             self._log("breakdown", phase=phase, dt=dt, **kw)
 
+    def _bump_version(self, version: int) -> None:
+        """Single funnel for version bumps so the journal sees every
+        failed round (the observatory's failed-round rate counts
+        these).  version 0 is the normal first attempt of a block, not
+        a failed round — it stays out of the journal."""
+        self.wb.bump_version(version)
+        if version > 0:
+            self.journal.record("version_bump", blk=self.wb.blk_num,
+                                version=version)
+
+    @property
+    def txpool(self):
+        return self._txpool
+
+    @txpool.setter
+    def txpool(self, pool) -> None:
+        self._txpool = pool
+        if pool is not None:
+            pool.event_journal = self.journal
+
     # ------------------------------------------------------------------
     # inbound dispatch
     # ------------------------------------------------------------------
@@ -314,11 +352,18 @@ class GeecNode:
     # defer a thunk until the working block reaches ``blk`` (Wait analogue)
     def _defer(self, blk: int, thunk) -> None:
         self._deferred.append((blk, thunk))
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+        metrics.gauge("consensus.deferred_depth").set(len(self._deferred))
 
     def _drain_deferred(self) -> None:
         ready = [(b, t) for (b, t) in self._deferred if b <= self.wb.blk_num]
         self._deferred = [(b, t) for (b, t) in self._deferred
                           if b > self.wb.blk_num]
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+        metrics.gauge("consensus.deferred_depth").set(len(self._deferred))
+        if ready:
+            self.journal.record("deferred_drain", blk=self.wb.blk_num,
+                                drained=len(ready))
         for b, t in ready:
             if b == self.wb.blk_num:
                 t()
@@ -369,7 +414,7 @@ class GeecNode:
         seed = self.seed_for(blk_num)
         committee = self.membership.committee(seed, version)
         if version > wb.max_version:
-            wb.bump_version(version)
+            self._bump_version(version)
         elif wb.elect_state == ELEC_VOTED:
             return  # already voted on this version (election_go.go:56-59)
         wb.n_candidates = len(committee)
@@ -377,6 +422,9 @@ class GeecNode:
         self._phase = ELECTING
         self._proposal_version = version
         self._elect_t = self.clock.now()
+        self.journal.record("election_started", blk=blk_num, version=version,
+                            committee=len(committee),
+                            threshold=wb.election_threshold)
         self._election_retry(blk_num, version, committee, retry=0)
 
     def _election_retry(self, blk_num: int, version: int, committee,
@@ -429,8 +477,12 @@ class GeecNode:
         wb.is_proposer = True
         wb.validate_threshold = self.membership.validate_threshold()
         self._cancel_timer("election")
-        self._breakdown("election", self.clock.now() - self._elect_t,
-                        blk=wb.blk_num)
+        dt = self.clock.now() - self._elect_t
+        self._breakdown("election", dt, blk=wb.blk_num)
+        self.elections_won += 1
+        self.journal.record("election_won", blk=wb.blk_num,
+                            version=self._proposal_version, dt=dt,
+                            votes=len(wb.supporters))
         if self._proposal_version > 0:
             # recovered leader: query what happened first
             self._start_query(wb.blk_num, self._proposal_version)
@@ -489,6 +541,9 @@ class GeecNode:
             self._abort_proposal()
             return
         self._proposal = self._build_proposal(blk_num)
+        self.journal.record("proposal_built", blk=blk_num, version=version,
+                            txns=len(self._proposal.transactions),
+                            geec_txns=len(self._proposal.geec_txns))
         req = M.ValidateRequest(
             block_num=blk_num, author=self.coinbase, block=self._proposal,
             ip=self.cfg.consensus_ip, port=self.cfg.consensus_port,
@@ -507,11 +562,17 @@ class GeecNode:
         self.wb.validate_cert = {}
         self.wb.validate_succeeded = False
         self._ack_t = self.clock.now()
+        self.journal.record("validate_request", blk=req.block_num,
+                            version=req.version,
+                            threshold=self.wb.validate_threshold)
         self._validate_retry(req.block_num, req.version, 0)
 
     def _validate_retry(self, blk_num: int, version: int, retry: int) -> None:
         if blk_num != self.wb.blk_num or self._phase != VALIDATING:
             return
+        if retry > 0:
+            self.journal.record("validate_retry", blk=blk_num,
+                                version=version, retry=retry)
         req = dataclasses.replace(self._validate_req, retry=retry)
         self.transport.gossip(M.pack_gossip(M.GOSSIP_VALIDATE_REQ, req))
         self._set_timer("validate", self.ccfg.validate_timeout_ms / 1e3,
@@ -564,8 +625,10 @@ class GeecNode:
                 wb.validate_cert = cert
             wb.validate_succeeded = True
             self._cancel_timer("validate")
-            self._breakdown("ack", self.clock.now() - self._ack_t,
-                            blk=wb.blk_num)
+            dt = self.clock.now() - self._ack_t
+            self._breakdown("ack", dt, blk=wb.blk_num)
+            self.journal.record("validate_quorum", blk=wb.blk_num, dt=dt,
+                                acks=len(wb.validate_replies))
             self._phase = BACKOFF
             supporters = tuple(wb.validate_replies.keys())
             self._set_timer("backoff", self.ccfg.backoff_time_ms / 1e3,
@@ -601,6 +664,11 @@ class GeecNode:
         self.transport.gossip(M.pack_gossip(M.GOSSIP_CONFIRM_BLOCK, confirm))
 
     def _abort_proposal(self) -> None:
+        if self._phase != IDLE:
+            # only a live proposal attempt journals an abort — the
+            # belt-and-braces calls on every block ingest would be noise
+            self.journal.record("proposal_aborted", blk=self.wb.blk_num,
+                                phase=self._phase)
         self._phase = IDLE
         self._proposal = None
         drained = getattr(self, "_proposal_geec_txns", None)
@@ -640,7 +708,7 @@ class GeecNode:
                                                     em.version)):
             return
         if wb.max_version < em.version:
-            wb.bump_version(em.version)
+            self._bump_version(em.version)
             if self._phase in (ELECTING, VALIDATING):
                 self._abort_proposal()
 
@@ -659,6 +727,11 @@ class GeecNode:
                 wb.delegator_ip = em.ip
                 wb.delegator_port = em.port
                 if self._phase == ELECTING:
+                    # we were campaigning and a larger rand beat us
+                    self.elections_lost += 1
+                    self.journal.record("election_lost", blk=em.block_num,
+                                        version=em.version,
+                                        winner=em.author.hex()[:8])
                     self._abort_proposal()
                 self._vote(em.block_num, em.ip, em.port, em.version)
             elif wb.elect_state == ELEC_VOTED:
@@ -701,9 +774,13 @@ class GeecNode:
         entry = (em.signing_hash(), em.sig)
         if len(lst) < 2 and entry not in lst:
             lst.append(entry)
+            self.journal.record("vote_stashed", blk=em.block_num,
+                                version=em.version,
+                                voter=em.author.hex()[:8])
 
     def _vote(self, blk_num: int, ip: str, port: int, version: int) -> None:
         """(ref: vote election_go.go:312-340)"""
+        self.journal.record("vote_cast", blk=blk_num, version=version)
         reply = M.ElectMessage(code=M.MSG_VOTE, block_num=blk_num,
                                author=self.coinbase, version=version,
                                ip=self.cfg.consensus_ip,
@@ -743,7 +820,7 @@ class GeecNode:
         if not self._verify_single(req.signing_hash(), req.sig, req.author):
             return
         if req.version > wb.max_version:
-            wb.bump_version(req.version)
+            self._bump_version(req.version)
         if req.retry <= wb.max_validate_retry:
             return  # already relayed/answered this retry round
         # gossip-relay with dedup (handler.go:1025-1037)
@@ -757,7 +834,11 @@ class GeecNode:
         accepted = self._validate_block(req.block)
         if not accepted:
             self._log("reject", blk=req.block_num)
+            self.journal.record("validate_reply", blk=req.block_num,
+                                version=req.version, accepted=False)
             return
+        self.journal.record("validate_reply", blk=req.block_num,
+                            version=req.version, accepted=True)
         fills = []
         for n in req.empty_list:  # backfill requested empties
             b = self.chain.get_block_by_number(n)
@@ -841,6 +922,9 @@ class GeecNode:
             for n in sorted(chained):
                 self.chain.offer(chained[n].with_confirm(confirm))
         self.max_confirmed_block = confirm.block_number
+        self.journal.record("block_confirmed", blk=confirm.block_number,
+                            empty=confirm.empty_block,
+                            confidence=confirm.confidence)
         # unconditional re-broadcast; loop broken by max_confirmed gate
         self.transport.gossip(M.pack_gossip(M.GOSSIP_CONFIRM_BLOCK, confirm))
         behind = self.chain.height() < confirm.block_number
@@ -1616,6 +1700,8 @@ class GeecNode:
             if blk.number not in self.empty_block_list:
                 self.empty_block_list.append(blk.number)
         self.unconfirmed.append(blk)
+        if not replay:
+            self._last_commit_t = self.clock.now()
         confidence = blk.confirm.confidence if blk.confirm else 0
         if confidence > CONFIDENCE_THRESHOLD:
             self._handle_confirmed_tail(blk)
@@ -1895,7 +1981,7 @@ class GeecNode:
         if query.version < wb.max_version:
             return
         if query.version > wb.max_version:
-            wb.bump_version(query.version)
+            self._bump_version(query.version)
             if self._phase in (ELECTING, VALIDATING):
                 self._abort_proposal()
         if query.retry <= wb.max_query_retry:

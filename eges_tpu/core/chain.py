@@ -273,6 +273,8 @@ class BlockChain:
         # funnel — insertion tries every candidate when the height opens
         self._future: dict[int, list[Block]] = {}
         self.bad_blocks = 0
+        # owning GeecNode attaches its event journal (utils/journal.py)
+        self.journal = None
         self.last_error: str | None = None
         self.alloc = dict(alloc or {})
         # state snapshots + receipts per canonical block hash (L3)
@@ -704,6 +706,10 @@ class BlockChain:
         metrics.gauge("chain.height").set(block.number)
         tracing.DEFAULT.record_span("chain.insert", dt, number=block.number,
                                     txns=len(block.transactions))
+        if self.journal is not None:
+            self.journal.record("block_committed", blk=block.number,
+                                txns=len(block.transactions),
+                                dt=round(dt, 6))
         for fn in self._listeners:
             fn(block)
 

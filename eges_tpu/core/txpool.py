@@ -56,6 +56,15 @@ class TxPool:
         self.owner = ""  # identifies this pool's node in span attrs
         self._ingest_ctx: dict[bytes, tracing.SpanContext] = {}
         self._INGEST_CTX_CAP = 8192
+        # consensus event journal (utils/journal.py), attached by the
+        # owning GeecNode; distinct from the RLP txn journal above
+        self.event_journal = None
+        self._depth_gauge()  # register txpool.pending at 0
+
+    def _depth_gauge(self) -> None:
+        from eges_tpu.utils import metrics
+
+        metrics.DEFAULT.gauge("txpool.pending").set(len(self._by_hash))
 
     # -- ingest -----------------------------------------------------------
 
@@ -164,6 +173,7 @@ class TxPool:
         self._by_hash[t.hash] = (sender, t.nonce)
         self._maybe_compact()
         self.stats["admitted"] += 1
+        self._depth_gauge()
         sp.set_attr("outcome", "admitted")
         if self.on_admitted is not None:
             # still inside the admit span: a broadcast hook fired here
@@ -251,6 +261,7 @@ class TxPool:
             self._dead.add(t.hash)
             self._ingest_ctx.pop(t.hash, None)
         self._maybe_compact()
+        self._depth_gauge()
 
     def remove_included(self, txns, block: int | None = None) -> None:
         """Drop txns included in a canonical block; closes each txn's
@@ -264,6 +275,9 @@ class TxPool:
                     tx=t.hash.hex()[:16],
                     **({"block": block} if block is not None else {}))
         self._evict(txns)
+        if self.event_journal is not None and txns:
+            self.event_journal.record("txns_included", blk=block,
+                                      count=len(txns))
         if (self.journal_path and
                 self._journal_count > max(64, 4 * len(self._by_hash))):
             self._rotate_journal()

@@ -396,6 +396,12 @@ class GossipPlane:
         self._closed = False
         self.auth_failures = 0
         self.peer_drops = 0       # misbehavior disconnects
+        self._peer_gauge()  # register net.peer_count at 0
+
+    def _peer_gauge(self) -> None:
+        from eges_tpu.utils import metrics
+
+        metrics.DEFAULT.gauge("net.peer_count").set(len(self._writers))
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -426,6 +432,7 @@ class GossipPlane:
             return
         self.peers.remove(peer)
         sess = self._writers.pop(peer, None)
+        self._peer_gauge()
         if sess is not None:
             try:
                 sess.writer.close()
@@ -563,6 +570,7 @@ class GossipPlane:
                     rejected = True
                     raise ConnectionError
                 self._writers[peer] = sess
+                self._peer_gauge()
                 t0 = time.monotonic()
                 try:
                     # hold the connection, reading the acceptor's side
@@ -597,6 +605,7 @@ class GossipPlane:
             except (ConnectionError, OSError, asyncio.TimeoutError):
                 pass
             self._writers.pop(peer, None)
+            self._peer_gauge()
             if held is not None and held >= 2.0:
                 backoff, quick_closes = 0.2, 0  # was a real connection
             elif held is not None:
@@ -637,6 +646,7 @@ class GossipPlane:
                 sess.writer.write(self._frame(payload))
             except Exception:
                 self._writers.pop(peer, None)
+                self._peer_gauge()
 
     def close(self) -> None:
         self._closed = True

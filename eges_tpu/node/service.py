@@ -328,6 +328,13 @@ class NodeService:
                         os.path.join(self.cfg.datadir, "spans.jsonl"))
                 except OSError:
                     pass
+                # same drain pattern for the consensus event journal:
+                # per-node journal.jsonl feeds observatory.py --replay
+                try:
+                    self.node.journal.dump(
+                        os.path.join(self.cfg.datadir, "journal.jsonl"))
+                except OSError:
+                    pass
             await asyncio.sleep(0.5)
 
     async def run_forever(self) -> None:
@@ -342,6 +349,11 @@ class NodeService:
         try:
             tracing.DEFAULT.dump(
                 os.path.join(self.cfg.datadir, "spans.jsonl"))
+        except OSError:
+            pass
+        try:
+            self.node.journal.dump(
+                os.path.join(self.cfg.datadir, "journal.jsonl"))
         except OSError:
             pass
         if self.discovery is not None:

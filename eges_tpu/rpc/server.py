@@ -18,8 +18,8 @@ Methods:
   eth_getFilterChanges, eth_uninstallFilter, eth_sendRawTransaction,
   net_version, web3_clientVersion,
   thw_register, thw_membership, thw_status, thw_pendingGeecTxns,
-  thw_metrics, thw_traces, debug_startProfile, debug_stopProfile,
-  debug_stacks, debug_stats
+  thw_metrics, thw_traces, thw_health, thw_journal,
+  debug_startProfile, debug_stopProfile, debug_stacks, debug_stats
 
 Plain HTTP ``GET /metrics`` on the same port serves the whole metrics
 registry in Prometheus text exposition format (the pull-based analogue
@@ -290,8 +290,11 @@ class RpcServer:
             out["tracing"] = tracing.DEFAULT.stats()
             return out
         if method == "thw_traces":
-            # finished spans from the in-process ring buffer; params:
-            # [] | [limit] | [{"limit": n, "trace": "<32-hex id>"}]
+            # finished spans from the in-process ring buffer, NEWEST
+            # FIRST; params: [] | [limit] | [{"limit": n,
+            # "trace": "<32-hex id>"}].  ``limit`` is clamped to
+            # [1, 4096] so a long-running node can never ship its whole
+            # span ring in one JSON-RPC reply.
             from eges_tpu.utils import tracing
             limit, trace = 256, None
             if params:
@@ -301,10 +304,77 @@ class RpcServer:
                     trace = p.get("trace")
                 else:
                     limit = int(p)
-            return tracing.DEFAULT.finished(limit=limit, trace=trace)
+            limit = max(1, min(limit, 4096))
+            spans = tracing.DEFAULT.finished(limit=limit, trace=trace)
+            spans.reverse()
+            return spans
+        if method == "thw_health":
+            return self._health()
+        if method == "thw_journal":
+            # consensus event journal, chronological; params:
+            # [] | [limit] | [{"limit": n, "since": seq}]
+            if self.node is None:
+                raise RpcError(-32000, "no consensus node")
+            limit, since = 1024, 0
+            if params:
+                p = params[0]
+                if isinstance(p, dict):
+                    limit = int(p.get("limit", limit))
+                    since = int(p.get("since", since))
+                else:
+                    limit = int(p)
+            limit = max(1, min(limit, 8192))
+            return self.node.journal.events(limit=limit, since=since)
         if method.startswith("debug_"):
             return self._debug(method, params)
         raise RpcError(-32601, f"method {method} not found")
+
+    # -- node health (thw_health) -----------------------------------------
+
+    def _health(self) -> dict:
+        """One-call cluster-operator snapshot: chain head + confirm lag,
+        the node's current consensus role, election win/loss tallies,
+        queue depths, membership economy, and a stall flag (no commit
+        for 3 block timeouts).  ``harness/observatory.py`` polls this on
+        every node; keys here are the documented contract its tests
+        assert."""
+        node = self.node
+        if node is None:
+            raise RpcError(-32000, "no consensus node")
+        height = self.chain.height()
+        blk_num = node.wb.blk_num
+        # role: what this node is for the CURRENT working block
+        from eges_tpu.consensus.node import BACKOFF, ELECTING, VALIDATING
+        if node._phase == ELECTING:
+            role = "electing"
+        elif node._phase in (VALIDATING, BACKOFF):
+            role = "sealing"
+        elif not node.registered or node.coinbase not in node.membership:
+            role = "observer"
+        elif node.is_committee(blk_num, node.wb.max_version):
+            role = "committee"
+        elif node.is_acceptor(blk_num):
+            role = "acceptor"
+        else:
+            role = "follower"
+        members = node.membership.members()
+        last_commit_age = node.clock.now() - node._last_commit_t
+        return {
+            "height": height,
+            "headHash": "0x" + self.chain.head().hash.hex(),
+            "lag": max(0, node.max_confirmed_block - height),
+            "role": role,
+            "electionsWon": node.elections_won,
+            "electionsLost": node.elections_lost,
+            "txpoolPending": len(self.txpool) if self.txpool is not None
+            else 0,
+            "deferredDepth": len(node._deferred),
+            "members": len(members),
+            "minTtl": min((m.ttl for m in members), default=0),
+            "lastCommitAge": round(last_commit_age, 6),
+            "stalled": last_commit_age > 3 * node.cfg.block_timeout_s,
+            "journal": node.journal.stats(),
+        }
 
     # -- read-only EVM execution (ref: internal/ethapi/api.go Call) -------
 

@@ -112,3 +112,9 @@ class SimCluster:
 
     def min_height(self) -> int:
         return min(self.heights())
+
+    def journals(self) -> dict[str, list[dict]]:
+        """Per-node consensus event journals, keyed by sim node name —
+        the live-poll source ``harness/observatory.py`` merges (the
+        RPC-less analogue of hitting ``thw_journal`` on every node)."""
+        return {sn.name: sn.node.journal.events() for sn in self.nodes}

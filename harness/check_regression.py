@@ -1,0 +1,83 @@
+"""Bench regression gate over ``harness/bench_history.jsonl``.
+
+Each ``bench.py`` round appends its final JSON line to the history
+file.  This gate compares the newest entry's primary metric
+(``value``, verifies/s/chip) against the previous entry and exits
+non-zero when it dropped more than the threshold (default 20%) — the
+CI tripwire for perf regressions that unit tests can't see.
+
+Exit codes: 0 ok (or fewer than two comparable entries), 1 regression,
+2 unreadable history.
+
+Usage::
+
+    python harness/check_regression.py [history.jsonl] [--threshold 0.2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_DEFAULT_HISTORY = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "bench_history.jsonl")
+
+
+def load_history(path: str) -> list[dict]:
+    """Entries with a numeric primary metric, oldest first; torn or
+    non-JSON lines are skipped (same tolerance as journal.load)."""
+    out = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and isinstance(
+                    obj.get("value"), (int, float)):
+                out.append(obj)
+    return out
+
+
+def check(entries: list[dict], threshold: float = 0.20) -> tuple[int, str]:
+    """(exit_code, message) for the newest-vs-previous comparison."""
+    if len(entries) < 2:
+        return 0, "ok: %d comparable entr%s — nothing to compare" % (
+            len(entries), "y" if len(entries) == 1 else "ies")
+    prev, last = entries[-2], entries[-1]
+    pv, lv = float(prev["value"]), float(last["value"])
+    if pv <= 0:
+        return 0, "ok: previous value %.1f is not a usable baseline" % pv
+    drop = (pv - lv) / pv
+    detail = "%.1f -> %.1f %s (%+.1f%%)" % (
+        pv, lv, last.get("unit", ""), -drop * 100.0)
+    if drop > threshold:
+        return 1, "REGRESSION: %s exceeds the %.0f%% threshold" % (
+            detail, threshold * 100.0)
+    return 0, "ok: %s within the %.0f%% threshold" % (
+        detail, threshold * 100.0)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("history", nargs="?", default=_DEFAULT_HISTORY)
+    ap.add_argument("--threshold", type=float, default=0.20,
+                    help="fractional drop that fails the gate")
+    args = ap.parse_args(argv)
+    try:
+        entries = load_history(args.history)
+    except OSError as e:
+        print("cannot read %s: %s" % (args.history, e), file=sys.stderr)
+        return 2
+    code, msg = check(entries, args.threshold)
+    print(msg)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

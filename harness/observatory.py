@@ -1,0 +1,266 @@
+"""Consensus observatory: merge per-node event journals into one
+cluster report.
+
+The cluster-wide analogue of the reference's ``grep.py`` post-mortem
+workflow (scraping "Geec: ..." election log lines out of N geth logs):
+every node's consensus event journal (``eges_tpu/utils/journal.py``)
+is collected — live from a sim cluster, or offline from the
+``journal.jsonl`` dumps a real node writes to its datadir — and merged
+into one summary:
+
+- per-block election timeline (started/won/lost/version-bump, in time
+  order across all nodes),
+- vote-quorum latency percentiles (election p50/p99, ACK-quorum
+  p50/p99),
+- version-bump (failed-round) rate,
+- per-node commit lag behind the cluster-first commit of each block,
+- stall detection (gaps between consecutive first-commits).
+
+``summarize`` is pure and deterministic over the event dicts, so the
+``--replay`` path (load JSONL dumps) reconstructs the IDENTICAL
+summary the live poll produced — the acceptance criterion this module
+exists for.
+
+Usage::
+
+    python harness/observatory.py --nodes 4 --blocks 8 --dump /tmp/obs
+    python harness/observatory.py --replay /tmp/obs
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from eges_tpu.utils import journal as journal_mod
+from eges_tpu.utils.metrics import percentile
+
+# Event types this report consumes; the lint test asserts this is a
+# subset of journal.EVENT_TYPES so parser and emit sites cannot drift.
+CONSUMED = ("election_started", "election_won", "election_lost",
+            "validate_quorum", "version_bump", "block_committed",
+            "block_confirmed")
+
+_TIMELINE = ("election_started", "election_won", "election_lost",
+             "version_bump")
+
+
+def summarize(by_node: dict[str, list[dict]],
+              stall_gap_s: float = 10.0) -> dict:
+    """Merge per-node journals (name -> event list) into the cluster
+    summary.  Pure and deterministic: sorted iteration everywhere,
+    fixed rounding, no ambient clock — identical input events (live or
+    JSON round-tripped) produce an identical dict."""
+    election_lat: list[float] = []
+    ack_lat: list[float] = []
+    version_bumps = 0
+    # blk -> node -> earliest commit ts
+    commits: dict[int, dict[str, float]] = {}
+    # blk -> [(ts, seq, name, line)]
+    timeline: dict[int, list[tuple]] = {}
+
+    for name in sorted(by_node):
+        for ev in by_node[name]:
+            typ = ev.get("type")
+            blk = ev.get("blk")
+            if typ == "election_won" and "dt" in ev:
+                election_lat.append(float(ev["dt"]))
+            elif typ == "validate_quorum" and "dt" in ev:
+                ack_lat.append(float(ev["dt"]))
+            elif typ == "version_bump":
+                version_bumps += 1
+            elif typ == "block_committed" and blk is not None:
+                per = commits.setdefault(int(blk), {})
+                ts = float(ev["ts"])
+                if name not in per or ts < per[name]:
+                    per[name] = ts
+            if typ in _TIMELINE and blk is not None:
+                if typ == "election_won":
+                    line = "%s won v%s (%d votes)" % (
+                        name, ev.get("version", 0), ev.get("votes", 0))
+                elif typ == "election_lost":
+                    line = "%s lost v%s to %s" % (
+                        name, ev.get("version", 0), ev.get("winner", "?"))
+                elif typ == "version_bump":
+                    line = "%s bumped to v%s" % (name, ev.get("version", 0))
+                else:
+                    line = "%s started v%s (committee %d)" % (
+                        name, ev.get("version", 0), ev.get("committee", 0))
+                timeline.setdefault(int(blk), []).append(
+                    (round(float(ev["ts"]), 6), int(ev.get("seq", 0)),
+                     name, typ, line))
+
+    def _pct(vals: list[float]) -> dict:
+        if not vals:
+            return {"count": 0, "p50_ms": None, "p99_ms": None}
+        s = sorted(vals)
+        return {"count": len(s),
+                "p50_ms": round(percentile(s, 50.0) * 1000.0, 3),
+                "p99_ms": round(percentile(s, 99.0) * 1000.0, 3)}
+
+    # per-node lag behind the cluster-first commit of each block
+    lags: dict[str, list[float]] = {}
+    firsts: list[tuple[int, float]] = []
+    for blk in sorted(commits):
+        per = commits[blk]
+        first = min(per.values())
+        firsts.append((blk, first))
+        for name in sorted(per):
+            lags.setdefault(name, []).append(per[name] - first)
+    commit_lag = {
+        name: {"mean_s": round(sum(v) / len(v), 6),
+               "max_s": round(max(v), 6)}
+        for name, v in sorted(lags.items())}
+
+    # stall detection: gaps between consecutive cluster-first commits
+    stalls = []
+    max_gap = 0.0
+    for (b0, t0), (b1, t1) in zip(firsts, firsts[1:]):
+        gap = t1 - t0
+        max_gap = max(max_gap, gap)
+        if gap > stall_gap_s:
+            stalls.append({"blk": b1, "gap_s": round(gap, 6)})
+
+    return {
+        "nodes": sorted(by_node),
+        "blocks": len(commits),
+        "election": _pct(election_lat),
+        "ack_quorum": _pct(ack_lat),
+        "version_bumps": version_bumps,
+        "version_bump_rate": round(
+            version_bumps / max(1, len(commits)), 4),
+        "election_timeline": {
+            blk: [{"ts": ts, "node": name, "type": typ, "line": line}
+                  for ts, _seq, name, typ, line in sorted(rows)]
+            for blk, rows in sorted(timeline.items())},
+        "commit_lag": commit_lag,
+        "stalls": stalls,
+        "max_commit_gap_s": round(max_gap, 6),
+    }
+
+
+# -- collection -----------------------------------------------------------
+
+def collect_live(cluster) -> dict[str, list[dict]]:
+    """Poll every node of a (sim) cluster for its journal."""
+    return cluster.journals()
+
+
+def dump_journals(by_node: dict[str, list[dict]], outdir: str) -> list[str]:
+    """Write each node's collected events as ``<name>.journal.jsonl``
+    (same row format as a real node's datadir ``journal.jsonl``)."""
+    os.makedirs(outdir, exist_ok=True)
+    paths = []
+    for name in sorted(by_node):
+        path = os.path.join(outdir, f"{name}.journal.jsonl")
+        with open(path, "w", encoding="utf-8") as fh:
+            for ev in by_node[name]:
+                fh.write(json.dumps(ev, sort_keys=True) + "\n")
+        paths.append(path)
+    return paths
+
+
+def load_journals(indir: str) -> dict[str, list[dict]]:
+    """Load dumped journals back: ``<name>.journal.jsonl`` files (our
+    own dumps) and ``<nodedir>/journal.jsonl`` (real-cluster datadirs,
+    node name = directory name)."""
+    by_node: dict[str, list[dict]] = {}
+    for path in sorted(glob.glob(os.path.join(indir, "*.journal.jsonl"))):
+        name = os.path.basename(path)[: -len(".journal.jsonl")]
+        by_node[name] = journal_mod.load(path)
+    for path in sorted(glob.glob(os.path.join(indir, "*", "journal.jsonl"))):
+        name = os.path.basename(os.path.dirname(path))
+        by_node.setdefault(name, []).extend(journal_mod.load(path))
+    return by_node
+
+
+def run_sim(nodes: int = 4, blocks: int = 6, seconds: float = 600.0,
+            seed: int = 0):
+    """Run a virtual-time sim cluster until every node holds ``blocks``
+    blocks; returns the cluster (stopped virtual clock, journals full)."""
+    from eges_tpu.sim.cluster import SimCluster
+
+    cluster = SimCluster(nodes, seed=seed, txn_per_block=5, txpool=True)
+    cluster.start()
+    cluster.run(seconds, stop_condition=lambda: cluster.min_height() >= blocks)
+    return cluster
+
+
+# -- rendering ------------------------------------------------------------
+
+def render(summary: dict) -> str:
+    out = []
+    out.append("consensus observatory — %d node(s), %d block(s)" % (
+        len(summary["nodes"]), summary["blocks"]))
+    e, a = summary["election"], summary["ack_quorum"]
+    out.append("  elections   : %4d  p50 %s ms  p99 %s ms" % (
+        e["count"], e["p50_ms"], e["p99_ms"]))
+    out.append("  ack quorums : %4d  p50 %s ms  p99 %s ms" % (
+        a["count"], a["p50_ms"], a["p99_ms"]))
+    out.append("  version bumps: %d (%.4f per block)" % (
+        summary["version_bumps"], summary["version_bump_rate"]))
+    out.append("  max commit gap: %.3f s; stalls(> threshold): %d" % (
+        summary["max_commit_gap_s"], len(summary["stalls"])))
+    for s in summary["stalls"]:
+        out.append("    STALL before blk %d: %.3f s" % (s["blk"], s["gap_s"]))
+    out.append("  commit lag behind cluster-first:")
+    for name, lag in summary["commit_lag"].items():
+        out.append("    %-8s mean %8.6f s  max %8.6f s" % (
+            name, lag["mean_s"], lag["max_s"]))
+    out.append("  election timeline:")
+    for blk, rows in summary["election_timeline"].items():
+        out.append("    blk %s:" % blk)
+        for r in rows:
+            out.append("      %12.6f  %s" % (r["ts"], r["line"]))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--replay", metavar="DIR", default=None,
+                    help="rebuild the summary offline from dumped "
+                         "journal JSONL instead of running a sim")
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--blocks", type=int, default=6)
+    ap.add_argument("--seconds", type=float, default=600.0,
+                    help="virtual-time budget for the sim run")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--dump", metavar="DIR", default=None,
+                    help="dump collected journals as JSONL for --replay")
+    ap.add_argument("--stall-gap", type=float, default=10.0,
+                    help="first-commit gap (s) that counts as a stall")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object")
+    args = ap.parse_args(argv)
+
+    if args.replay:
+        by_node = load_journals(args.replay)
+        if not by_node:
+            print("no *.journal.jsonl under %s" % args.replay,
+                  file=sys.stderr)
+            return 2
+    else:
+        cluster = run_sim(args.nodes, args.blocks, args.seconds, args.seed)
+        by_node = collect_live(cluster)
+        if args.dump:
+            for p in dump_journals(by_node, args.dump):
+                print("dumped %s" % p, file=sys.stderr)
+
+    summary = summarize(by_node, stall_gap_s=args.stall_gap)
+    try:
+        print(json.dumps(summary, sort_keys=True) if args.json
+              else render(summary))
+    except BrokenPipeError:
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
