@@ -33,6 +33,7 @@ north-star upgrade), and the insert path batch-recovers senders
 from __future__ import annotations
 
 import dataclasses
+import threading
 from dataclasses import dataclass
 
 from eges_tpu.consensus import messages as M
@@ -115,6 +116,16 @@ class GeecNode:
                                        referee=bn.account, joined_block=0,
                                        ttl=tp["initial_ttl"]))
 
+        # One re-entrant monitor guards every mutable consensus field
+        # below.  The state machine is single-threaded on the event
+        # loop, but the RPC server runs its handlers on another thread
+        # and enters through submit_txns / broadcast_txns /
+        # request_registration — every entry point (inbound dispatch,
+        # chain listener, timer fire, RPC surface) takes this lock, so
+        # those two threads serialize.  The attached TxPool shares THIS
+        # lock (see the txpool setter) — one lock domain, no ordering
+        # hazards between pool window flushes and RPC submissions.
+        self._lock = threading.RLock()
         self.wb = WorkingBlock(self.coinbase)
         self.trust_rands: dict[int, int] = {0: 0}
         self.pending_blocks: dict[int, Block] = {}
@@ -225,7 +236,15 @@ class GeecNode:
 
     def _set_timer(self, name: str, delay_s: float, fn) -> None:
         self._cancel_timer(name)
-        self._timers[name] = self.clock.call_later(delay_s, fn)
+
+        def fire():
+            # timer callbacks join the same monitor as the message and
+            # RPC entry points; re-entrancy keeps nested arming from
+            # already-locked regions cheap
+            with self._lock:
+                fn()
+
+        self._timers[name] = self.clock.call_later(delay_s, fire)
 
     def _cancel_timer(self, name: str) -> None:
         h = self._timers.pop(name, None)
@@ -237,15 +256,17 @@ class GeecNode:
     # ------------------------------------------------------------------
 
     def start(self) -> None:
-        self._arm_block_timeout()
-        if self.mine:
-            if not self.registered:
-                self._start_registration(renew=0)
-            self._try_propose()
+        with self._lock:
+            self._arm_block_timeout()
+            if self.mine:
+                if not self.registered:
+                    self._start_registration(renew=0)
+                self._try_propose()
 
     def stop(self) -> None:
-        for name in list(self._timers):
-            self._cancel_timer(name)
+        with self._lock:
+            for name in list(self._timers):
+                self._cancel_timer(name)
 
     def _breakdown(self, phase: str, dt: float, **kw) -> None:
         """One phase timing, three sinks: the legacy ``[Breakdown]`` log
@@ -277,6 +298,13 @@ class GeecNode:
         self._txpool = pool
         if pool is not None:
             pool.event_journal = self.journal
+            # one lock domain for node + pool: the RPC thread holds the
+            # node lock through submit_txns -> add_locals while the
+            # clock thread's window flush re-enters the node through the
+            # on_admitted broadcast hook — two separate locks would be
+            # taken in opposite orders on those paths (deadlock); one
+            # shared re-entrant lock serializes both.
+            pool._lock = self._lock
 
     # ------------------------------------------------------------------
     # inbound dispatch
@@ -284,14 +312,16 @@ class GeecNode:
 
     def on_gossip(self, data: bytes) -> None:
         ctx, data = tracing.extract(data)
-        with tracing.DEFAULT.activate(ctx):
+        with self._lock, tracing.DEFAULT.activate(ctx):
             self._on_gossip(data)
 
     def _on_gossip(self, data: bytes) -> None:
         try:
             code, msg = M.unpack_gossip(data)
-        except Exception:
-            return  # malformed datagram from a peer must not kill the loop
+        except Exception as exc:
+            # malformed datagram from a peer must not kill the loop
+            self._log("malformed gossip", nbytes=len(data), err=repr(exc))
+            return
         if code == M.GOSSIP_VALIDATE_REQ:
             self._handle_validate_request(msg)
         elif code == M.GOSSIP_QUERY:
@@ -317,13 +347,15 @@ class GeecNode:
 
     def on_direct(self, data: bytes) -> None:
         ctx, data = tracing.extract(data)
-        with tracing.DEFAULT.activate(ctx):
+        with self._lock, tracing.DEFAULT.activate(ctx):
             self._on_direct(data)
 
     def _on_direct(self, data: bytes) -> None:
         try:
             code, author, msg = M.unpack_direct(data)
-        except Exception:
+        except Exception as exc:
+            # malformed/unauthenticated datagram: drop, but leave a trace
+            self._log("malformed direct", nbytes=len(data), err=repr(exc))
             return
         if code == M.UDP_ELECT:
             self._handle_elect_message(msg)
@@ -347,7 +379,8 @@ class GeecNode:
     def on_geec_txn(self, payload: bytes) -> None:
         """UDP txn ingest (ref: consensus/geec/geec_api.go:28-41)."""
         from eges_tpu.core.types import geec_txn
-        self.pending_geec_txns.append(geec_txn(payload))
+        with self._lock:
+            self.pending_geec_txns.append(geec_txn(payload))
 
     # defer a thunk until the working block reaches ``blk`` (Wait analogue)
     def _defer(self, blk: int, thunk) -> None:
@@ -1007,26 +1040,28 @@ class GeecNode:
 
     _TXN_SEEN_CAP = 1 << 16
 
-    def submit_txns(self, txns) -> None:
+    def submit_txns(self, txns) -> None:  # thread-entry (RPC worker)
         """Local ingress (RPC eth_sendRawTransaction): admit to our pool
         via the journaled local path (they survive a restart, ref:
         core/tx_pool.go journal); admitted txns are broadcast via the
         pool's admission hook."""
         txns = list(txns)
-        if self.txpool is not None:
-            self._ensure_pool_relay()
-            self.txpool.add_locals(txns)
-        else:
-            self.broadcast_txns(txns)
+        with self._lock:
+            if self.txpool is not None:
+                self._ensure_pool_relay()
+                self.txpool.add_locals(txns)
+            else:
+                self.broadcast_txns(txns)
 
-    def broadcast_txns(self, txns) -> None:
+    def broadcast_txns(self, txns) -> None:  # thread-entry (RPC worker)
         """Gossip txns to peers with relay-once dedup."""
-        fresh = [t for t in txns if t.hash not in self._txn_seen]
-        if not fresh:
-            return
-        self._mark_seen_txns(fresh)
-        self.transport.gossip(
-            M.pack_gossip(M.GOSSIP_TXNS, M.TxnsMsg(txns=tuple(fresh))))
+        with self._lock:
+            fresh = [t for t in txns if t.hash not in self._txn_seen]
+            if not fresh:
+                return
+            self._mark_seen_txns(fresh)
+            self.transport.gossip(
+                M.pack_gossip(M.GOSSIP_TXNS, M.TxnsMsg(txns=tuple(fresh))))
 
     def _handle_txns(self, msg: M.TxnsMsg) -> None:
         fresh = [t for t in msg.txns if t.hash not in self._txn_seen]
@@ -1672,9 +1707,10 @@ class GeecNode:
     # ------------------------------------------------------------------
 
     def _on_new_block(self, blk: Block) -> None:
-        self._timeout_times = 0
-        self._arm_block_timeout()
-        self._ingest_block(blk)
+        with self._lock:
+            self._timeout_times = 0
+            self._arm_block_timeout()
+            self._ingest_block(blk)
 
     def _ingest_block(self, blk: Block, replay: bool = False) -> None:
         """Consensus-state effects of a canonical block; also used to
@@ -1762,10 +1798,11 @@ class GeecNode:
     # registration (ref: Register geec_state.go:706-757)
     # ------------------------------------------------------------------
 
-    def request_registration(self) -> None:
+    def request_registration(self) -> None:  # thread-entry (RPC worker)
         """Public join-request trigger (the thw RPC namespace's Register,
         ref: consensus/geec/api.go)."""
-        self._start_registration(renew=0)
+        with self._lock:
+            self._start_registration(renew=0)
 
     def _start_registration(self, renew: int) -> None:
         me = self.membership.get(self.coinbase)
@@ -1803,17 +1840,18 @@ class GeecNode:
                         self._on_block_timeout)
 
     def _on_block_timeout(self) -> None:
-        if self.wb.blk_num == 1:
-            self._arm_block_timeout()  # no timeout during bootstrap
-            return
-        if self._timeout_times < 3:
-            self._timeout_times += 1
-            self._arm_block_timeout()
-            self._handle_committee_timeout(self._timeout_times)
-        else:
-            self._timeout_times = 0
-            self._arm_block_timeout()
-            self._force_empty_block()
+        with self._lock:
+            if self.wb.blk_num == 1:
+                self._arm_block_timeout()  # no timeout during bootstrap
+                return
+            if self._timeout_times < 3:
+                self._timeout_times += 1
+                self._arm_block_timeout()
+                self._handle_committee_timeout(self._timeout_times)
+            else:
+                self._timeout_times = 0
+                self._arm_block_timeout()
+                self._force_empty_block()
 
     def _force_empty_block(self) -> None:
         """(ref: HandleBlockTimeout geec_state.go:927-953)"""

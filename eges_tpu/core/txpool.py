@@ -16,6 +16,8 @@ admission costs one device call per window regardless of txn rate.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from eges_tpu.core.types import Transaction
@@ -32,6 +34,14 @@ class TxPool:
         self.max_batch = max_batch
         self.max_pending = max_pending
         self.on_admitted = on_admitted
+        # One re-entrant monitor guards every mutable structure below:
+        # add_locals arrives on the RPC thread while the window flush
+        # fires on the clock thread.  A GeecNode that adopts this pool
+        # REPLACES this lock with its own (GeecNode.txpool setter) so
+        # node + pool form a single lock domain — the on_admitted hook
+        # re-enters the node from inside a flush, and two separate locks
+        # would be acquired in opposite orders on that path.
+        self._lock = threading.RLock()
         # local-txn journal (ref: core/tx_pool.go journal — locally
         # submitted txns survive a restart): append-only RLP records,
         # rotated to the still-pending set when it grows stale
@@ -68,11 +78,12 @@ class TxPool:
 
     # -- ingest -----------------------------------------------------------
 
-    def add_remotes(self, txns) -> None:
+    def add_remotes(self, txns) -> None:  # thread-entry (RPC via add_locals)
         """Queue remote txns for batched admission
         (ref: TxPool.AddRemotes core/tx_pool.go:551)."""
         fresh = 0
-        with tracing.DEFAULT.span("txpool.ingest", owner=self.owner) as sp:
+        with self._lock, \
+                tracing.DEFAULT.span("txpool.ingest", owner=self.owner) as sp:
             ctx = sp.context()
             for t in txns:
                 h = t.hash
@@ -85,15 +96,16 @@ class TxPool:
                     self._ingest_ctx[h] = ctx
                 fresh += 1
             sp.set_attr("fresh", fresh)
-        if len(self._queue) >= self.max_batch:
-            self._flush()
-        elif self._queue and self._timer is None:
-            self._timer = self.clock.call_later(self.window_ms / 1e3,
-                                                self._on_window)
+            if len(self._queue) >= self.max_batch:
+                self._flush()
+            elif self._queue and self._timer is None:
+                self._timer = self.clock.call_later(self.window_ms / 1e3,
+                                                    self._on_window)
 
     def _on_window(self) -> None:
-        self._timer = None
-        self._flush()
+        with self._lock:
+            self._timer = None
+            self._flush()
 
     def _flush(self) -> None:
         if self._timer is not None:
@@ -205,41 +217,42 @@ class TxPool:
         reference pool (pending vs queued, core/tx_pool.go): a sender
         with a nonce gap or empty purse no longer starves other senders
         out of the per-block limit."""
-        seen: set[bytes] = set()
-        out: list[Transaction] = []
-        for s, _ in list(self._order):
-            if s in seen:
-                continue
-            seen.add(s)
-            by_nonce = self.pending.get(s)
-            if not by_nonce:
-                continue
-            run = sorted(by_nonce.items())
-            if state is not None:
-                start = state.nonce(s)
-                stale = [t for n, t in run if n < start]
-                if stale:
-                    self._evict(stale)
-                    run = [(n, t) for n, t in run if n >= start]
-                spendable = state.balance(s)
-                picked = []
-                want = start
-                for n, t in run:
-                    if n != want:
-                        break  # nonce gap: rest is non-executable
-                    from eges_tpu.core.state import INTRINSIC_GAS
-                    cost = t.value + t.gas_price * INTRINSIC_GAS
-                    if cost > spendable:
-                        break
-                    spendable -= cost
-                    picked.append(t)
-                    want += 1
-                out.extend(picked)
-            else:
-                out.extend(t for _, t in run)
-            if limit and len(out) >= limit:
-                break
-        return out[:limit] if limit else out
+        with self._lock:
+            seen: set[bytes] = set()
+            out: list[Transaction] = []
+            for s, _ in list(self._order):
+                if s in seen:
+                    continue
+                seen.add(s)
+                by_nonce = self.pending.get(s)
+                if not by_nonce:
+                    continue
+                run = sorted(by_nonce.items())
+                if state is not None:
+                    start = state.nonce(s)
+                    stale = [t for n, t in run if n < start]
+                    if stale:
+                        self._evict(stale)
+                        run = [(n, t) for n, t in run if n >= start]
+                    spendable = state.balance(s)
+                    picked = []
+                    want = start
+                    for n, t in run:
+                        if n != want:
+                            break  # nonce gap: rest is non-executable
+                        from eges_tpu.core.state import INTRINSIC_GAS
+                        cost = t.value + t.gas_price * INTRINSIC_GAS
+                        if cost > spendable:
+                            break
+                        spendable -= cost
+                        picked.append(t)
+                        want += 1
+                    out.extend(picked)
+                else:
+                    out.extend(t for _, t in run)
+                if limit and len(out) >= limit:
+                    break
+            return out[:limit] if limit else out
 
     def _evict(self, txns) -> None:
         """O(evicted) eviction: the ``_by_hash`` index locates each txn's
@@ -267,44 +280,46 @@ class TxPool:
         """Drop txns included in a canonical block; closes each txn's
         trace with a ``tx.commit`` span so ingest -> admit -> commit is
         one linked trace even across nodes."""
-        for t in txns:
-            ctx = self._ingest_ctx.get(t.hash)
-            if ctx is not None:
-                tracing.DEFAULT.record_span(
-                    "tx.commit", 0.0, parent=ctx, owner=self.owner,
-                    tx=t.hash.hex()[:16],
-                    **({"block": block} if block is not None else {}))
-        self._evict(txns)
-        if self.event_journal is not None and txns:
-            self.event_journal.record("txns_included", blk=block,
-                                      count=len(txns))
-        if (self.journal_path and
-                self._journal_count > max(64, 4 * len(self._by_hash))):
-            self._rotate_journal()
+        with self._lock:
+            for t in txns:
+                ctx = self._ingest_ctx.get(t.hash)
+                if ctx is not None:
+                    tracing.DEFAULT.record_span(
+                        "tx.commit", 0.0, parent=ctx, owner=self.owner,
+                        tx=t.hash.hex()[:16],
+                        **({"block": block} if block is not None else {}))
+            self._evict(txns)
+            if self.event_journal is not None and txns:
+                self.event_journal.record("txns_included", blk=block,
+                                          count=len(txns))
+            if (self.journal_path and
+                    self._journal_count > max(64, 4 * len(self._by_hash))):
+                self._rotate_journal()
 
     # -- local-txn journal (ref: core/tx_pool.go newTxJournal) ------------
 
-    def add_locals(self, txns) -> None:
+    def add_locals(self, txns) -> None:  # thread-entry (RPC worker)
         """Admit locally-submitted txns AND journal them so they survive
         a node restart (remote gossip txns are not journaled).  Only
         FRESH txns journal — resubmitting the same txn N times must not
         grow the file — and a journal that outgrows the live pool 4x
         rotates even on a quiet chain."""
-        fresh = [t for t in txns if t.hash not in self._known]
-        if self.journal_path and fresh:
-            import struct
+        with self._lock:
+            fresh = [t for t in txns if t.hash not in self._known]
+            if self.journal_path and fresh:
+                import struct
 
-            if self._journal is None:
-                self._journal = open(self.journal_path, "ab")
-            for t in fresh:
-                raw = t.encode()
-                self._journal.write(struct.pack("<I", len(raw)) + raw)
-                self._journal_count += 1
-            self._journal.flush()
-            if self._journal_count > max(64, 4 * (len(self._by_hash)
-                                                  + len(fresh))):
-                self._rotate_journal()
-        self.add_remotes(txns)
+                if self._journal is None:
+                    self._journal = open(self.journal_path, "ab")
+                for t in fresh:
+                    raw = t.encode()
+                    self._journal.write(struct.pack("<I", len(raw)) + raw)
+                    self._journal_count += 1
+                self._journal.flush()
+                if self._journal_count > max(64, 4 * (len(self._by_hash)
+                                                      + len(fresh))):
+                    self._rotate_journal()
+            self.add_remotes(txns)
 
     def load_journal(self) -> int:
         """Re-queue journaled local txns (stale nonces fall out at
@@ -316,29 +331,31 @@ class TxPool:
 
         if not self.journal_path or not os.path.exists(self.journal_path):
             return 0
-        with open(self.journal_path, "rb") as f:
-            data = f.read()
-        txns = []
-        pos = 0
-        good_end = 0
-        while pos + 4 <= len(data):
-            (n,) = struct.unpack("<I", data[pos : pos + 4])
-            if pos + 4 + n > len(data):
-                break  # torn tail
-            try:
-                txns.append(Transaction.decode(data[pos + 4 : pos + 4 + n]))
-            except Exception:
-                break
-            pos += 4 + n
-            good_end = pos
-        if good_end != len(data):
-            with open(self.journal_path, "r+b") as f:
-                f.truncate(good_end)
-        self._journal_count = len(txns)
-        if txns:
-            self.add_remotes(txns)
-            self._flush()
-        return len(txns)
+        with self._lock:
+            with open(self.journal_path, "rb") as f:
+                data = f.read()
+            txns = []
+            pos = 0
+            good_end = 0
+            while pos + 4 <= len(data):
+                (n,) = struct.unpack("<I", data[pos : pos + 4])
+                if pos + 4 + n > len(data):
+                    break  # torn tail
+                try:
+                    txns.append(
+                        Transaction.decode(data[pos + 4 : pos + 4 + n]))
+                except Exception:
+                    break  # torn/corrupt record: keep the parsed prefix
+                pos += 4 + n
+                good_end = pos
+            if good_end != len(data):
+                with open(self.journal_path, "r+b") as f:
+                    f.truncate(good_end)
+            self._journal_count = len(txns)
+            if txns:
+                self.add_remotes(txns)
+                self._flush()
+            return len(txns)
 
     def _rotate_journal(self) -> None:
         """Rewrite the journal with the still-pending set (a superset of
@@ -363,9 +380,11 @@ class TxPool:
         self._journal_count = kept
 
     def close(self) -> None:
-        if self._journal is not None:
-            self._journal.close()
-            self._journal = None
+        with self._lock:
+            if self._journal is not None:
+                self._journal.close()
+                self._journal = None
 
     def __len__(self) -> int:
-        return len(self._by_hash)
+        with self._lock:
+            return len(self._by_hash)

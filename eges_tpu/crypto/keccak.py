@@ -97,6 +97,7 @@ def _dispatch_keccak256():
 
         if native.available():
             return lambda data: native.keccak256(bytes(data))
+    # analysis: allow-swallow(optional native-accel probe; falls back to python)
     except Exception:
         pass
     return keccak256_py

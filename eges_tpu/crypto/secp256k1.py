@@ -266,5 +266,6 @@ try:
             if len(priv) != 32:
                 raise ValueError("private key must be 32 bytes")
             return _native.ec_pubkey(bytes(priv))
+# analysis: allow-swallow(optional native-accel probe; pure-python defs stand)
 except Exception:  # pragma: no cover - native lib absent
     pass

@@ -38,6 +38,11 @@ import time
 
 from eges_tpu.core import rlp
 from eges_tpu.crypto.keccak import keccak256
+from eges_tpu.utils.log import get_logger
+
+# hostile/malformed datagrams are routine on an open UDP port: dropped
+# at GDBUG so default verbosity stays quiet but a -v5 run shows them
+log = get_logger("discovery")
 from eges_tpu.net import enr as enrlib
 from eges_tpu.net import netutil
 
@@ -115,7 +120,9 @@ class BootnodeService:
                 self._evict(now)
                 recs = [r.encode() for _, r in self._sample(self.records)]
                 reply(rlp.encode([RECORDS, bytes(item[1]), recs]))
-        except Exception:
+        except Exception as exc:
+            log.gdbug("bootnode dropped datagram", nbytes=len(data),
+                      err=repr(exc))
             return
 
     @staticmethod
@@ -139,7 +146,8 @@ class BootnodeService:
             gip, cip = bytes(gip).decode(), bytes(cip).decode()
             gport, cport = rlp.decode_uint(gport), rlp.decode_uint(cport)
             expiry = rlp.decode_uint(expiry)
-        except Exception:
+        except Exception as exc:
+            log.gdbug("bootnode dropped malformed announce", err=repr(exc))
             return
         if expiry < now:
             return  # stale/replayed announce
@@ -147,7 +155,9 @@ class BootnodeService:
                                   cport, expiry]))
         try:
             signer = secp.recover_address(h, sig)
-        except Exception:
+        except Exception as exc:
+            log.gdbug("bootnode dropped announce: bad signature",
+                      err=repr(exc))
             return
         if signer != secp.pubkey_to_address(pub):
             return
@@ -215,8 +225,11 @@ class BootnodeService:
                 try:
                     service.handle(
                         data, lambda out: self.transport.sendto(out, addr))
-                except Exception:
-                    pass
+                except Exception as exc:
+                    # handle() guards its own parse; this catches reply
+                    # transmit failures (transport mid-close etc.)
+                    log.gdbug("bootnode reply failed", peer=str(addr),
+                              err=repr(exc))
 
         self._transport, _ = await loop.create_datagram_endpoint(
             _Proto, local_addr=(self.bind_ip, self.port))
@@ -264,31 +277,38 @@ class DiscoveryClient:
         try:
             item = rlp.decode(data)
             code = rlp.decode_uint(item[0])
-        except Exception:
+        except Exception as exc:
+            log.gdbug("client dropped malformed datagram",
+                      nbytes=len(data), err=repr(exc))
             return
         if code == RECORDS:
             try:
                 recs = item[2]
-            except Exception:
+            except Exception as exc:
+                log.gdbug("client dropped truncated RECORDS", err=repr(exc))
                 return
             for raw in recs:
                 try:
                     self._on_record(bytes(raw))
-                except Exception:
-                    continue  # one bad record must not shadow the rest
+                except Exception as exc:
+                    # one bad record must not shadow the rest
+                    log.gdbug("client skipped bad record", err=repr(exc))
+                    continue
             return
         if code != PEERS:
             return
         try:
             peers = item[2]
-        except Exception:
+        except Exception as exc:
+            log.gdbug("client dropped truncated PEERS", err=repr(exc))
             return
         for p in peers:
             try:
                 addr = bytes(p[0])
                 gip, gport = bytes(p[1]).decode(), rlp.decode_uint(p[2])
                 cip, cport = bytes(p[3]).decode(), rlp.decode_uint(p[4])
-            except Exception:
+            except Exception as exc:
+                log.gdbug("client skipped bad peer entry", err=repr(exc))
                 continue
             self._learn(addr, gip, gport, cip, cport, seq=0)
 
@@ -345,8 +365,11 @@ class DiscoveryClient:
                     self._transport.sendto(ann, bn)
                     self._transport.sendto(rquery, bn)
                     self._transport.sendto(query, bn)
-                except Exception:
-                    pass
+                except Exception as exc:
+                    # a dead/unresolvable bootnode must not stall the
+                    # announce loop for the remaining ones
+                    log.gdbug("announce to bootnode failed", bootnode=bn,
+                              err=repr(exc))
             rounds += 1
             # fast-start: tight announce/lookup rounds until the mesh
             # forms (peers only learn each other after BOTH have
@@ -368,5 +391,6 @@ class _ClientProto(asyncio.DatagramProtocol):
     def datagram_received(self, data, addr):
         try:
             self._on(data)
-        except Exception:
-            pass
+        except Exception as exc:
+            log.gdbug("client handler error", peer=str(addr),
+                      err=repr(exc))

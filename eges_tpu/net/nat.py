@@ -43,6 +43,7 @@ class NAT:
             return self.extip  # type: ignore[return-value]
         # auto: route-table lookup via an unconnected-send-free socket
         s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.settimeout(1.0)  # connect() on UDP is local-only, but be safe
         try:
             s.connect(("192.0.2.1", 9))  # TEST-NET-1: never dialed
             ip = s.getsockname()[0]

@@ -30,6 +30,13 @@ import asyncio
 import struct
 import time
 
+from eges_tpu.utils.log import get_logger
+
+# peer-facing parse/dispatch errors are routine against hostile or
+# mid-upgrade peers: logged at GDBUG so -v5 shows them without letting
+# default verbosity drown in them
+log = get_logger("net")
+
 
 class AsyncioClock:
     """Clock interface over the running asyncio loop."""
@@ -51,8 +58,10 @@ class _UdpProtocol(asyncio.DatagramProtocol):
     def datagram_received(self, data, addr):
         try:
             self._on_datagram(data)
-        except Exception:
-            pass  # one bad datagram must not kill the receive loop
+        except Exception as exc:
+            # one bad datagram must not kill the receive loop
+            log.gdbug("direct datagram handler error", peer=str(addr),
+                      err=repr(exc))
 
 
 class DirectPlane:
@@ -394,7 +403,9 @@ class GossipPlane:
         self._writers: dict[tuple[str, int], _Session] = {}
         self._tasks: list[asyncio.Task] = []
         self._closed = False
-        self.auth_failures = 0
+        # dial + accept coroutines both bump this, but all of them run
+        # on the plane's single asyncio loop — never concurrently
+        self.auth_failures = 0  # guarded-by: event-loop
         self.peer_drops = 0       # misbehavior disconnects
         self._peer_gauge()  # register net.peer_count at 0
 
@@ -436,6 +447,7 @@ class GossipPlane:
         if sess is not None:
             try:
                 sess.writer.close()
+            # analysis: allow-swallow(best-effort close of a possibly dead writer)
             except Exception:
                 pass
 
@@ -483,6 +495,7 @@ class GossipPlane:
             self.peer_drops += 1       # and stop dispatching its
             try:                       # already-buffered frames
                 sess.writer.close()
+            # analysis: allow-swallow(best-effort close of a misbehaving peer)
             except Exception:
                 pass
 
@@ -500,8 +513,8 @@ class GossipPlane:
         if self.protocols is None:
             try:
                 self._on_gossip(data)
-            except Exception:
-                pass
+            except Exception as exc:
+                log.gdbug("gossip handler error", err=repr(exc))
             return
         from eges_tpu.core import rlp
         from eges_tpu.utils import tracing
@@ -523,8 +536,9 @@ class GossipPlane:
             return
         try:
             proto.handler(data)
-        except Exception:
-            pass
+        except Exception as exc:
+            log.gdbug("protocol handler error", proto=proto.name,
+                      err=repr(exc))
 
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:

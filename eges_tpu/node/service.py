@@ -114,6 +114,7 @@ class NodeService:
                         ".jax_cache"))
                 jax.config.update(
                     "jax_persistent_cache_min_compile_time_secs", 2.0)
+            # analysis: allow-swallow(older jax lacks these cache knobs)
             except Exception:
                 pass
             from eges_tpu.crypto.verifier import default_verifier

@@ -51,8 +51,9 @@ def initialize(coordinator: str, num_processes: int, process_id: int,
     if (platform or "cpu") == "cpu":
         try:
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        # analysis: allow-swallow(older jax: single implementation, no knob)
         except Exception:
-            pass  # older jax: single implementation, no knob
+            pass
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)
@@ -193,6 +194,7 @@ def _free_port() -> int:
     import socket
 
     s = socket.socket()
+    s.settimeout(1.0)
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()

@@ -34,6 +34,24 @@ import json
 from eges_tpu.core import rlp
 from eges_tpu.core.types import Block, Transaction
 
+# Closed vocabulary of dispatched JSON-RPC methods.  The static-analysis
+# vocabulary rule checks this both ways against the ``method == "..."``
+# dispatch comparisons below: an unregistered dispatch literal and a
+# registered method with no dispatch site both fail the gate.  The
+# ``debug_*`` namespace goes through a prefix dispatcher and is exempt.
+RPC_METHODS = frozenset({
+    "eth_blockNumber", "eth_call", "eth_chainId", "eth_estimateGas",
+    "eth_gasPrice", "eth_getBalance", "eth_getBlockByHash",
+    "eth_getBlockByNumber", "eth_getCode", "eth_getFilterChanges",
+    "eth_getLogs", "eth_getStorageAt", "eth_getTransactionByHash",
+    "eth_getTransactionCount", "eth_getTransactionReceipt",
+    "eth_newBlockFilter", "eth_newFilter", "eth_sendRawTransaction",
+    "eth_subscribe", "eth_uninstallFilter", "eth_unsubscribe",
+    "net_version", "thw_health", "thw_journal", "thw_membership",
+    "thw_metrics", "thw_pendingGeecTxns", "thw_register", "thw_status",
+    "thw_traces", "web3_clientVersion",
+})
+
 
 def _hex(n: int) -> str:
     return hex(n)
@@ -948,6 +966,7 @@ class RpcServer:
                         writer.close()
                         continue
                     writer.write(self._ws_frame(json.dumps(msg).encode()))
+                # analysis: allow-swallow(dead subscriber; reaped on next pass)
                 except Exception:
                     pass
 

@@ -37,6 +37,7 @@ def dump_stacks() -> str:
         out.append(f"--- {len(tasks)} pending asyncio tasks ---")
         for t in tasks:
             out.append(repr(t))
+    # analysis: allow-swallow(best-effort diagnostic dump; partial output ok)
     except Exception:
         pass
     return "\n".join(out)
@@ -108,6 +109,7 @@ class DebugController:
             out["max_rss_kb"] = ru.ru_maxrss
             out["user_cpu_s"] = round(ru.ru_utime, 3)
             out["sys_cpu_s"] = round(ru.ru_stime, 3)
+        # analysis: allow-swallow(resource module optional; stats best-effort)
         except Exception:
             pass
         return out

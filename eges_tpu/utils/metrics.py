@@ -22,6 +22,34 @@ import threading
 import time
 from collections import deque
 
+# Closed vocabulary of metric families emitted by the library (the part
+# of the name before the ``;`` label separator).  Emit sites are checked
+# against this set by ``python -m harness.analysis`` (vocabulary rule):
+# an unregistered family, a family used as two different kinds, or a
+# registered family with no emit site all fail the gate.
+METRIC_FAMILIES = frozenset({
+    # core/chain.py
+    "chain.bad_blocks", "chain.blocks", "chain.fastsync_adoptions",
+    "chain.geec_txns", "chain.height", "chain.insert",
+    "chain.insert_seconds", "chain.txns",
+    # consensus/
+    "consensus.deferred_depth", "consensus.elected",
+    "consensus.forced_empties", "consensus.phase_seconds",
+    "consensus.sealed", "membership.min_ttl", "membership.size",
+    # net/ + sim/simnet.py
+    "net.direct_bytes", "net.direct_msgs", "net.gossip_bytes",
+    "net.gossip_msgs", "net.peer_count",
+    # core/txpool.py
+    "txpool.pending",
+    # crypto/ verifiers
+    "verifier.batches", "verifier.compile_cache_hits",
+    "verifier.compile_cache_misses", "verifier.d2h_seconds",
+    "verifier.device", "verifier.device_name", "verifier.device_seconds",
+    "verifier.h2d_seconds", "verifier.host_rows", "verifier.native",
+    "verifier.native_batches", "verifier.native_rows",
+    "verifier.pad_waste", "verifier.padded_rows", "verifier.rows",
+})
+
 
 def percentile(sorted_vals, q: float) -> float:
     """Linear-interpolation percentile over a pre-sorted sequence,

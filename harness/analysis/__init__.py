@@ -1,0 +1,17 @@
+"""AST-based static-analysis pass for the eges_tpu tree.
+
+Run with ``python -m harness.analysis`` (or ``python harness/analyze.py``).
+See core.py for the finding/waiver/baseline model and the four checker
+modules (lock_discipline, jit_purity, vocabulary, robustness) for the
+rules.
+"""
+
+from harness.analysis.core import (  # noqa: F401
+    DEFAULT_BASELINE,
+    DEFAULT_PATHS,
+    BaselineError,
+    Finding,
+    Project,
+    Report,
+    run,
+)

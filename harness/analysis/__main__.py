@@ -1,0 +1,88 @@
+"""CLI for the static-analysis pass.
+
+Exit status is the CI gate: 0 only when every finding is waived or
+baselined (and the baseline itself is well-formed).  ``--summary FILE``
+appends one ``findings_by_rule`` JSON line so the counts can be trended
+alongside bench_history.jsonl.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from harness.analysis import core
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m harness.analysis",
+        description="AST static analysis: lock-discipline, jit-purity, "
+                    "vocabulary, robustness-hygiene.")
+    ap.add_argument("paths", nargs="*", default=list(core.DEFAULT_PATHS),
+                    help="directories/files to scan (default: eges_tpu "
+                         "harness)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: parent of harness/)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON instead of text")
+    ap.add_argument("--summary", metavar="FILE", default=None,
+                    help="append a findings_by_rule JSON summary line")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the checked-in baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite baseline.json from current unsuppressed "
+                         "findings (justifications must then be filled in)")
+    args = ap.parse_args(argv)
+
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    rules = tuple(args.rules.split(",")) if args.rules else None
+    baseline = None if args.no_baseline else core.DEFAULT_BASELINE
+
+    try:
+        report = core.run(root, tuple(args.paths), rules, baseline)
+    except core.BaselineError as e:
+        print(f"baseline error: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        core.save_baseline(core.DEFAULT_BASELINE, report.unsuppressed)
+        print(f"wrote {len(report.unsuppressed)} entries to "
+              f"{core.DEFAULT_BASELINE}; fill in the justifications.")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({"summary": report.summary_json(),
+                          "findings": [f.as_json() for f in report.findings],
+                          "stale_baseline": report.stale_baseline,
+                          "errors": report.errors}, indent=2))
+    else:
+        for f in report.findings:
+            print(f.render())
+        for e in report.errors:
+            print(f"error: {e}")
+        for e in report.stale_baseline:
+            print(f"stale baseline entry (no longer fires): "
+                  f"[{e['rule']}] {e['path']} {e['symbol']}")
+        s = report.summary_json()
+        print(f"{s['files']} files, {s['findings']} findings "
+              f"({s['unsuppressed']} unsuppressed, {s['waived']} waived, "
+              f"{s['baselined']} baselined) in {s['elapsed_s']}s")
+
+    if args.summary:
+        with open(args.summary, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(report.summary_json(),
+                                sort_keys=True) + "\n")
+
+    if report.errors:
+        return 2
+    return 1 if report.unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

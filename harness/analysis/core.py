@@ -1,0 +1,300 @@
+"""Static-analysis core: source model, findings, waivers, baseline.
+
+The framework is pure-AST — it never imports the code under analysis
+(no JAX, no device init), so the whole pass stays in the single-digit
+seconds the tier-1 wrapper budget allows.  Checkers receive a
+:class:`Project` (every parsed source file plus shared symbol-table
+helpers) and return :class:`Finding` lists; the runner then applies the
+two suppression layers:
+
+* **inline waivers** — ``# analysis: allow-<rule>(<reason>)`` on the
+  offending line (or alone on the line above) waives that rule there;
+* **baseline** — ``harness/analysis/baseline.json`` carries
+  known-and-accepted findings, each with a one-line justification.
+  Matching is by (rule, path, symbol, message), never by line number,
+  so unrelated edits don't churn the baseline.
+
+A finding that is neither waived nor baselined is *unsuppressed* and
+fails the gate (non-zero exit / the tier-1 pytest wrapper).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import time
+
+# rule ids, grouped by the four checkers that own them
+RULES = (
+    "lock-discipline",                                   # lock_discipline
+    "jit-purity",                                        # jit_purity
+    "vocabulary",                                        # vocabulary
+    "swallow", "thread-join", "socket-timeout",          # robustness
+    "unbounded-queue", "no-print",                       # robustness
+)
+
+_WAIVER_RE = re.compile(r"#\s*analysis:\s*(.+)$")
+_ALLOW_RE = re.compile(r"allow-([a-z0-9-]+)(?:\(([^)]*)\))?")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative, '/'-separated
+    line: int
+    symbol: str        # stable anchor: Class.attr / function / family
+    message: str
+    waived: bool = False
+    baselined: bool = False
+
+    def fingerprint(self) -> tuple[str, str, str, str]:
+        return (self.rule, self.path, self.symbol, self.message)
+
+    def render(self) -> str:
+        tag = " [waived]" if self.waived else (
+            " [baselined]" if self.baselined else "")
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{tag}"
+
+    def as_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message,
+                "waived": self.waived, "baselined": self.baselined}
+
+
+class SourceFile:
+    """One parsed module: text, AST, and per-line waiver map."""
+
+    def __init__(self, abspath: str, relpath: str):
+        self.abspath = abspath
+        self.path = relpath.replace(os.sep, "/")
+        with open(abspath, "r", encoding="utf-8") as fh:
+            self.text = fh.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=relpath)
+        # line -> {rule-token: reason}; a waiver comment alone on a line
+        # also covers the next line (annotation-above style)
+        self.waivers: dict[int, dict[str, str]] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = _WAIVER_RE.search(line)
+            if not m:
+                continue
+            tokens = {tok: (reason or "")
+                      for tok, reason in _ALLOW_RE.findall(m.group(1))}
+            if not tokens:
+                continue
+            self.waivers.setdefault(i, {}).update(tokens)
+            if line.lstrip().startswith("#"):  # standalone comment line
+                self.waivers.setdefault(i + 1, {}).update(tokens)
+
+    def waived(self, rule: str, line: int) -> bool:
+        for tok in self.waivers.get(line, ()):
+            if rule == tok or rule.endswith("-" + tok):
+                return True
+        return False
+
+    # -- annotation helpers (shared comment conventions) ----------------
+
+    def line_comment(self, line: int) -> str:
+        """The comment tail of a 1-based source line ('' if none)."""
+        if 1 <= line <= len(self.lines):
+            _, hash_, tail = self.lines[line - 1].partition("#")
+            return tail if hash_ else ""
+        return ""
+
+    def guarded_by(self, line: int) -> str | None:
+        """``# guarded-by: <lock>`` annotation on a source line."""
+        m = re.search(r"guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)",
+                      self.line_comment(line))
+        return m.group(1) if m else None
+
+    def thread_entry(self, line: int) -> bool:
+        """``# thread-entry`` annotation on a def line (declares the
+        method is invoked from another thread, e.g. an RPC worker)."""
+        return "thread-entry" in self.line_comment(line)
+
+
+class Project:
+    """All scanned sources plus cross-file lookups checkers share."""
+
+    def __init__(self, root: str, paths: tuple[str, ...]):
+        self.root = root
+        self.files: list[SourceFile] = []
+        self.errors: list[str] = []
+        for top in paths:
+            top_abs = os.path.join(root, top)
+            if os.path.isfile(top_abs) and top_abs.endswith(".py"):
+                self._add(top_abs)
+                continue
+            for dirpath, dirnames, filenames in os.walk(top_abs):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git",
+                                            ".jax_cache")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        self._add(os.path.join(dirpath, fn))
+
+    def _add(self, abspath: str) -> None:
+        rel = os.path.relpath(abspath, self.root)
+        try:
+            self.files.append(SourceFile(abspath, rel))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            self.errors.append(f"{rel}: unparseable: {e}")
+
+    def file(self, relpath: str) -> SourceFile | None:
+        relpath = relpath.replace(os.sep, "/")
+        for f in self.files:
+            if f.path == relpath:
+                return f
+        return None
+
+    def frozenset_literal(self, relpath: str, name: str) -> frozenset | None:
+        """Evaluate a module-level ``NAME = frozenset({...})`` (or plain
+        set/tuple) assignment without importing the module."""
+        f = self.file(relpath)
+        if f is None:
+            return None
+        for node in f.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == name
+                            for t in node.targets)):
+                try:
+                    value = ast.literal_eval(_strip_frozenset(node.value))
+                except ValueError:
+                    return None
+                return frozenset(value)
+        return None
+
+
+def _strip_frozenset(node: ast.expr) -> ast.expr:
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id in ("frozenset", "set", "tuple")
+            and len(node.args) == 1):
+        return node.args[0]
+    return node
+
+
+# -- baseline -----------------------------------------------------------
+
+class BaselineError(Exception):
+    pass
+
+
+def load_baseline(path: str) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as fh:
+        entries = json.load(fh)
+    for e in entries:
+        missing = {"rule", "path", "symbol", "message",
+                   "justification"} - set(e)
+        if missing:
+            raise BaselineError(
+                f"baseline entry {e.get('symbol', '?')!r} missing "
+                f"{sorted(missing)}")
+        just = str(e["justification"]).strip()
+        if not just or just.startswith("TODO"):
+            raise BaselineError(
+                f"baseline entry {e['symbol']!r} has an empty or TODO "
+                "justification — every suppression must say why")
+    return entries
+
+
+def save_baseline(path: str, findings: list[Finding]) -> None:
+    entries = [{"rule": f.rule, "path": f.path, "symbol": f.symbol,
+                "message": f.message,
+                "justification": "TODO: justify this suppression"}
+               for f in findings]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(entries, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+# -- runner -------------------------------------------------------------
+
+DEFAULT_PATHS = ("eges_tpu", "harness")
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+class Report:
+    def __init__(self, findings: list[Finding], files: int,
+                 elapsed_s: float, stale_baseline: list[dict],
+                 errors: list[str]):
+        self.findings = findings
+        self.files = files
+        self.elapsed_s = elapsed_s
+        self.stale_baseline = stale_baseline
+        self.errors = errors
+
+    @property
+    def unsuppressed(self) -> list[Finding]:
+        return [f for f in self.findings if not f.waived and not f.baselined]
+
+    def findings_by_rule(self) -> dict[str, int]:
+        out = {r: 0 for r in RULES}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def summary_json(self) -> dict:
+        return {
+            "files": self.files,
+            "elapsed_s": round(self.elapsed_s, 3),
+            "findings": len(self.findings),
+            "unsuppressed": len(self.unsuppressed),
+            "waived": sum(1 for f in self.findings if f.waived),
+            "baselined": sum(1 for f in self.findings if f.baselined),
+            "stale_baseline": len(self.stale_baseline),
+            "findings_by_rule": self.findings_by_rule(),
+        }
+
+
+def run(root: str, paths: tuple[str, ...] = DEFAULT_PATHS,
+        rules: tuple[str, ...] | None = None,
+        baseline_path: str | None = DEFAULT_BASELINE) -> Report:
+    from harness.analysis import (
+        jit_purity, lock_discipline, robustness, vocabulary,
+    )
+
+    t0 = time.monotonic()
+    project = Project(root, paths)
+    findings: list[Finding] = []
+    for checker in (lock_discipline, jit_purity, vocabulary, robustness):
+        findings.extend(checker.check(project))
+    if rules is not None:
+        findings = [f for f in findings if f.rule in rules]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    # layer 1: inline waivers
+    by_path = {f.path: f for f in project.files}
+    for f in findings:
+        src = by_path.get(f.path)
+        if src is not None and src.waived(f.rule, f.line):
+            f.waived = True
+
+    # layer 2: baseline (line-number-free match, each entry usable once
+    # per occurrence — N identical findings need N baseline entries)
+    stale: list[dict] = []
+    if baseline_path:
+        entries = load_baseline(baseline_path)
+        budget: dict[tuple, int] = {}
+        for e in entries:
+            key = (e["rule"], e["path"], e["symbol"], e["message"])
+            budget[key] = budget.get(key, 0) + 1
+        for f in findings:
+            if f.waived:
+                continue
+            if budget.get(f.fingerprint(), 0) > 0:
+                budget[f.fingerprint()] -= 1
+                f.baselined = True
+        for e in entries:
+            key = (e["rule"], e["path"], e["symbol"], e["message"])
+            if budget.get(key, 0) > 0:
+                budget[key] -= 1
+                stale.append(e)
+
+    return Report(findings, len(project.files), time.monotonic() - t0,
+                  stale, project.errors)

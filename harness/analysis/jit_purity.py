@@ -1,0 +1,268 @@
+"""jit-purity / host-sync: Python side effects and implicit host
+round-trips inside traced code.
+
+Roots are functions handed to ``jax.jit`` / ``pl.pallas_call`` (call
+form or decorator, including ``functools.partial(jax.jit, ...)``)
+inside ``eges_tpu/ops/`` and ``eges_tpu/crypto/``.  From each root we
+walk the call graph transitively — same-module helpers and
+cross-module calls resolved through the import table, restricted to
+the scanned packages — and flag, anywhere in a reached body:
+
+* ``print`` and logger calls (side effects traced at compile time only,
+  then silently dropped — or worse, firing per-retrace);
+* ``time.time()`` / ``monotonic()`` / ``perf_counter()`` (host clock
+  reads burned into the trace as constants);
+* ``.item()``, ``float(tracer)`` / ``int(tracer)``, ``np.asarray``,
+  ``jax.device_get``, ``.block_until_ready()`` (implicit device→host
+  syncs that serialize the pipeline);
+* ``global`` / ``nonlocal`` declarations and subscript writes to
+  module-level names (mutation leaks out of the pure trace).
+
+``float(x)``/``int(x)`` casts are exempt when the argument is visibly
+static — a constant, or derived from ``.shape``/``.ndim``/``.size``/
+``.dtype``/``len()`` — since those fold at trace time.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from harness.analysis.core import Finding, Project, SourceFile
+
+SCAN_PREFIXES = ("eges_tpu/ops/", "eges_tpu/crypto/")
+
+HOST_CLOCKS = frozenset({"time", "monotonic", "perf_counter",
+                         "process_time", "time_ns"})
+LOGGER_RECEIVERS = frozenset({"log", "logger", "logging", "LOG"})
+LOGGER_METHODS = frozenset({"debug", "info", "warning", "error",
+                            "exception", "critical", "geec", "gdbug",
+                            "warn", "breakdown"})
+STATIC_ATTRS = frozenset({"shape", "ndim", "size", "dtype", "itemsize"})
+
+
+class _Module:
+    """Symbol tables for one scanned file."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.defs: dict[str, ast.FunctionDef] = {}
+        self.imports: dict[str, str] = {}        # alias -> dotted module
+        self.from_imports: dict[str, tuple[str, str]] = {}  # alias -> (mod, orig)
+        self.globals: set[str] = set()
+        pkg = src.path.rsplit("/", 1)[0].replace("/", ".")
+        for node in src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs[node.name] = node
+                self.globals.add(node.name)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.globals.add(t.id)
+            elif isinstance(node, ast.AnnAssign):
+                if isinstance(node.target, ast.Name):
+                    self.globals.add(node.target.id)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.imports[alias.asname or
+                                 alias.name.split(".")[0]] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if node.level:  # relative: resolve against this package
+                    base = pkg.rsplit(".", node.level - 1)[0] \
+                        if node.level > 1 else pkg
+                    mod = f"{base}.{mod}" if mod else base
+                for alias in node.names:
+                    self.from_imports[alias.asname or alias.name] = (
+                        mod, alias.name)
+
+
+def _mod_path(dotted: str) -> str:
+    return dotted.replace(".", "/") + ".py"
+
+
+def _first_func_ref(call: ast.Call) -> ast.expr | None:
+    return call.args[0] if call.args else None
+
+
+def _is_jit_callee(func: ast.expr) -> bool:
+    if isinstance(func, ast.Name):
+        return func.id in ("jit", "pallas_call")
+    if isinstance(func, ast.Attribute):
+        return func.attr in ("jit", "pallas_call")
+    return False
+
+
+def _decorator_roots(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        target = dec
+        if isinstance(dec, ast.Call):
+            callee = dec.func
+            is_partial = (isinstance(callee, ast.Name)
+                          and callee.id == "partial") or (
+                isinstance(callee, ast.Attribute)
+                and callee.attr == "partial")
+            if is_partial and dec.args:
+                target = dec.args[0]
+            else:
+                target = callee
+        if isinstance(target, ast.Name) and target.id in (
+                "jit", "pallas_call"):
+            return True
+        if isinstance(target, ast.Attribute) and target.attr in (
+                "jit", "pallas_call"):
+            return True
+    return False
+
+
+def _is_cached_host_builder(fn: ast.FunctionDef) -> bool:
+    """True for ``@functools.lru_cache``/``@cache`` functions: tracers
+    are unhashable, so a cached function can only ever receive static
+    arguments — it runs on the host at trace time building constants,
+    and purity rules for traced code don't apply inside it."""
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = (target.attr if isinstance(target, ast.Attribute)
+                else target.id if isinstance(target, ast.Name) else "")
+        if name in ("lru_cache", "cache"):
+            return True
+    return False
+
+
+def _static_cast_arg(node: ast.expr) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant):
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+            return True
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id == "len"):
+            return True
+    return False
+
+
+def _violations(mod: _Module, fn: ast.FunctionDef, root: str,
+                out: list[Finding]) -> None:
+    src = mod.src
+
+    def emit(line: int, what: str) -> None:
+        out.append(Finding(
+            rule="jit-purity", path=src.path, line=line, symbol=fn.name,
+            message=f"{what} inside jit-traced code (reached from "
+                    f"{root})"))
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            emit(node.lineno, f"`{type(node).__name__.lower()}` declaration")
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id in mod.globals):
+                    emit(t.lineno,
+                         f"mutation of module-level `{t.value.id}`")
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                if f.id == "print":
+                    emit(node.lineno, "`print`")
+                elif f.id in ("float", "int") and node.args and not \
+                        _static_cast_arg(node.args[0]):
+                    emit(node.lineno,
+                         f"`{f.id}()` on a possibly-traced value "
+                         "(host sync)")
+            elif isinstance(f, ast.Attribute):
+                recv = f.value.id if isinstance(f.value, ast.Name) else ""
+                if recv == "time" and f.attr in HOST_CLOCKS:
+                    emit(node.lineno, f"`time.{f.attr}()` host clock read")
+                elif (recv in LOGGER_RECEIVERS
+                        and f.attr in LOGGER_METHODS):
+                    emit(node.lineno, f"logger call `{recv}.{f.attr}`")
+                elif f.attr == "item" and not node.args:
+                    emit(node.lineno, "`.item()` host sync")
+                elif f.attr == "block_until_ready":
+                    emit(node.lineno, "`.block_until_ready()`")
+                elif recv in ("np", "numpy", "onp") and f.attr == "asarray":
+                    emit(node.lineno, f"`{recv}.asarray` host sync")
+                elif recv == "jax" and f.attr == "device_get":
+                    emit(node.lineno, "`jax.device_get` host sync")
+
+
+def _callees(mod: _Module, fn: ast.FunctionDef,
+             modules: dict[str, _Module]) -> list[tuple[str, str]]:
+    """(module-path, func-name) pairs this body calls, within scope."""
+    out = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id in mod.defs:
+                out.append((mod.src.path, f.id))
+            elif f.id in mod.from_imports:
+                dotted, orig = mod.from_imports[f.id]
+                path = _mod_path(dotted)
+                if path in modules and orig in modules[path].defs:
+                    out.append((path, orig))
+        elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            alias = f.value.id
+            dotted = mod.imports.get(alias)
+            if dotted is None and alias in mod.from_imports:
+                base, orig = mod.from_imports[alias]
+                dotted = f"{base}.{orig}" if base else orig
+            if dotted:
+                path = _mod_path(dotted)
+                if path in modules and f.attr in modules[path].defs:
+                    out.append((path, f.attr))
+    return out
+
+
+def check(project: Project) -> list[Finding]:
+    modules = {src.path: _Module(src)
+               for src in project.files
+               if src.path.startswith(SCAN_PREFIXES)}
+
+    # roots: jit/pallas_call call-sites + decorators
+    roots: list[tuple[str, str]] = []
+    for path, mod in modules.items():
+        for node in ast.walk(mod.src.tree):
+            if isinstance(node, ast.Call) and _is_jit_callee(node.func):
+                ref = _first_func_ref(node)
+                if isinstance(ref, ast.Name) and ref.id in mod.defs:
+                    roots.append((path, ref.id))
+                elif (isinstance(ref, ast.Name)
+                        and ref.id in mod.from_imports):
+                    dotted, orig = mod.from_imports[ref.id]
+                    tpath = _mod_path(dotted)
+                    if tpath in modules and orig in modules[tpath].defs:
+                        roots.append((tpath, orig))
+                elif (isinstance(ref, ast.Attribute)
+                        and isinstance(ref.value, ast.Name)):
+                    dotted = mod.imports.get(ref.value.id)
+                    if dotted:
+                        tpath = _mod_path(dotted)
+                        if (tpath in modules
+                                and ref.attr in modules[tpath].defs):
+                            roots.append((tpath, ref.attr))
+        for name, fn in mod.defs.items():
+            if _decorator_roots(fn):
+                roots.append((path, name))
+
+    findings: list[Finding] = []
+    seen: set[tuple[str, str]] = set()
+    for root_path, root_name in roots:
+        work = [(root_path, root_name)]
+        root_label = f"{root_path}:{root_name}"
+        while work:
+            path, name = work.pop()
+            if (path, name) in seen:
+                continue
+            seen.add((path, name))
+            mod = modules[path]
+            fn = mod.defs[name]
+            if _is_cached_host_builder(fn):
+                continue
+            _violations(mod, fn, root_label, findings)
+            work.extend(_callees(mod, fn, modules))
+    return findings

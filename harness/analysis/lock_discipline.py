@@ -1,0 +1,288 @@
+"""lock-discipline: shared ``self.*`` state mutated from concurrent
+entry points without a held lock.
+
+Entry points of a class are methods that some other code can invoke
+asynchronously with respect to each other:
+
+* methods passed by reference as a callback anywhere in the project
+  (``threading.Thread(target=self.run)``, ``clock.call_later(d,
+  self._on_window)``, ``chain.add_listener(self._on_new_block)``,
+  ``DirectPlane(..., node.on_direct)``, protocol-factory lambdas);
+* methods invoked inside a lambda handed to a scheduler
+  (``loop.call_later(d, lambda: self._retry(x))``);
+* asyncio protocol overrides (``datagram_received`` & co.) on classes
+  whose base name mentions ``Protocol``;
+* methods annotated ``# thread-entry`` on their ``def`` line.
+
+For classes with >= 2 entry points we BFS the intra-class call graph
+from each entry, tracking the lexical ``with self.<lock>:`` state, and
+flag attributes mutated from >= 2 distinct entries when at least one of
+those mutations happens without the lock held.
+
+Escapes, most-specific first:
+
+* ``# guarded-by: <lock>`` trailing an assignment to the attribute
+  (conventionally in ``__init__``) asserts the discipline is upheld by
+  other means — e.g. ``# guarded-by: event-loop`` for state only ever
+  touched from a single asyncio loop;
+* ``# analysis: allow-lock-discipline(<reason>)`` on the ``class`` line
+  exempts the whole class;
+* the generic per-line waiver / baseline layers in core.py.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from harness.analysis.core import Finding, Project, SourceFile
+
+# callables whose lambda/inner-call arguments run later, detached from
+# the registering frame
+SCHEDULERS = frozenset({
+    "call_later", "call_soon", "call_soon_threadsafe", "call_at",
+    "add_done_callback", "run_in_executor", "submit", "Timer",
+    "create_task", "ensure_future",
+})
+
+PROTOCOL_OVERRIDES = frozenset({
+    "connection_made", "connection_lost", "datagram_received",
+    "error_received", "data_received", "eof_received", "pause_writing",
+    "resume_writing",
+})
+
+# method calls that mutate their receiver in place
+MUTATORS = frozenset({
+    "append", "appendleft", "add", "insert", "extend", "update", "pop",
+    "popleft", "popitem", "remove", "discard", "clear", "setdefault",
+    "sort", "reverse",
+})
+
+LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition", "Semaphore"})
+
+
+def _callback_names(project: Project) -> set[str]:
+    """Names of methods referenced-as-callbacks anywhere in the tree."""
+    names: set[str] = set()
+    for src in project.files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = ""
+            if isinstance(node.func, ast.Attribute):
+                callee = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                callee = node.func.id
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                # f(self.on_x) / Plane(..., node.on_direct): a bound
+                # method handed over by reference is a future entry
+                if isinstance(arg, ast.Attribute) and isinstance(
+                        arg.ctx, ast.Load):
+                    names.add(arg.attr)
+                if callee in SCHEDULERS:
+                    # loop.call_later(d, lambda: self._retry(x)) and
+                    # create_task(self._dial_loop(peer)) both defer the
+                    # inner method past the current frame
+                    for inner in ast.walk(arg):
+                        if (isinstance(inner, ast.Call)
+                                and isinstance(inner.func, ast.Attribute)):
+                            names.add(inner.func.attr)
+    return names
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Collect per-method facts: self-calls, self-attr mutations, and
+    the lexical lock state (`with self.<lock>:`) each happens under."""
+
+    def __init__(self, lock_attrs: set[str]):
+        self.lock_attrs = lock_attrs
+        self.locked = False
+        self.calls: list[tuple[str, bool]] = []        # (method, locked)
+        self.mutations: list[tuple[str, int, bool]] = []  # (attr, line, locked)
+        self.wraps_body = False  # whole body inside `with self._lock:`
+
+    def visit_With(self, node: ast.With) -> None:
+        takes_lock = any(
+            isinstance(item.context_expr, ast.Attribute)
+            and isinstance(item.context_expr.value, ast.Name)
+            and item.context_expr.value.id == "self"
+            and item.context_expr.attr in self.lock_attrs
+            for item in node.items)
+        if takes_lock and not self.locked:
+            self.locked = True
+            for stmt in node.body:
+                self.visit(stmt)
+            self.locked = False
+        else:
+            self.generic_visit(node)
+
+    visit_AsyncWith = visit_With
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # deferred bodies don't inherit the current lock scope; the
+        # scheduler-lambda rule in _callback_names covers methods they
+        # invoke, so don't scan them as if they ran here
+        pass
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass  # nested defs likewise run later, not here
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _self_attr(self, node: ast.expr) -> str | None:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        return None
+
+    def _mutation_target(self, target: ast.expr) -> tuple[str, int] | None:
+        attr = self._self_attr(target)
+        if attr is not None:
+            return attr, target.lineno
+        # self.x[k] = v / del self.x[k] mutate x
+        if isinstance(target, ast.Subscript):
+            attr = self._self_attr(target.value)
+            if attr is not None:
+                return attr, target.lineno
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                hit = self._mutation_target(elt)
+                if hit is not None:
+                    return hit
+        return None
+
+    def _record_targets(self, targets: list[ast.expr]) -> None:
+        for t in targets:
+            hit = self._mutation_target(t)
+            if hit is not None:
+                self.mutations.append((hit[0], hit[1], self.locked))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._record_targets(node.targets)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_targets([node.target])
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_targets([node.target])
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        self._record_targets(node.targets)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute):
+            recv = self._self_attr(node.func.value)
+            if recv is not None:
+                if node.func.attr in MUTATORS:
+                    self.mutations.append((recv, node.lineno, self.locked))
+                else:
+                    self.calls.append((node.func.attr, self.locked))
+        self.generic_visit(node)
+
+
+def _scan_class(src: SourceFile, cls: ast.ClassDef,
+                callbacks: set[str]) -> list[Finding]:
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    if not methods:
+        return []
+    if src.waived("lock-discipline", cls.lineno):
+        return []
+
+    # lock attributes: self.X = threading.Lock() / RLock() / ...
+    lock_attrs: set[str] = set()
+    for meth in methods.values():
+        for node in ast.walk(meth):
+            if not isinstance(node, ast.Assign):
+                continue
+            fn = node.value.func if isinstance(node.value, ast.Call) else None
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else "")
+            if name not in LOCK_FACTORIES:
+                continue
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    lock_attrs.add(t.attr)
+
+    is_protocol = any("Protocol" in ast.unparse(b) for b in cls.bases)
+    entries = sorted(
+        name for name, meth in methods.items()
+        if name in callbacks
+        or (is_protocol and name in PROTOCOL_OVERRIDES)
+        or src.thread_entry(meth.lineno))
+    if len(entries) < 2:
+        return []
+
+    scans: dict[str, _MethodScan] = {}
+    for name, meth in methods.items():
+        scan = _MethodScan(lock_attrs)
+        for stmt in meth.body:
+            scan.visit(stmt)
+        scans[name] = scan
+
+    # guarded-by annotations on any assignment to the attribute
+    guarded: set[str] = set()
+    for meth in methods.values():
+        for node in ast.walk(meth):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                            and src.guarded_by(t.lineno)):
+                        guarded.add(t.attr)
+
+    # BFS per entry with lock-state propagation through self-calls
+    per_attr_entries: dict[str, set[str]] = {}
+    unlocked_site: dict[str, tuple[str, int]] = {}  # attr -> (entry, line)
+    for entry in entries:
+        seen: set[tuple[str, bool]] = set()
+        work: list[tuple[str, bool]] = [(entry, False)]
+        while work:
+            name, locked = work.pop()
+            if (name, locked) in seen or name not in scans:
+                continue
+            seen.add((name, locked))
+            scan = scans[name]
+            for attr, line, mut_locked in scan.mutations:
+                eff = locked or mut_locked
+                per_attr_entries.setdefault(attr, set()).add(entry)
+                if not eff and attr not in unlocked_site:
+                    unlocked_site[attr] = (entry, line)
+            for callee, call_locked in scan.calls:
+                work.append((callee, locked or call_locked))
+
+    findings = []
+    for attr, from_entries in sorted(per_attr_entries.items()):
+        if (len(from_entries) < 2 or attr not in unlocked_site
+                or attr in guarded or attr in lock_attrs):
+            continue
+        entry, line = unlocked_site[attr]
+        findings.append(Finding(
+            rule="lock-discipline", path=src.path, line=line,
+            symbol=f"{cls.name}.{attr}",
+            message=(f"self.{attr} is mutated from entry points "
+                     f"{', '.join(sorted(from_entries))} but the mutation "
+                     f"reached from {entry} holds no lock "
+                     f"(annotate '# guarded-by: <lock>' if guarded by "
+                     f"other means)")))
+    return findings
+
+
+def check(project: Project) -> list[Finding]:
+    callbacks = _callback_names(project)
+    findings: list[Finding] = []
+    for src in project.files:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(_scan_class(src, node, callbacks))
+    return findings

@@ -1,0 +1,201 @@
+"""robustness-hygiene: failure paths that hide, hang, or grow.
+
+* ``swallow`` — an ``except``/``except Exception`` handler whose whole
+  body is ``pass``/``continue``/bare ``return``: the error vanishes
+  with no log line.  Either log it with context or waive with
+  ``# analysis: allow-swallow(<reason>)`` where dropping is the point
+  (e.g. one bad datagram must not kill the receive loop).
+* ``thread-join`` — a ``threading.Thread`` created neither
+  ``daemon=True`` nor ever ``.join()``-ed/daemonized in its scope:
+  node shutdown can hang on it.
+* ``socket-timeout`` — ``socket.socket()`` with no later
+  ``.settimeout()`` in scope, or ``socket.create_connection()`` with
+  no timeout argument: a dead peer blocks forever.
+* ``unbounded-queue`` — ``queue.Queue()``/``asyncio.Queue()`` without
+  ``maxsize``: backpressure-free buffering grows until OOM.
+* ``no-print`` — bare ``print()`` in ``eges_tpu/`` library code
+  (CLIs — ``__main__.py`` files — and ``parallel/multihost.py``'s
+  coordinator banners are exempt); library output goes through
+  ``utils.log`` so verbosity stays controllable.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from harness.analysis.core import Finding, Project, SourceFile
+
+PRINT_ALLOWED_SUFFIXES = ("__main__.py", "parallel/multihost.py")
+QUEUE_MODULES = frozenset({"queue", "asyncio", "multiprocessing", "mp"})
+QUEUE_NAMES = frozenset({"Queue", "SimpleQueue", "LifoQueue",
+                         "PriorityQueue"})
+
+
+def _is_noop_body(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass) or isinstance(stmt, ast.Continue):
+            continue
+        if isinstance(stmt, ast.Return) and (
+                stmt.value is None
+                or (isinstance(stmt.value, ast.Constant)
+                    and stmt.value.value is None)):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+def _catches_broadly(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    for node in [t] + (list(t.elts) if isinstance(t, ast.Tuple) else []):
+        if isinstance(node, ast.Name):
+            names.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.append(node.attr)
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _attr_call(node: ast.expr, receivers: frozenset[str] | None,
+               attrs: frozenset[str]) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr in attrs
+            and (receivers is None
+                 or (isinstance(node.value, ast.Name)
+                     and node.value.id in receivers)))
+
+
+def _scopes(tree: ast.Module):
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _scope_walk(scope: ast.AST):
+    """Walk a scope without descending into nested function scopes."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _var_used_with(scope: ast.AST, var: str,
+                   attrs: tuple[str, ...]) -> bool:
+    for node in ast.walk(scope):
+        if (isinstance(node, ast.Attribute) and node.attr in attrs
+                and isinstance(node.value, ast.Name)
+                and node.value.id == var):
+            return True
+    return False
+
+
+def _check_file(src: SourceFile, findings: list[Finding]) -> None:
+    in_library = src.path.startswith("eges_tpu/")
+    print_exempt = src.path.endswith(PRINT_ALLOWED_SUFFIXES)
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ExceptHandler):
+            if _catches_broadly(node) and _is_noop_body(node.body):
+                findings.append(Finding(
+                    rule="swallow", path=src.path, line=node.lineno,
+                    symbol="except",
+                    message="broad except handler silently swallows the "
+                            "exception — log it or waive with "
+                            "allow-swallow(<reason>)"))
+        elif (in_library and not print_exempt
+                and isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            findings.append(Finding(
+                rule="no-print", path=src.path, line=node.lineno,
+                symbol="print",
+                message="bare print() in library code — use utils.log"))
+
+    for scope in _scopes(src.tree):
+        for node in _scope_walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+
+            # threading.Thread(...) without daemon=True or a join
+            if _attr_call(node.func, frozenset({"threading"}),
+                          frozenset({"Thread"})) or (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id == "Thread"):
+                daemon = any(
+                    kw.arg == "daemon"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                    for kw in node.keywords)
+                if not daemon:
+                    var = _assigned_var(scope, node)
+                    if var is None or not _var_used_with(
+                            scope, var, ("join", "daemon")):
+                        findings.append(Finding(
+                            rule="thread-join", path=src.path,
+                            line=node.lineno, symbol="Thread",
+                            message="non-daemon thread is never joined "
+                                    "or daemonized — shutdown can hang"))
+
+            # socket.socket() / socket.create_connection()
+            elif _attr_call(node.func, frozenset({"socket", "_socket"}),
+                            frozenset({"socket", "create_connection"})):
+                if node.func.attr == "create_connection":
+                    has_timeout = len(node.args) >= 2 or any(
+                        kw.arg == "timeout" for kw in node.keywords)
+                else:
+                    var = _assigned_var(scope, node)
+                    has_timeout = var is not None and _var_used_with(
+                        scope, var, ("settimeout",))
+                if not has_timeout:
+                    findings.append(Finding(
+                        rule="socket-timeout", path=src.path,
+                        line=node.lineno, symbol=node.func.attr,
+                        message="socket created without a timeout — a "
+                                "dead peer blocks forever"))
+
+            # unbounded queue.Queue() and friends
+            elif (_attr_call(node.func, QUEUE_MODULES, QUEUE_NAMES)
+                    or (isinstance(node.func, ast.Name)
+                        and node.func.id in ("Queue", "SimpleQueue"))):
+                bounded = bool(node.args) or any(
+                    kw.arg == "maxsize" for kw in node.keywords)
+                if not bounded:
+                    findings.append(Finding(
+                        rule="unbounded-queue", path=src.path,
+                        line=node.lineno, symbol="Queue",
+                        message="queue created without maxsize — "
+                                "unbounded buffering"))
+
+
+def _assigned_var(scope: ast.AST, call: ast.Call) -> str | None:
+    """The name a constructor call is bound to (x = C() or `with C()
+    as x:`), if any, searched within the same scope."""
+    for node in _scope_walk(scope):
+        if isinstance(node, ast.Assign) and node.value is call:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    return t.id
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    return None  # instance attr: lifetime unknown here
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if (item.context_expr is call
+                        and isinstance(item.optional_vars, ast.Name)):
+                    return item.optional_vars.id
+    return None
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in project.files:
+        _check_file(src, findings)
+    return findings

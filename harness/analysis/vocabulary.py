@@ -1,0 +1,186 @@
+"""vocabulary-exhaustiveness: emit sites must use registered names.
+
+Three closed vocabularies, each declared once as a module-level
+frozenset so both humans and this checker read the same source of
+truth:
+
+* journal event types — ``EVENT_TYPES`` / ``BREAKDOWN_PHASES`` in
+  ``eges_tpu/utils/journal.py``; every ``journal.record("<type>")`` and
+  ``self._breakdown("<phase>")`` literal must be registered, and the
+  observatory's ``CONSUMED`` tuple must stay a subset;
+* metric families — ``METRIC_FAMILIES`` in ``eges_tpu/utils/metrics.py``;
+  every ``metrics.counter/gauge/meter/timer/histogram("<family>")``
+  (including the leading constant of f-string names and both arms of
+  conditional names; the family is the part before the ``;`` label
+  separator) must be registered, each family must be used with exactly
+  one metric kind, and registered families that no emit site uses are
+  flagged as stale;
+* RPC methods — ``RPC_METHODS`` in ``eges_tpu/rpc/server.py``; every
+  ``method == "<lit>"`` / ``method in (...)`` dispatch comparison must
+  be registered and every registered method must have a dispatch site
+  (``debug_*`` goes through a prefix dispatcher and is exempt).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from harness.analysis.core import Finding, Project
+
+JOURNAL_PATH = "eges_tpu/utils/journal.py"
+METRICS_PATH = "eges_tpu/utils/metrics.py"
+RPC_PATH = "eges_tpu/rpc/server.py"
+OBSERVATORY_PATH = "harness/observatory.py"
+
+METRIC_KINDS = frozenset({"counter", "gauge", "meter", "timer",
+                          "histogram"})
+
+
+def _str_consts(node: ast.expr) -> list[str]:
+    """Resolve a metric/event name expression to its literal value(s):
+    plain constant, both arms of a conditional, or the leading constant
+    of an f-string (the family part before any interpolated labels)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.IfExp):
+        return _str_consts(node.body) + _str_consts(node.orelse)
+    if isinstance(node, ast.JoinedStr) and node.values:
+        head = node.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return [head.value]
+    return []
+
+
+def _family(name: str) -> str:
+    return name.split(";", 1)[0]
+
+
+def _recv_is_metrics(node: ast.expr) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id == "metrics"
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("DEFAULT", "metrics")
+    return False
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    event_types = project.frozenset_literal(JOURNAL_PATH, "EVENT_TYPES")
+    phases = project.frozenset_literal(JOURNAL_PATH, "BREAKDOWN_PHASES")
+    families = project.frozenset_literal(METRICS_PATH, "METRIC_FAMILIES")
+    rpc_methods = project.frozenset_literal(RPC_PATH, "RPC_METHODS")
+
+    for name, value, path in (("EVENT_TYPES", event_types, JOURNAL_PATH),
+                              ("METRIC_FAMILIES", families, METRICS_PATH),
+                              ("RPC_METHODS", rpc_methods, RPC_PATH)):
+        if value is None and project.file(path) is not None:
+            findings.append(Finding(
+                rule="vocabulary", path=path, line=1, symbol=name,
+                message=f"{name} frozenset literal not found — the "
+                        "vocabulary must be declared in this module"))
+    if event_types is None or phases is None:
+        return findings
+
+    family_kinds: dict[str, set[str]] = {}
+    family_seen: dict[str, tuple[str, int]] = {}
+    dispatch_methods: dict[str, tuple[str, int]] = {}
+
+    for src in project.files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.Call, ast.Compare)):
+                continue
+
+            # journal.record("<type>") / self._breakdown("<phase>")
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                attr = node.func.attr
+                if (attr in ("record", "_record")
+                        and src.path != JOURNAL_PATH and node.args):
+                    for lit in _str_consts(node.args[0]):
+                        if lit not in event_types:
+                            findings.append(Finding(
+                                rule="vocabulary", path=src.path,
+                                line=node.lineno, symbol=lit,
+                                message=f'journal event "{lit}" is not '
+                                        "in EVENT_TYPES"))
+                elif attr == "_breakdown" and node.args:
+                    for lit in _str_consts(node.args[0]):
+                        if lit not in phases:
+                            findings.append(Finding(
+                                rule="vocabulary", path=src.path,
+                                line=node.lineno, symbol=lit,
+                                message=f'breakdown phase "{lit}" is '
+                                        "not in BREAKDOWN_PHASES"))
+                elif (attr in METRIC_KINDS and node.args
+                        and _recv_is_metrics(node.func.value)
+                        and src.path != METRICS_PATH):
+                    for lit in _str_consts(node.args[0]):
+                        fam = _family(lit)
+                        family_kinds.setdefault(fam, set()).add(attr)
+                        family_seen.setdefault(fam, (src.path,
+                                                     node.lineno))
+                        if families is not None and fam not in families:
+                            findings.append(Finding(
+                                rule="vocabulary", path=src.path,
+                                line=node.lineno, symbol=fam,
+                                message=f'metric family "{fam}" is not '
+                                        "in METRIC_FAMILIES"))
+
+            # dispatch comparisons: method == "lit" / method in (...)
+            if (isinstance(node, ast.Compare)
+                    and isinstance(node.left, ast.Name)
+                    and node.left.id == "method"
+                    and src.path == RPC_PATH):
+                lits: list[str] = []
+                for op, cmp in zip(node.ops, node.comparators):
+                    if isinstance(op, (ast.Eq, ast.NotEq)):
+                        lits.extend(_str_consts(cmp))
+                    elif isinstance(op, ast.In) and isinstance(
+                            cmp, (ast.Tuple, ast.List, ast.Set)):
+                        for elt in cmp.elts:
+                            lits.extend(_str_consts(elt))
+                for lit in lits:
+                    dispatch_methods.setdefault(lit, (src.path,
+                                                      node.lineno))
+                    if (rpc_methods is not None
+                            and lit not in rpc_methods
+                            and not lit.startswith("debug_")):
+                        findings.append(Finding(
+                            rule="vocabulary", path=src.path,
+                            line=node.lineno, symbol=lit,
+                            message=f'RPC method "{lit}" is dispatched '
+                                    "but not in RPC_METHODS"))
+
+    # one family, one kind
+    for fam, kinds in sorted(family_kinds.items()):
+        if len(kinds) > 1:
+            path, line = family_seen[fam]
+            findings.append(Finding(
+                rule="vocabulary", path=path, line=line, symbol=fam,
+                message=f'metric family "{fam}" is used as multiple '
+                        f"kinds: {', '.join(sorted(kinds))}"))
+
+    # registered but never emitted → stale vocabulary
+    if families is not None:
+        for fam in sorted(families - set(family_kinds)):
+            findings.append(Finding(
+                rule="vocabulary", path=METRICS_PATH, line=1, symbol=fam,
+                message=f'metric family "{fam}" is registered in '
+                        "METRIC_FAMILIES but never emitted"))
+    if rpc_methods is not None:
+        for meth in sorted(rpc_methods - set(dispatch_methods)):
+            findings.append(Finding(
+                rule="vocabulary", path=RPC_PATH, line=1, symbol=meth,
+                message=f'RPC method "{meth}" is registered in '
+                        "RPC_METHODS but has no dispatch comparison"))
+
+    # observatory consumes a subset of the journal vocabulary
+    consumed = project.frozenset_literal(OBSERVATORY_PATH, "CONSUMED")
+    if consumed is not None:
+        for lit in sorted(consumed - event_types):
+            findings.append(Finding(
+                rule="vocabulary", path=OBSERVATORY_PATH, line=1,
+                symbol=lit,
+                message=f'observatory CONSUMED event "{lit}" is not in '
+                        "EVENT_TYPES"))
+    return findings

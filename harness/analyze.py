@@ -1,0 +1,14 @@
+#!/usr/bin/env python3
+"""Shim so ``python harness/analyze.py`` works from a checkout without
+installing anything: puts the repo root on sys.path and delegates to
+``python -m harness.analysis``."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from harness.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

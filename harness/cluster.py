@@ -548,6 +548,7 @@ def loadtest(dirpath: str, n: int, seconds: float, *, n_udp=300,
                         to=bytes(20), value=0).signed(node_key(0))
         txh = rpc("eth_sendRawTransaction", ["0x" + t.encode().hex()])
         s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.settimeout(1.0)  # send-only UDP; never blocks, but bound anyway
         for i in range(n_udp):
             s.sendto(b"load payload %d" % i, ("127.0.0.1", TXN_BASE))
             time.sleep(0.005)

@@ -19,6 +19,7 @@ import time
 
 def run_rate(host: str, port: int, rate: float, size: int, count: int) -> None:
     sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.settimeout(1.0)  # send-only UDP; bounded just in case
     interval = 1.0 / rate if rate > 0 else 0
     sent = 0
     t0 = time.time()
@@ -36,6 +37,7 @@ def run_rate(host: str, port: int, rate: float, size: int, count: int) -> None:
 
 def run_interactive(host: str, port: int) -> None:
     sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.settimeout(1.0)  # send-only UDP; bounded just in case
     print(f"sending stdin lines to {host}:{port} (^D to stop)")
     for line in sys.stdin:
         data = line.rstrip("\n").encode()

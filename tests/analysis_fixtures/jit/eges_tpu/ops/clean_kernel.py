@@ -1,0 +1,19 @@
+"""jit-purity true negatives: static-shape casts fold at trace time and
+lru_cached helpers are host-side constant builders (tracers are
+unhashable, so they provably receive static arguments)."""
+
+import functools
+
+import jax
+import numpy as np
+
+
+@functools.lru_cache(maxsize=1)
+def _table():
+    return np.asarray([1, 2, 3])  # host builder: exempt via the cache
+
+
+@jax.jit
+def kernel(x):
+    n = int(x.shape[0])  # static: folds at trace time
+    return x * n + _table()[0]

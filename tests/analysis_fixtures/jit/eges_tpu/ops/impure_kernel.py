@@ -1,0 +1,22 @@
+"""Seeded jit-purity true positives: a host clock read inside a
+pallas_call-rooted kernel and a print inside a jitted function."""
+
+import time
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _impure_kernel(x_ref, o_ref):
+    t0 = time.time()  # host clock burned into the trace
+    o_ref[...] = x_ref[...] * t0
+
+
+def run(x):
+    return pl.pallas_call(_impure_kernel, out_shape=x)(x)
+
+
+@jax.jit
+def noisy_sum(x):
+    print("tracing")  # fires at trace time only
+    return x.sum()

@@ -1,0 +1,99 @@
+"""Seeded lock-discipline fixtures: one true positive (Racy.total), one
+fully-locked negative, one guarded-by annotation, one per-line waiver,
+and one class-line waiver.  Never imported — parsed by the analyzer."""
+
+import threading
+
+
+class Racy:
+    """TP: two concurrent entries mutate self.total with no lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counts = {}
+        self.total = 0
+
+    def start(self):
+        t = threading.Thread(target=self.on_packet, daemon=True)
+        t.start()
+        threading.Timer(0.1, self.on_tick).start()
+
+    def on_packet(self):
+        self.total += 1  # unlocked, reached from a thread entry
+
+    def on_tick(self):
+        with self._lock:
+            self.counts.update(tick=1)  # locked: not a finding
+        self.total += 1
+
+
+class Disciplined:
+    """TN: same shape, every mutation under the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def start(self):
+        threading.Thread(target=self.on_packet, daemon=True).start()
+        threading.Timer(0.1, self.on_tick).start()
+
+    def on_packet(self):
+        with self._lock:
+            self.total += 1
+
+    def on_tick(self):
+        with self._lock:
+            self.total += 1
+
+
+class LoopConfined:
+    """TN: unlocked mutations asserted safe via # guarded-by:."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0  # guarded-by: event-loop
+
+    def start(self):
+        threading.Thread(target=self.on_packet, daemon=True).start()
+        threading.Timer(0.1, self.on_tick).start()
+
+    def on_packet(self):
+        self.hits += 1
+
+    def on_tick(self):
+        self.hits += 1
+
+
+class LineWaived:
+    """Finding exists but is waived on the offending line."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def start(self):
+        threading.Thread(target=self.on_packet, daemon=True).start()
+        threading.Timer(0.1, self.on_tick).start()
+
+    def on_packet(self):
+        self.n += 1  # analysis: allow-lock-discipline(fixture waiver)
+
+    def on_tick(self):
+        self.n += 1  # analysis: allow-lock-discipline(fixture waiver)
+
+
+class ClassWaived:  # analysis: allow-lock-discipline(single-threaded double)
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def start(self):
+        threading.Thread(target=self.on_packet, daemon=True).start()
+        threading.Timer(0.1, self.on_tick).start()
+
+    def on_packet(self):
+        self.n += 1
+
+    def on_tick(self):
+        self.n += 1

@@ -1,0 +1,1 @@
+print("CLI banner: prints are allowed in __main__ entry points")

@@ -1,0 +1,60 @@
+"""Robustness-hygiene fixtures: one TP and one TN per sub-rule, plus a
+waived swallow."""
+
+import queue
+import socket
+import threading
+
+
+def swallow_tp(fn):
+    try:
+        fn()
+    except Exception:
+        pass
+
+
+def swallow_waived(fn):
+    try:
+        fn()
+    # analysis: allow-swallow(fixture: dropping is the point)
+    except Exception:
+        pass
+
+
+def swallow_tn(fn, log):
+    try:
+        fn()
+    except Exception as exc:
+        log.warning("fn failed: %r", exc)
+
+
+def thread_tp(fn):
+    threading.Thread(target=fn).start()
+
+
+def thread_joined_tn(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+
+
+def thread_daemon_tn(fn):
+    threading.Thread(target=fn, daemon=True).start()
+
+
+def socket_tp():
+    return socket.socket()
+
+
+def socket_tn():
+    s = socket.socket()
+    s.settimeout(1.0)
+    return s
+
+
+def queue_tp():
+    return queue.Queue()
+
+
+def queue_tn():
+    return queue.Queue(maxsize=64)

@@ -1,0 +1,10 @@
+"""Fixture emit sites: one good and one bad per vocabulary, plus a
+metric family used as two different kinds."""
+
+
+def run(journal, metrics):
+    journal.record("vote_cast", blk=1)
+    journal.record("mystery_event")  # not in EVENT_TYPES
+    metrics.counter("pool.pending").inc()
+    metrics.counter("pool.bogus").inc()  # not in METRIC_FAMILIES
+    metrics.gauge("pool.pending").set(1)  # kind conflict with counter

@@ -1,0 +1,13 @@
+"""Fixture RPC dispatch: eth_unknown is deliberately unregistered."""
+
+RPC_METHODS = frozenset({"eth_ping"})
+
+
+def dispatch(method):
+    if method == "eth_ping":
+        return "pong"
+    if method == "eth_unknown":
+        return None
+    if method == "debug_traceMe":  # debug_* routes via a prefix dispatcher
+        return None
+    return None

@@ -1,0 +1,5 @@
+"""Fixture vocabulary declarations (mirrors the real journal module)."""
+
+EVENT_TYPES = frozenset({"vote_cast", "block_committed"})
+
+BREAKDOWN_PHASES = frozenset({"election"})

@@ -1,0 +1,3 @@
+"""Fixture metric families: pool.flushed is deliberately stale."""
+
+METRIC_FAMILIES = frozenset({"pool.pending", "pool.flushed"})

@@ -1,0 +1,157 @@
+"""The static-analysis framework against its seeded fixtures.
+
+Each rule gets a true-positive, a true-negative, a waiver path, and the
+baseline path is exercised end-to-end (budget, staleness, justification
+required).  The last tests are the CI gate: the real tree must come out
+with zero unsuppressed findings, fast, via the same entry point CI runs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from harness.analysis import run  # noqa: E402
+from harness.analysis.core import BaselineError, save_baseline  # noqa: E402
+
+FIXTURES = os.path.join(REPO, "tests", "analysis_fixtures")
+
+
+def _run_fixture(tree, **kw):
+    kw.setdefault("baseline_path", None)
+    return run(os.path.join(FIXTURES, tree), **kw)
+
+
+# -- lock-discipline ------------------------------------------------------
+
+def test_lock_discipline_catches_seeded_race():
+    rep = _run_fixture("race", paths=("pkg",), rules=("lock-discipline",))
+    hits = {f.symbol for f in rep.unsuppressed}
+    assert "Racy.total" in hits, [f.render() for f in rep.findings]
+    # the locked dict and the annotated/locked classes stay clean
+    assert not any(s.startswith(("Disciplined.", "LoopConfined.",
+                                 "ClassWaived.")) for s in hits)
+    assert "Racy.counts" not in hits
+
+
+def test_lock_discipline_line_waiver():
+    rep = _run_fixture("race", paths=("pkg",), rules=("lock-discipline",))
+    waived = [f for f in rep.findings if f.waived]
+    assert any(f.symbol == "LineWaived.n" for f in waived)
+    assert not any(f.symbol == "LineWaived.n" for f in rep.unsuppressed)
+
+
+# -- jit-purity -----------------------------------------------------------
+
+def test_jit_purity_flags_seeded_clock_and_print():
+    rep = _run_fixture("jit", paths=("eges_tpu",), rules=("jit-purity",))
+    msgs = [f.message for f in rep.unsuppressed]
+    assert any("time.time()" in m for m in msgs), msgs
+    assert any("`print`" in m for m in msgs), msgs
+    # every finding names the jit/pallas root it was reached from
+    assert all("reached from" in m for m in msgs)
+
+
+def test_jit_purity_exempts_static_casts_and_cached_builders():
+    rep = _run_fixture("jit", paths=("eges_tpu",), rules=("jit-purity",))
+    clean = [f for f in rep.findings
+             if f.path.endswith("clean_kernel.py")]
+    assert clean == [], [f.render() for f in clean]
+
+
+# -- vocabulary -----------------------------------------------------------
+
+def test_vocabulary_flags_each_drift_mode():
+    rep = _run_fixture("vocab", paths=("eges_tpu",), rules=("vocabulary",))
+    by_symbol = {f.symbol: f.message for f in rep.unsuppressed}
+    assert "mystery_event" in by_symbol          # unregistered event
+    assert "pool.bogus" in by_symbol             # unregistered family
+    assert "multiple" in by_symbol["pool.pending"]  # counter+gauge clash
+    assert "never emitted" in by_symbol["pool.flushed"]  # stale entry
+    assert "eth_unknown" in by_symbol            # unregistered dispatch
+    # registered uses and the debug_* prefix dispatcher stay clean
+    assert "vote_cast" not in by_symbol
+    assert "eth_ping" not in by_symbol
+    assert "debug_traceMe" not in by_symbol
+
+
+# -- robustness-hygiene ---------------------------------------------------
+
+def test_robustness_tp_tn_and_waiver_per_subrule():
+    rep = _run_fixture("robust", paths=("pkg", "eges_tpu"))
+    un = rep.unsuppressed
+    lines = {f.rule: f for f in un}
+    assert set(lines) == {"swallow", "thread-join", "socket-timeout",
+                          "unbounded-queue", "no-print"}
+    # exactly one unsuppressed finding per rule: the TNs stayed quiet
+    assert len(un) == 5, [f.render() for f in un]
+    assert any(f.waived and f.rule == "swallow" for f in rep.findings)
+    assert lines["no-print"].path.endswith("lib.py")  # __main__ exempt
+
+
+# -- baseline layer -------------------------------------------------------
+
+def test_baseline_budget_staleness_and_justification(tmp_path):
+    root = os.path.join(FIXTURES, "robust")
+    rep = run(root, paths=("pkg",), rules=("swallow",), baseline_path=None)
+    assert len(rep.unsuppressed) == 1
+
+    # a generated baseline absorbs the finding but demands justification
+    bl = str(tmp_path / "baseline.json")
+    save_baseline(bl, rep.unsuppressed)
+    with pytest.raises(BaselineError, match="justification"):
+        run(root, paths=("pkg",), rules=("swallow",), baseline_path=bl)
+
+    entries = json.load(open(bl))
+    for e in entries:
+        e["justification"] = "fixture: intentional drop"
+    extra = dict(entries[0], path="pkg/gone.py",
+                 justification="stale on purpose")
+    json.dump(entries + [extra], open(bl, "w"))
+
+    rep2 = run(root, paths=("pkg",), rules=("swallow",), baseline_path=bl)
+    assert rep2.unsuppressed == []
+    assert sum(1 for f in rep2.findings if f.baselined) == 1
+    # the unmatched entry is reported stale, and the budget is per
+    # occurrence: one entry cannot hide two findings
+    assert [e["path"] for e in rep2.stale_baseline] == ["pkg/gone.py"]
+
+
+# -- the CI gate over the real tree --------------------------------------
+
+def test_repo_tree_has_zero_unsuppressed_findings():
+    rep = run(REPO)
+    assert rep.errors == [], rep.errors
+    assert rep.unsuppressed == [], "\n".join(
+        f.render() for f in rep.unsuppressed)
+    assert rep.stale_baseline == [], rep.stale_baseline
+    assert rep.elapsed_s < 10.0  # the "fast enough to gate CI" budget
+
+
+def test_cli_gate_exit_codes_and_summary(tmp_path):
+    summary = str(tmp_path / "history.jsonl")
+    proc = subprocess.run(
+        [sys.executable, "-m", "harness.analysis", "--json",
+         "--summary", summary],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["summary"]["unsuppressed"] == 0
+    # the JSONL trend line carries per-rule counts, like bench_history
+    line = json.loads(open(summary).read().strip())
+    assert set(line["findings_by_rule"]) >= {"lock-discipline",
+                                             "jit-purity", "vocabulary",
+                                             "swallow", "no-print"}
+
+    # seeded regression: the same CLI exits non-zero on a dirty tree
+    proc = subprocess.run(
+        [sys.executable, "-m", "harness.analysis", "--root",
+         os.path.join(FIXTURES, "robust"), "--no-baseline", "pkg"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
