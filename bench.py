@@ -339,6 +339,80 @@ def _cpu_baseline() -> float | None:
         return None
 
 
+def _coalesced_stage() -> dict | None:
+    """Coalesced-path stage: 8 concurrent submitters drive the verifier
+    scheduler (``crypto/scheduler.py``) over the native host verifier and
+    the stage reports the EFFECTIVE occupancy (dispatched rows / padded
+    bucket rows) plus the sender-recovery cache hit rate.
+
+    Runs in the PARENT on purpose: the scheduler and
+    ``NativeBatchVerifier`` import no JAX, and what this stage measures —
+    how well the micro-window turns per-caller single verifies into full
+    buckets — is backend-independent.  None when the native lib (or the
+    pure-Python fallback it rides on) can't sign the workload."""
+    import threading
+
+    try:
+        from eges_tpu.crypto import native
+        from eges_tpu.crypto import secp256k1 as host
+        from eges_tpu.crypto.scheduler import VerifierScheduler
+        from eges_tpu.crypto.verify_host import NativeBatchVerifier
+
+        n_threads, uniq, reverify = 8, 48, 16
+        entries = []
+        for i in range(n_threads * uniq):
+            msg = (i + 1).to_bytes(4, "big") * 8
+            priv = bytes([(i % 200) + 7]) * 32
+            sig = (native.ec_sign(msg, priv) if native.available()
+                   else host.ecdsa_sign(msg, priv))
+            entries.append((msg, sig))
+
+        sched = VerifierScheduler(NativeBatchVerifier(), window_ms=2.0,
+                                  max_batch=256)
+        barrier = threading.Barrier(n_threads)
+        failures = []
+        t0 = time.monotonic()
+
+        def submitter(k: int) -> None:
+            barrier.wait()  # all 8 callers hit the window together
+            mine = entries[k * uniq:(k + 1) * uniq]
+            # second pass re-verifies a slice of the NEIGHBOUR's rows —
+            # the gossip pattern the recovery cache exists for
+            j = ((k + 1) % n_threads) * uniq
+            for part in (mine, entries[j:j + reverify]):
+                futs = [sched.submit(h, s) for h, s in part]
+                for f in futs:
+                    if f.result(60) is None:
+                        failures.append(k)
+
+        threads = [threading.Thread(target=submitter, args=(k,))
+                   for k in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(90)
+        sched.close()
+        dt = time.monotonic() - t0
+
+        st = sched.stats()
+        lookups = st["cache_hits"] + st["cache_misses"]
+        return {
+            "submitters": n_threads,
+            "submitted": n_threads * (uniq + reverify),
+            "rows": st["rows"],
+            "batches": st["batches"],
+            "singleton_diverted": st["host_diverted"],
+            "effective_occupancy":
+                round(st["rows"] / max(st["bucket_rows"], 1), 3),
+            "cache_hit_rate":
+                round(st["cache_hits"] / max(lookups, 1), 3),
+            "verify_failures": len(failures),
+            "elapsed_s": round(dt, 2),
+        }
+    except Exception:
+        return None
+
+
 def _spawn(kind: str, deadline: float, max_batch: int) -> subprocess.Popen:
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -362,6 +436,9 @@ def main() -> None:
 
     measured = _cpu_baseline()
     denom = max(measured or 0.0, REF_CLASS_CPU_PER_S)
+    # backend-independent scheduler stage, measured up front in the
+    # parent so it rides every later line (including the fail line)
+    coalesced = _coalesced_stage()
 
     best: dict = {}      # kind -> best stage result for that backend
     # kind -> {batch(str): {p50_ms, p99_ms}} — every stage's tails, not
@@ -389,6 +466,8 @@ def main() -> None:
             "elapsed_s": round(time.monotonic() - t_start, 1),
         }
         out.update(_provenance())
+        if coalesced:
+            out["coalesced"] = dict(coalesced)
         if probe_state:
             out["tpu_probe"] = dict(probe_state)
         if "tpu" not in best:
@@ -529,6 +608,7 @@ def main() -> None:
             "metric": "secp256k1_ecrecover_verifies_per_sec_per_chip",
             "value": 0.0, "unit": "verifies/s", "vs_baseline": 0.0,
             "error": "no backend produced a result within budget",
+            "coalesced": coalesced,
             "tpu_probe": dict(probe_state),
             "watcher_tpu_capture": _watcher_capture(),
             "cpu_baseline_measured_per_s":

@@ -93,6 +93,12 @@ class GeecNode:
         from eges_tpu.utils.journal import Journal
         self.journal = Journal(node=self.coinbase.hex()[:8],
                                clock=clock.now)
+        # a VerifierScheduler (crypto/scheduler.py) journals its flush
+        # decisions; a cluster-shared scheduler lands in the stream of
+        # the FIRST node that adopts it (the device owner's view)
+        if verifier is not None and \
+                getattr(verifier, "journal", b"") is None:
+            verifier.journal = self.journal
         self.elections_won = 0
         self.elections_lost = 0
         self._last_commit_t = clock.now()
@@ -200,7 +206,13 @@ class GeecNode:
 
     def _verify_single(self, sighash: bytes, sig: bytes,
                        author: bytes) -> bool:
-        """One-off signature check (candidacies, proposals, confirms)."""
+        """One-off signature check (candidacies, proposals, confirms).
+
+        With a VerifierScheduler wired (sim cluster / node service),
+        ``recover_signers`` delegates into its cache + coalescing
+        window, so a lone check is a cache hit (gossip re-delivery), a
+        row in someone else's batch, or one host recover — never the
+        padded 1-row device dispatch this path used to cost."""
         if not self._signing:
             return True
         if len(sig) != 65:
@@ -210,9 +222,10 @@ class GeecNode:
 
     def _recover_entries(self, entries) -> list:
         """Recover the signer of each ``(author, sighash, sig)`` entry in
-        ONE verifier batch; per-entry result is the claimed author when
-        the signature checks out, else None.  With signing off every
-        entry passes."""
+        ONE verifier batch (or one scheduler window, where the cache
+        strips already-seen votes before the device sees them); per-entry
+        result is the claimed author when the signature checks out, else
+        None.  With signing off every entry passes."""
         if not self._signing:
             return [a for a, _, _ in entries]
         from eges_tpu.crypto.verify_host import recover_signers

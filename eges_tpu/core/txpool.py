@@ -18,8 +18,6 @@ from __future__ import annotations
 
 import threading
 
-import numpy as np
-
 from eges_tpu.core.types import Transaction
 from eges_tpu.utils import tracing
 
@@ -119,24 +117,17 @@ class TxPool:
         parts = [t.signature_parts() for t in batch]
         senders: list[bytes | None] = [None] * len(batch)
         rows = [(i, p) for i, p in enumerate(parts) if p is not None]
-        if rows and self.verifier is not None:
-            sigs = np.zeros((len(rows), 65), np.uint8)
-            hashes = np.zeros((len(rows), 32), np.uint8)
-            for k, (_, (sig, h)) in enumerate(rows):
-                sigs[k] = np.frombuffer(sig, np.uint8)
-                hashes[k] = np.frombuffer(h, np.uint8)
-            addrs, ok = self.verifier.recover_addresses(sigs, hashes)
-            for k, (i, _) in enumerate(rows):
-                if ok[k]:
-                    senders[i] = bytes(addrs[k])
-        elif rows:
-            from eges_tpu.crypto.verify_host import _count_host_rows
-            _count_host_rows(len(rows))
-            for i, _ in rows:
-                try:
-                    senders[i] = batch[i].sender()
-                except ValueError:
-                    pass
+        if rows:
+            # one shared recovery path for all three verifier shapes:
+            # a VerifierScheduler (window coalescing across callers +
+            # the sender cache, so a re-gossiped txn costs a lookup),
+            # a plain batch verifier (one device batch), or None (the
+            # per-entry host fallback, signature_nocgo.go role)
+            from eges_tpu.crypto.verify_host import recover_signers
+            rec = recover_signers([(h, sig) for _, (sig, h) in rows],
+                                  self.verifier)
+            for (i, _), sender in zip(rows, rec):
+                senders[i] = sender
         for t, sender in zip(batch, senders):
             if sender is None:
                 self.stats["rejected"] += 1

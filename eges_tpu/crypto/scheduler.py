@@ -1,0 +1,389 @@
+"""Asynchronous coalescing verifier scheduler with a sender-recovery cache.
+
+Every consensus/txpool call site used to drive the batch verifier
+synchronously — including one-row dispatches per candidacy/registration
+message that got padded to a 16-row bucket and still paid full dispatch
+plus transfer cost.  This layer sits between those callers and the
+device facade (:class:`~eges_tpu.crypto.verifier.BatchVerifier` or the
+JAX-free :class:`~eges_tpu.crypto.verify_host.NativeBatchVerifier`):
+
+* callers :meth:`submit` ``(sighash, sig)`` requests and get futures;
+* a background dispatch thread coalesces concurrent requests across
+  callers (txpool sender recovery + vote quorums + single-message
+  checks) into ONE device batch per micro-window — flushed when the
+  bucket fills, when the deadline measured from the oldest pending
+  entry expires, or when a synchronous caller *kicks* the window;
+* an LRU ``(sighash, sig) -> address-or-None`` recovery cache makes
+  gossip re-delivery and commit-time re-verification free — the role
+  split the reference implements host-side as the concurrent sender
+  cacher + signature LRU (ref: core/tx_cacher.go:45 txSenderCacher,
+  core/types/transaction_signing.go:42 sigCache via Transaction.from);
+* a flush that coalesced down to a single row is diverted to the host
+  recovery path instead of the device: a padded 1-row device dispatch
+  costs more than one native recover, and diverting keeps
+  ``verifier.singleton_batches`` at zero in steady state.
+
+This module must stay importable WITHOUT JAX (same contract as
+``verify_host.py``): the bench parent and host-fallback node processes
+construct schedulers around native verifiers.
+
+Thread model: ``submit``/``kick``/``close`` arrive on any caller thread
+(RPC workers, the sim clock thread, consensus dispatch); the flush loop
+runs on one daemon thread.  Every mutable field is guarded by the one
+condition ``self._lock``; the dispatch thread calls only the backing
+verifier outside it, never a caller's lock — so it can never deadlock
+against the node/txpool lock domain.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import Future
+
+import numpy as np
+
+# sentinel distinguishing "cached None" (a signature that verifiably
+# fails recovery) from "not cached"
+_MISS = object()
+
+
+def _bucket16(n: int) -> int:
+    """The device bucket model (power of two, minimum 16) used to score
+    occupancy when the backing verifier exposes no ``_pad`` of its own
+    (e.g. the native verifier, which does not pad at all)."""
+    b = 16
+    while b < n:
+        b *= 2
+    return b
+
+
+class VerifierScheduler:
+    """Coalescing dispatch front-end over a batch verifier.
+
+    Facade-compatible with the verifier it wraps: ``recover_addresses``
+    / ``recover_signers`` / ``ecrecover`` / ``verify`` all exist, so the
+    chain, txpool, EVM precompile, and consensus node can hold a
+    scheduler wherever they previously held a ``BatchVerifier``.
+    """
+
+    def __init__(self, verifier, *, window_ms: float = 2.0,
+                 max_batch: int = 1024, cache_size: int = 4096):
+        self._verifier = verifier
+        self._window_s = window_ms / 1e3
+        self.max_batch = max_batch
+        self.cache_size = cache_size
+        # ONE condition guards every mutable field below; the dispatch
+        # thread waits on it for work / deadline / kick.
+        self._lock = threading.Condition()
+        # LRU recovery cache: (sighash, sig) -> 20-byte address or None
+        # (a deterministic recovery failure is cached too — re-gossiped
+        # garbage must not re-reach the device either)
+        self._cache: OrderedDict[tuple, object] = OrderedDict()
+        # key -> ([futures], t_submit): identical in-flight keys share
+        # one row (in-batch dedup), arrival order preserved
+        self._pending: OrderedDict[tuple, list] = OrderedDict()
+        self._kick = False
+        self._closed = False
+        self._thread: threading.Thread | None = None
+        self._stats = {
+            "cache_hits": 0, "cache_misses": 0, "coalesced_rows": 0,
+            "batches": 0, "rows": 0, "bucket_rows": 0, "host_diverted": 0,
+            "kicks": 0, "flush_full": 0, "flush_deadline": 0,
+            "flush_kick": 0, "flush_close": 0, "invalid": 0,
+        }
+        # optional consensus event journal (utils/journal.py), attached
+        # by the first owning node; flush decisions land in its stream
+        self.journal = None
+
+    # -- public async API -------------------------------------------------
+
+    def submit(self, sighash: bytes, sig: bytes) -> Future:  # thread-entry
+        """Queue one ``(sighash32, sig65)`` recovery; the future resolves
+        to the 20-byte signer address, or ``None`` for an invalid
+        signature.  Cache hits resolve immediately; misses ride the next
+        coalesced batch."""
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+
+        fut: Future = Future()
+        if len(sig) != 65 or len(sighash) != 32:
+            # malformed entries never reach the device (the zero-fill
+            # rows of verify_host.recover_signers recover as invalid —
+            # same observable result, no batch slot burned)
+            with self._lock:
+                self._stats["invalid"] += 1
+            fut.set_result(None)
+            return fut
+        key = (bytes(sighash), bytes(sig))
+        resolve = _MISS
+        with self._lock:
+            hit = self._cache.get(key, _MISS)
+            if hit is not _MISS:
+                self._cache.move_to_end(key)
+                self._stats["cache_hits"] += 1
+                resolve = hit
+            elif self._closed:
+                # post-close stragglers execute inline on the caller —
+                # the contract is "no lost futures", not "no work"
+                self._stats["cache_misses"] += 1
+                resolve = self._host_recover(key)
+                self._cache_put(key, resolve)
+            else:
+                self._stats["cache_misses"] += 1
+                row = self._pending.get(key)
+                if row is not None:
+                    # in-flight dedup: same signature already queued by
+                    # another caller — share its batch row
+                    row[0].append(fut)
+                    self._stats["coalesced_rows"] += 1
+                else:
+                    self._pending[key] = [[fut], time.monotonic()]
+                    self._ensure_thread()
+                if len(self._pending) >= self.max_batch:
+                    self._kick = True
+                self._lock.notify_all()
+        if resolve is not _MISS:
+            metrics.counter("verifier.cache_hits" if hit is not _MISS
+                            else "verifier.cache_misses").inc()
+            fut.set_result(resolve)
+            return fut
+        metrics.counter("verifier.cache_misses").inc()
+        return fut
+
+    def kick(self) -> None:  # thread-entry
+        """Flush the current micro-window immediately: synchronous
+        callers (quorum tallies under the virtual-time sim clock) must
+        not sleep out the real-time deadline."""
+        with self._lock:
+            if self._pending:
+                self._kick = True
+                self._stats["kicks"] += 1
+                self._lock.notify_all()
+
+    # -- synchronous facades (BatchVerifier-compatible) -------------------
+
+    def recover_signers(self, entries) -> list:
+        """Batch-recover ``(sighash32, sig65)`` entries; one 20-byte
+        address or ``None`` per entry.  Submits everything, kicks the
+        window (coalescing with whatever else is pending right now), and
+        blocks for the results — ``verify_host.recover_signers``
+        delegates here when the node's verifier is a scheduler."""
+        futs = [self.submit(h, s) for h, s in entries]
+        self.kick()
+        return [f.result() for f in futs]
+
+    def recover_addresses(self, sigs: np.ndarray, hashes: np.ndarray):
+        """Array-in/array-out facade matching
+        ``BatchVerifier.recover_addresses`` so the txpool window flush,
+        block body validation, and the EVM ecrecover precompile route
+        through the cache/coalescer unchanged."""
+        n = sigs.shape[0]
+        addrs = np.zeros((n, 20), np.uint8)
+        ok = np.zeros((n,), bool)
+        if n == 0:
+            return addrs, ok
+        rec = self.recover_signers(
+            [(bytes(hashes[i]), bytes(sigs[i])) for i in range(n)])
+        for i, r in enumerate(rec):
+            if r is not None:
+                addrs[i] = np.frombuffer(r, np.uint8)
+                ok[i] = True
+        return addrs, ok
+
+    def ecrecover(self, sigs: np.ndarray, hashes: np.ndarray):
+        """Full-pubkey recovery delegates straight to the backing
+        verifier: the cache stores addresses only (the sigCache role),
+        and the sole ``pubs`` consumer is the startup warmup."""
+        return self._verifier.ecrecover(sigs, hashes)
+
+    def verify(self, sigs: np.ndarray, hashes: np.ndarray,
+               pubs: np.ndarray):
+        """Classic known-pubkey verify is not address recovery — pass
+        through to the backing verifier's batched path."""
+        return self._verifier.verify(sigs, hashes, pubs)
+
+    # -- lifecycle --------------------------------------------------------
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def close(self, timeout: float | None = 30.0) -> None:  # thread-entry
+        """Drain every pending future, then stop and join the dispatch
+        thread — no lost futures, no leaked thread."""
+        with self._lock:
+            self._closed = True
+            self._kick = True
+            self._lock.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    def stats(self) -> dict:
+        """Snapshot of scheduler counters (tests and the bench stage
+        read deltas here instead of the process-global registry)."""
+        with self._lock:
+            out = dict(self._stats)
+            out["cached_entries"] = len(self._cache)
+            out["pending"] = len(self._pending)
+        return out
+
+    # -- internals --------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        # caller holds self._lock
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._dispatch_loop, name="verifier-scheduler",
+                daemon=True)
+            self._thread.start()
+
+    def _cache_put(self, key: tuple, addr) -> None:
+        # caller holds self._lock
+        self._cache[key] = addr
+        self._cache.move_to_end(key)
+        while len(self._cache) > self.cache_size:
+            self._cache.popitem(last=False)
+
+    def _host_recover(self, key: tuple):
+        """One host-path recovery (native C++ single recover when built,
+        pure-Python model otherwise) — the divert target for flushes
+        that coalesced down to a single row, and the post-close inline
+        path.  Counts into ``verifier.host_rows`` like every other host
+        fallback so the device-share metric stays honest."""
+        h, sig = key
+        from eges_tpu.crypto.verify_host import _count_host_rows
+        _count_host_rows(1)
+        from eges_tpu.crypto import native
+        if native.available():
+            from eges_tpu.crypto.keccak import keccak256
+            pubs, okb = native.ec_recover_batch(h, sig, 1)
+            return keccak256(pubs[:64])[12:] if okb[0] else None
+        from eges_tpu.crypto import secp256k1 as host
+        try:
+            return host.recover_address(h, sig)
+        # analysis: allow-swallow(invalid signature maps to a None result)
+        except Exception:
+            return None
+
+    def _dispatch_loop(self) -> None:
+        """Background flush loop: wait for work, coalesce inside the
+        micro-window, dispatch ONE batch, repeat.  Exits only once
+        closed AND drained."""
+        while True:
+            with self._lock:
+                while not self._pending and not self._closed:
+                    self._lock.wait()
+                if not self._pending and self._closed:
+                    return
+                # coalescing window: more submitters may land until the
+                # bucket fills, a sync caller kicks, close drains, or
+                # the deadline measured from the OLDEST entry expires
+                while (len(self._pending) < self.max_batch
+                        and not self._kick and not self._closed
+                        and self._pending):
+                    oldest = next(iter(self._pending.values()))[1]
+                    left = self._window_s - (time.monotonic() - oldest)
+                    if left <= 0:
+                        break
+                    self._lock.wait(left)
+                if not self._pending:
+                    continue
+                reason = ("full" if len(self._pending) >= self.max_batch
+                          else "kick" if self._kick
+                          else "close" if self._closed else "deadline")
+                self._stats["flush_" + reason] += 1
+                keys = list(self._pending)[: self.max_batch]
+                batch = [(k, self._pending.pop(k)) for k in keys]
+                if not self._pending:
+                    self._kick = False
+            self._run_batch(batch, reason)
+
+    def _run_batch(self, batch, reason: str) -> None:
+        """Dispatch one coalesced batch OUTSIDE the scheduler lock (the
+        device call is the long pole; submitters keep queueing into the
+        next window meanwhile)."""
+        from eges_tpu.utils import tracing
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+
+        t0 = time.monotonic()
+        rows = len(batch)
+        keys = [k for k, _ in batch]
+        results = [None] * rows
+        try:
+            if rows == 1:
+                # singleton divert: a padded 1-row device dispatch costs
+                # more than one native recover — keep the device for
+                # real batches and verifier.singleton_batches at zero
+                results[0] = self._host_recover(keys[0])
+                with self._lock:
+                    self._stats["host_diverted"] += 1
+            else:
+                sigs = np.zeros((rows, 65), np.uint8)
+                hashes = np.zeros((rows, 32), np.uint8)
+                for i, (h, sig) in enumerate(keys):
+                    sigs[i] = np.frombuffer(sig, np.uint8)
+                    hashes[i] = np.frombuffer(h, np.uint8)
+                try:
+                    addrs, ok = self._verifier.recover_addresses(sigs,
+                                                                 hashes)
+                    results = [bytes(addrs[i]) if ok[i] else None
+                               for i in range(rows)]
+                # analysis: allow-swallow(device failure falls back to the
+                # host model so queued futures still resolve correctly)
+                except Exception:
+                    results = [self._host_recover(k) for k in keys]
+            dt = time.monotonic() - t0
+            pad = getattr(self._verifier, "_pad", _bucket16)
+            bucket = pad(rows) if rows > 1 else 1  # diverted rows pad nothing
+            waited = t0 - min(t for _, (_, t) in batch)
+            with self._lock:
+                for k, r in zip(keys, results):
+                    self._cache_put(k, r)
+                self._stats["batches"] += 1
+                self._stats["rows"] += rows
+                self._stats["bucket_rows"] += bucket
+            for _, (_, t_sub) in batch:
+                metrics.histogram("verifier.sched_queue_wait_seconds") \
+                    .observe(t0 - t_sub)
+            metrics.histogram("verifier.sched_batch_rows").observe(rows)
+            metrics.histogram("verifier.sched_occupancy") \
+                .observe(rows / bucket)
+            tracing.DEFAULT.record_span(
+                "verifier.sched_dispatch", dt, rows=rows, bucket=bucket,
+                reason=reason, occupancy=round(rows / bucket, 4),
+                waited_ms=round(waited * 1e3, 3))
+            journal = self.journal
+            if journal is not None:
+                journal.record("verifier_flush", rows=rows, reason=reason,
+                               occupancy=round(rows / bucket, 4),
+                               waited_ms=round(waited * 1e3, 3))
+        finally:
+            # futures resolve even if the instrumentation path raises —
+            # a blocked recover_signers caller is a wedged consensus node
+            for (_, (futs, _)), r in zip(batch, results):
+                for f in futs:
+                    f.set_result(r)
+
+
+def scheduler_for(verifier, **kwargs) -> VerifierScheduler | None:
+    """Attach (or reuse) the scheduler for a verifier object.
+
+    The scheduler rides as an attribute on the verifier itself, so every
+    component holding the same device facade — all sim-cluster nodes,
+    the chain, the txpool — shares one coalescing window and one
+    recovery cache, and the pair is garbage-collected together.  ``None``
+    (host-fallback mode) passes through: those nodes keep the per-entry
+    host path.
+    """
+    if verifier is None:
+        return None
+    if isinstance(verifier, VerifierScheduler):
+        return verifier
+    sched = getattr(verifier, "_eges_scheduler", None)
+    if sched is None or sched.closed:
+        sched = VerifierScheduler(verifier, **kwargs)
+        verifier._eges_scheduler = sched
+    return sched

@@ -49,6 +49,11 @@ class NativeBatchVerifier:
         ok = np.zeros((n,), bool)
         if n == 0:
             return addrs, ok
+        if n == 1:
+            # same steady-state anti-goal as the device facade: one-row
+            # batches mean some caller bypassed the scheduler's
+            # coalescer/cache (the cluster sim asserts this stays ~0)
+            metrics.counter("verifier.singleton_batches").inc()
         t0 = time.monotonic()
         if native.available():
             pubs, okb = native.ec_recover_batch(
@@ -113,6 +118,13 @@ def batch_verify_txns(txns, verifier) -> bool:
         except ValueError:
             return False
         return True
+    if hasattr(verifier, "recover_signers"):
+        # a VerifierScheduler: entries ride the coalescing window and
+        # the sender cache — the acceptor-ACK check and the insert-path
+        # body validation (the two sites below) verify the SAME block's
+        # signatures, so the second site becomes pure cache hits
+        rec = verifier.recover_signers([(h, sig) for sig, h in parts])
+        return all(r is not None for r in rec)
     sigs = np.zeros((len(parts), 65), np.uint8)
     hashes = np.zeros((len(parts), 32), np.uint8)
     for i, (sig, h) in enumerate(parts):
@@ -143,6 +155,10 @@ def recover_signers(entries, verifier) -> list:
             except Exception:
                 out.append(None)
         return out
+    if hasattr(verifier, "recover_signers"):
+        # a VerifierScheduler front-end: per-entry cache hits + cross-
+        # caller coalescing replace the dedicated one-shot device batch
+        return verifier.recover_signers(entries)
     sigs = np.zeros((len(entries), 65), np.uint8)
     hashes = np.zeros((len(entries), 32), np.uint8)
     for i, (h, sig) in enumerate(entries):

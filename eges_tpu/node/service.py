@@ -123,6 +123,16 @@ class NodeService:
             from eges_tpu.crypto.verify_host import NativeBatchVerifier
             verifier = NativeBatchVerifier()
         self._verifier_mode = mode
+        # the coalescing scheduler + sender-recovery cache fronts the
+        # device for every consumer below (chain body validation, the
+        # consensus node's vote paths, the txpool flush): concurrent RPC
+        # submissions and consensus checks merge into one device batch
+        # per micro-window, and commit-time re-verification of gossiped
+        # signatures becomes a cache hit
+        self._raw_verifier = verifier
+        if verifier is not None:
+            from eges_tpu.crypto.scheduler import scheduler_for
+            verifier = scheduler_for(verifier)
 
         os.makedirs(cfg.datadir, exist_ok=True)
         store = FileStore(os.path.join(cfg.datadir, "chaindata"))
@@ -268,20 +278,21 @@ class NodeService:
     async def start(self) -> None:
         from eges_tpu.utils.debug import install_sigusr1
         install_sigusr1()  # kill -USR1 dumps stacks (pprof-dump parity)
-        if self._verifier_mode == "jax" and self.chain.verifier is not None:
-            # warm the smallest verify graph NOW: the first jit compile
+        if self._verifier_mode == "jax" and self._raw_verifier is not None:
+            # warm the smallest recover graph NOW: the first jit compile
             # can take minutes on a small host, and letting it happen
             # lazily inside a consensus message handler wedges the event
             # loop mid-election (diagnosed via the SIGUSR1 dump); the
-            # persistent cache makes later runs instant
+            # persistent cache makes later runs instant.  The next few
+            # buckets compile on a background thread — off the critical
+            # path, so the first non-trivial block doesn't stall either.
             import time as _t
 
-            import numpy as _np
             t0 = _t.monotonic()
-            self.chain.verifier.ecrecover(_np.zeros((1, 65), _np.uint8),
-                                          _np.zeros((1, 32), _np.uint8))
+            self._raw_verifier.prewarm(buckets=(16,), background=False)
             self.log.geec("verifier warmup",
                           dt=round(_t.monotonic() - t0, 1))
+            self._raw_verifier.prewarm(buckets=(32, 64, 128))
         await self.direct.start()
         await self.gossip.start()
         if self.discovery is not None:
@@ -362,6 +373,11 @@ class NodeService:
         if self.rpc is not None:
             self.rpc.close()
         self.node.stop()
+        if self.chain.verifier is not None and \
+                hasattr(self.chain.verifier, "close"):
+            # drain the scheduler's pending futures and join its
+            # dispatch thread before the transports go away
+            self.chain.verifier.close()
         self.txpool.close()
         self.gossip.close()
         self.direct.close()

@@ -41,6 +41,15 @@ class SimCluster:
         self.net = SimNet(self.clock, seed=seed, drop_rate=drop_rate)
         self.nodes: list[SimNode] = []
 
+        # every node shares ONE coalescing scheduler + recovery cache
+        # around the supplied verifier (crypto/scheduler.py): the same
+        # vote signature verified by N sim nodes costs one device row
+        # and N-1 cache hits.  verifier=None (host fallback) passes
+        # through untouched.
+        from eges_tpu.crypto.scheduler import scheduler_for
+        verifier = scheduler_for(verifier)
+        self.verifier = verifier
+
         if n_bootstrap is None:
             n_bootstrap = n_nodes
         from eges_tpu.crypto.keys import deterministic_node_key

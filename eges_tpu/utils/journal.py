@@ -56,6 +56,9 @@ EVENT_TYPES = frozenset({
     "deferred_drain",
     # txpool <-> chain coupling
     "txns_included",
+    # verifier scheduler (crypto/scheduler.py): one coalesced dispatch
+    # window flushed to the device or host-diverted
+    "verifier_flush",
 })
 
 # The registered ``_breakdown`` phase vocabulary (consensus/node.py);

@@ -48,6 +48,11 @@ METRIC_FAMILIES = frozenset({
     "verifier.h2d_seconds", "verifier.host_rows", "verifier.native",
     "verifier.native_batches", "verifier.native_rows",
     "verifier.pad_waste", "verifier.padded_rows", "verifier.rows",
+    # crypto/scheduler.py — coalescing scheduler + sender-recovery cache
+    "verifier.cache_hits", "verifier.cache_misses",
+    "verifier.prewarmed_buckets", "verifier.sched_batch_rows",
+    "verifier.sched_occupancy", "verifier.sched_queue_wait_seconds",
+    "verifier.singleton_batches",
 })
 
 

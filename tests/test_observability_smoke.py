@@ -62,8 +62,11 @@ def test_metrics_endpoint_serves_verifier_histograms():
 
     # one real device batch populates the verifier histogram families
     # (single-device facade: the mesh path needs jax.shard_map, broken
-    # on this jax version — see test_ring_parallel)
-    v = BatchVerifier()
+    # on this jax version — see test_ring_parallel).  debug_timing
+    # re-enables the H2D/compute sync that feeds the h2d/d2h split
+    # histograms — without it upload and compute overlap and only the
+    # aggregate device timer is published.
+    v = BatchVerifier(debug_timing=True)
     v.ecrecover(np.zeros((1, 65), np.uint8), np.zeros((1, 32), np.uint8))
 
     chain = BlockChain(genesis=make_genesis())
