@@ -335,6 +335,15 @@ class GeecNode:
             # malformed datagram from a peer must not kill the loop
             self._log("malformed gossip", nbytes=len(data), err=repr(exc))
             return
+        try:
+            self._dispatch_gossip(code, msg)
+        except Exception as exc:
+            # a datagram that unpacks but whose payload fails deeper
+            # decode/auth (bit-flip corruption) is a peer-supplied input:
+            # reject it, never crash the node (DoS-resistance contract)
+            self._log("gossip handler rejected", code=code, err=repr(exc))
+
+    def _dispatch_gossip(self, code: int, msg) -> None:
         if code == M.GOSSIP_VALIDATE_REQ:
             self._handle_validate_request(msg)
         elif code == M.GOSSIP_QUERY:
@@ -370,6 +379,14 @@ class GeecNode:
             # malformed/unauthenticated datagram: drop, but leave a trace
             self._log("malformed direct", nbytes=len(data), err=repr(exc))
             return
+        try:
+            self._dispatch_direct(code, msg)
+        except Exception as exc:
+            # same contract as the gossip plane: corrupted-but-unpackable
+            # payloads get rejected by the handler, not fatal
+            self._log("direct handler rejected", code=code, err=repr(exc))
+
+    def _dispatch_direct(self, code: int, msg) -> None:
         if code == M.UDP_ELECT:
             self._handle_elect_message(msg)
         elif code == M.UDP_EXAMINE_REPLY:
@@ -1806,6 +1823,16 @@ class GeecNode:
                     and self.mine):
                 me = self.membership.get(self.coinbase)
                 self._start_registration(renew=me.renewed_times + 1)
+            elif self.coinbase not in self.membership and self.registered:
+                # our own TTL ran out — typically discovered while
+                # replaying blocks missed behind a partition, where the
+                # renewal window passed unseen (ref: the node-expiry
+                # path, core/geec_state.go:706,1088).  Clear the stale
+                # registered flag and rejoin from scratch so the heal
+                # ends in clean re-registration, not a silent zombie.
+                self.registered = False
+                if self.mine and self.transport is not None:
+                    self._start_registration(renew=0)
 
     # ------------------------------------------------------------------
     # registration (ref: Register geec_state.go:706-757)
@@ -1831,7 +1858,11 @@ class GeecNode:
         if self.registered and reg.renew == 0:
             return
         self._append_reg_req(reg)  # local pending list too
-        self.transport.gossip(M.pack_gossip(M.GOSSIP_REGISTER_REQ, reg))
+        if self.transport is not None:
+            # transport is None only during construction-time replay
+            # (a restarted node re-discovering a pending renewal); the
+            # timer below re-sends once the node is live on the net
+            self.transport.gossip(M.pack_gossip(M.GOSSIP_REGISTER_REQ, reg))
         self._set_timer("register", self.ccfg.reg_timeout_s,
                         lambda: self._registration_tick(reg, attempt + 1))
 

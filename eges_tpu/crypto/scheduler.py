@@ -69,11 +69,26 @@ class VerifierScheduler:
     """
 
     def __init__(self, verifier, *, window_ms: float = 2.0,
-                 max_batch: int = 1024, cache_size: int = 4096):
+                 max_batch: int = 1024, cache_size: int = 4096,
+                 breaker_cooldown_s: float = 5.0, breaker_clock=None):
         self._verifier = verifier
         self._window_s = window_ms / 1e3
         self.max_batch = max_batch
         self.cache_size = cache_size
+        # injectable device-failure hook (chaos harness / tests): called
+        # with the row count right before every device dispatch; raising
+        # is treated exactly like the device itself raising
+        self.failure_hook = None
+        # circuit breaker around the device path: a device exception
+        # trips it OPEN (every window host-diverts, no device calls) for
+        # ``breaker_cooldown_s``; the first window after the cooldown is
+        # a HALF-OPEN probe — success closes the breaker, failure
+        # re-opens it.  ``breaker_clock`` is injectable so chaos runs
+        # can measure the cooldown in deterministic virtual time.
+        self.breaker_cooldown_s = breaker_cooldown_s
+        self.breaker_clock = breaker_clock or time.monotonic
+        self._breaker = "closed"          # "closed" | "open"
+        self._breaker_until = 0.0
         # ONE condition guards every mutable field below; the dispatch
         # thread waits on it for work / deadline / kick.
         self._lock = threading.Condition()
@@ -92,6 +107,8 @@ class VerifierScheduler:
             "batches": 0, "rows": 0, "bucket_rows": 0, "host_diverted": 0,
             "kicks": 0, "flush_full": 0, "flush_deadline": 0,
             "flush_kick": 0, "flush_close": 0, "invalid": 0,
+            "device_errors": 0, "breaker_trips": 0, "breaker_probes": 0,
+            "breaker_diverted": 0,
         }
         # optional consensus event journal (utils/journal.py), attached
         # by the first owning node; flush decisions land in its stream
@@ -171,7 +188,16 @@ class VerifierScheduler:
         delegates here when the node's verifier is a scheduler."""
         futs = [self.submit(h, s) for h, s in entries]
         self.kick()
-        return [f.result() for f in futs]
+        out = []
+        for (h, s), f in zip(entries, futs):
+            try:
+                out.append(f.result())
+            # analysis: allow-swallow(a torn-down scheduler fails futures
+            # with an error; consensus keeps committing on the host path)
+            except Exception:
+                out.append(self._host_recover((bytes(h), bytes(s)))
+                           if len(s) == 65 and len(h) == 32 else None)
+        return out
 
     def recover_addresses(self, sigs: np.ndarray, hashes: np.ndarray):
         """Array-in/array-out facade matching
@@ -212,7 +238,9 @@ class VerifierScheduler:
 
     def close(self, timeout: float | None = 30.0) -> None:  # thread-entry
         """Drain every pending future, then stop and join the dispatch
-        thread — no lost futures, no leaked thread."""
+        thread — no lost futures, no leaked thread.  If the dispatch
+        thread died (or the join times out), whatever is still pending
+        is failed with an error rather than left to hang callers."""
         with self._lock:
             self._closed = True
             self._kick = True
@@ -220,6 +248,14 @@ class VerifierScheduler:
             t = self._thread
         if t is not None:
             t.join(timeout)
+        with self._lock:
+            leftovers = list(self._pending.values())
+            self._pending.clear()
+        for futs, _t in leftovers:
+            for f in futs:
+                if not f.done():
+                    f.set_exception(RuntimeError(
+                        "verifier scheduler closed with unresolved futures"))
 
     def stats(self) -> dict:
         """Snapshot of scheduler counters (tests and the bench stage
@@ -228,6 +264,7 @@ class VerifierScheduler:
             out = dict(self._stats)
             out["cached_entries"] = len(self._cache)
             out["pending"] = len(self._pending)
+            out["breaker"] = self._breaker
         return out
 
     # -- internals --------------------------------------------------------
@@ -269,6 +306,23 @@ class VerifierScheduler:
             return None
 
     def _dispatch_loop(self) -> None:
+        """Wrapper keeping the strand-no-future invariant: if the flush
+        loop itself dies on an unexpected error, every queued future is
+        failed with that error instead of hanging its caller forever
+        (``_ensure_thread`` restarts a thread on the next submit)."""
+        try:
+            self._dispatch_forever()
+        except BaseException as exc:
+            with self._lock:
+                leftovers = list(self._pending.values())
+                self._pending.clear()
+            for futs, _t in leftovers:
+                for f in futs:
+                    if not f.done():
+                        f.set_exception(exc)
+            raise
+
+    def _dispatch_forever(self) -> None:
         """Background flush loop: wait for work, coalesce inside the
         micro-window, dispatch ONE batch, repeat.  Exits only once
         closed AND drained."""
@@ -299,7 +353,55 @@ class VerifierScheduler:
                 batch = [(k, self._pending.pop(k)) for k in keys]
                 if not self._pending:
                     self._kick = False
-            self._run_batch(batch, reason)
+            try:
+                self._run_batch(batch, reason)
+            # the batch's futures were already resolved or failed inside
+            # _run_batch's finally; the loop survives to the next window
+            # analysis: allow-swallow(futures already resolved/failed in _run_batch finally)
+            except Exception:
+                pass
+
+    def _breaker_admits(self) -> tuple[bool, bool]:
+        """(use_device, probing): closed -> dispatch normally; open ->
+        host-divert until the cooldown elapses, then admit ONE half-open
+        probe window."""
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+        with self._lock:
+            if self._breaker == "closed":
+                return True, False
+            if self.breaker_clock() >= self._breaker_until:
+                self._stats["breaker_probes"] += 1
+                probe = True
+            else:
+                return False, False
+        metrics.counter("verifier.breaker_probes").inc()
+        return True, probe
+
+    def _breaker_trip(self, probing: bool) -> None:
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+        with self._lock:
+            self._stats["device_errors"] += 1
+            self._stats["breaker_trips"] += 1
+            self._breaker = "open"
+            self._breaker_until = self.breaker_clock() \
+                + self.breaker_cooldown_s
+        metrics.counter("verifier.device_errors").inc()
+        metrics.counter("verifier.breaker_trips").inc()
+        metrics.gauge("verifier.breaker_state").set(1)
+        journal = self.journal
+        if journal is not None:
+            journal.record("fault_breaker", state="open",
+                           probe=bool(probing),
+                           cooldown_s=self.breaker_cooldown_s)
+
+    def _breaker_close(self) -> None:
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+        with self._lock:
+            self._breaker = "closed"
+        metrics.gauge("verifier.breaker_state").set(0)
+        journal = self.journal
+        if journal is not None:
+            journal.record("fault_breaker", state="closed")
 
     def _run_batch(self, batch, reason: str) -> None:
         """Dispatch one coalesced batch OUTSIDE the scheduler lock (the
@@ -312,6 +414,8 @@ class VerifierScheduler:
         rows = len(batch)
         keys = [k for k, _ in batch]
         results = [None] * rows
+        computed = False
+        failure: BaseException | None = None
         try:
             if rows == 1:
                 # singleton divert: a padded 1-row device dispatch costs
@@ -321,20 +425,38 @@ class VerifierScheduler:
                 with self._lock:
                     self._stats["host_diverted"] += 1
             else:
-                sigs = np.zeros((rows, 65), np.uint8)
-                hashes = np.zeros((rows, 32), np.uint8)
-                for i, (h, sig) in enumerate(keys):
-                    sigs[i] = np.frombuffer(sig, np.uint8)
-                    hashes[i] = np.frombuffer(h, np.uint8)
-                try:
-                    addrs, ok = self._verifier.recover_addresses(sigs,
-                                                                 hashes)
-                    results = [bytes(addrs[i]) if ok[i] else None
-                               for i in range(rows)]
-                # analysis: allow-swallow(device failure falls back to the
-                # host model so queued futures still resolve correctly)
-                except Exception:
+                use_device, probing = self._breaker_admits()
+                if not use_device:
+                    # breaker open: the device is presumed dead — the
+                    # whole window takes the host recover path so
+                    # consensus keeps committing
                     results = [self._host_recover(k) for k in keys]
+                    with self._lock:
+                        self._stats["breaker_diverted"] += rows
+                else:
+                    sigs = np.zeros((rows, 65), np.uint8)
+                    hashes = np.zeros((rows, 32), np.uint8)
+                    for i, (h, sig) in enumerate(keys):
+                        sigs[i] = np.frombuffer(sig, np.uint8)
+                        hashes[i] = np.frombuffer(h, np.uint8)
+                    try:
+                        hook = self.failure_hook
+                        if hook is not None:
+                            hook(rows)
+                        addrs, ok = self._verifier.recover_addresses(
+                            sigs, hashes)
+                        results = [bytes(addrs[i]) if ok[i] else None
+                                   for i in range(rows)]
+                        if probing:
+                            self._breaker_close()
+                    # analysis: allow-swallow(a device exception diverts
+                    # exactly this window to the host model — the queued
+                    # futures still resolve correctly — and trips the
+                    # circuit breaker for the windows after it)
+                    except Exception:
+                        self._breaker_trip(probing)
+                        results = [self._host_recover(k) for k in keys]
+            computed = True
             dt = time.monotonic() - t0
             pad = getattr(self._verifier, "_pad", _bucket16)
             bucket = pad(rows) if rows > 1 else 1  # diverted rows pad nothing
@@ -360,12 +482,24 @@ class VerifierScheduler:
                 journal.record("verifier_flush", rows=rows, reason=reason,
                                occupancy=round(rows / bucket, 4),
                                waited_ms=round(waited * 1e3, 3))
+        except BaseException as exc:
+            failure = exc
+            raise
         finally:
             # futures resolve even if the instrumentation path raises —
-            # a blocked recover_signers caller is a wedged consensus node
+            # a blocked recover_signers caller is a wedged consensus
+            # node.  If the batch died before results were computed,
+            # its futures FAIL with that error rather than masquerading
+            # as None ("invalid signature").
             for (_, (futs, _)), r in zip(batch, results):
                 for f in futs:
-                    f.set_result(r)
+                    if f.done():
+                        continue
+                    if computed:
+                        f.set_result(r)
+                    else:
+                        f.set_exception(failure or RuntimeError(
+                            "verifier batch dispatch failed"))
 
 
 def scheduler_for(verifier, **kwargs) -> VerifierScheduler | None:

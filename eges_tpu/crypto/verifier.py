@@ -171,6 +171,16 @@ class BatchVerifier:
         # interleave writes into one buffer mid-upload.
         self._stage_bufs: dict[int, dict[str, np.ndarray]] = {}
         self._staging_lock = threading.Lock()
+        # injectable device-failure hook (fault injection): called with
+        # the row count at the head of every device entry point; raising
+        # here models the accelerator dying mid-flush — the scheduler's
+        # circuit breaker is the production consumer of that signal
+        self.failure_hook = None
+
+    def _maybe_fail(self, n: int) -> None:
+        hook = self.failure_hook
+        if hook is not None:
+            hook(n)
 
     def _staging(self, b: int, with_pubs: bool = False) -> dict:
         # caller holds self._staging_lock
@@ -261,6 +271,7 @@ class BatchVerifier:
         if n == 0:
             return (np.zeros((0, 20), np.uint8), np.zeros((0, 64), np.uint8),
                     np.zeros((0,), bool))
+        self._maybe_fail(n)
         b = self._pad(n)
         cached = b in self._compiled_buckets
         self._compiled_buckets.add(b)
@@ -301,6 +312,7 @@ class BatchVerifier:
         n = sigs.shape[0]
         if n == 0:
             return np.zeros((0,), bool)
+        self._maybe_fail(n)
         b = self._pad(n)
         cached = b in self._verify_buckets
         self._verify_buckets.add(b)

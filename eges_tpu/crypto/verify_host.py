@@ -37,6 +37,12 @@ class NativeBatchVerifier:
     rows only, plus ``verifier.batched_share`` for the routing share
     either batch path achieves."""
 
+    def __init__(self):
+        # injectable failure hook, same contract as BatchVerifier's:
+        # called with the row count before dispatch; raising models the
+        # backing implementation dying (fault-injection test surface)
+        self.failure_hook = None
+
     def recover_addresses(self, sigs, hashes):
         import time
 
@@ -49,6 +55,9 @@ class NativeBatchVerifier:
         ok = np.zeros((n,), bool)
         if n == 0:
             return addrs, ok
+        hook = self.failure_hook
+        if hook is not None:
+            hook(n)
         if n == 1:
             # same steady-state anti-goal as the device facade: one-row
             # batches mean some caller bypassed the scheduler's

@@ -15,7 +15,7 @@ from eges_tpu.consensus.config import BootstrapNode, ChainGeecConfig, NodeConfig
 from eges_tpu.consensus.node import GeecNode
 from eges_tpu.core.chain import BlockChain, make_genesis
 from eges_tpu.crypto import secp256k1 as secp
-from eges_tpu.sim.simnet import SimClock, SimNet
+from eges_tpu.sim.simnet import SimClock, SimNet, SkewedClock
 
 
 @dataclass
@@ -25,6 +25,8 @@ class SimNode:
     addr: bytes
     chain: BlockChain
     node: GeecNode
+    clock: SkewedClock = None   # per-node (skewable) view of the clock
+    crashed: bool = False
 
 
 class SimCluster:
@@ -68,6 +70,16 @@ class SimCluster:
         genesis = make_genesis(alloc=alloc)
 
         self._deferred: set[int] = set(defer or ())
+        self._ccfg = ccfg
+        self._mine = mine
+        self._txpool = txpool
+        self._alloc = alloc
+        # crashed nodes' journal history, preserved across the rebuild
+        # so the observatory sees one continuous per-node stream
+        self._archived: dict[str, list] = {}
+        # chaos harness attaches its fault-injector journal here; it
+        # rides journals() under the synthetic "faults" node name
+        self.fault_journal = None
         for i in range(n_nodes):
             name = f"node{i}"
             ncfg = NodeConfig(
@@ -78,14 +90,15 @@ class SimCluster:
                 total_nodes=n_nodes, failure_test=failure_test,
                 privkey=privs[i] if signed else b"",
                 fast_sync=bool(fast_sync and i in fast_sync))
+            node_clock = SkewedClock(self.clock)
             chain = BlockChain(genesis=genesis, verifier=verifier,
                                alloc=alloc)
-            node = GeecNode(chain, self.clock, None, ncfg, ccfg,
+            node = GeecNode(chain, node_clock, None, ncfg, ccfg,
                             mine=(mine[i] if mine is not None else True),
                             verifier=verifier)
             if txpool:
                 from eges_tpu.core.txpool import TxPool
-                node.txpool = TxPool(self.clock, verifier=verifier)
+                node.txpool = TxPool(node_clock, verifier=verifier)
             if i not in self._deferred:
                 # deferred nodes (late joiners) stay OFF the network —
                 # no transport join, no gossip — until start_deferred()
@@ -94,7 +107,8 @@ class SimCluster:
                                           node.on_gossip, node.on_direct)
                 node.transport = transport
             self.nodes.append(SimNode(name=name, priv=privs[i],
-                                      addr=addrs[i], chain=chain, node=node))
+                                      addr=addrs[i], chain=chain, node=node,
+                                      clock=node_clock))
 
     def start(self) -> None:
         for i, sn in enumerate(self.nodes):
@@ -113,6 +127,50 @@ class SimCluster:
             sn.node.on_gossip, sn.node.on_direct)
         sn.node.start()
 
+    def crash(self, i: int) -> None:
+        """Tear a node down mid-run: cancel its timers, detach it from
+        the chain, unbind it from both network planes.  Its BlockChain
+        (the "datadir") survives for :meth:`restart` to replay."""
+        sn = self.nodes[i]
+        assert not sn.crashed, f"{sn.name} already crashed"
+        sn.node.stop()
+        sn.chain.remove_listener(sn.node._on_new_block)
+        self.net.leave(sn.name)
+        # keep the dead node's journal history for the observatory merge
+        self._archived.setdefault(sn.name, []).extend(
+            sn.node.journal.events())
+        # a cluster-shared scheduler journaling into this node's stream
+        # re-attaches to whichever node adopts it next
+        if self.verifier is not None and \
+                getattr(self.verifier, "journal", None) is sn.node.journal:
+            self.verifier.journal = None
+        sn.crashed = True
+
+    def restart(self, i: int) -> None:
+        """Rebuild a crashed node from its surviving chain — the same
+        restart-replay path a real process takes on boot (GeecNode's
+        constructor re-ingests every canonical block with the journal
+        gated off), then rejoin both planes and start."""
+        sn = self.nodes[i]
+        assert sn.crashed, f"{sn.name} is not crashed"
+        ncfg = sn.node.cfg
+        node = GeecNode(sn.chain, sn.clock, None, ncfg, self._ccfg,
+                        mine=(self._mine[i] if self._mine is not None
+                              else True),
+                        verifier=self.verifier)
+        if self._txpool:
+            from eges_tpu.core.txpool import TxPool
+            node.txpool = TxPool(sn.clock, verifier=self.verifier)
+        node.transport = self.net.join(sn.name, ncfg.consensus_ip,
+                                       ncfg.consensus_port,
+                                       node.on_gossip, node.on_direct)
+        sn.node = node
+        sn.crashed = False
+        node.start()
+
+    def live_nodes(self) -> list[SimNode]:
+        return [sn for sn in self.nodes if not sn.crashed]
+
     def run(self, seconds: float, stop_condition=None) -> None:
         self.clock.run_until(self.clock.now() + seconds, stop_condition)
 
@@ -122,8 +180,22 @@ class SimCluster:
     def min_height(self) -> int:
         return min(self.heights())
 
+    def net_stats(self) -> dict:
+        """SimNet delivery counters (gossip/direct/dropped/dead_letter/
+        corrupted/duplicated/reordered) for the cluster report."""
+        return dict(self.net.stats)
+
     def journals(self) -> dict[str, list[dict]]:
         """Per-node consensus event journals, keyed by sim node name —
         the live-poll source ``harness/observatory.py`` merges (the
-        RPC-less analogue of hitting ``thw_journal`` on every node)."""
-        return {sn.name: sn.node.journal.events() for sn in self.nodes}
+        RPC-less analogue of hitting ``thw_journal`` on every node).
+        Crashed-then-restarted nodes contribute their archived pre-crash
+        events plus the rebuilt node's stream; an attached fault
+        injector's journal rides along as the "faults" node."""
+        out = {}
+        for sn in self.nodes:
+            out[sn.name] = (self._archived.get(sn.name, [])
+                            + sn.node.journal.events())
+        if self.fault_journal is not None:
+            out["faults"] = self.fault_journal.events()
+        return out

@@ -1,0 +1,268 @@
+"""Deterministic fault injection over the simulated cluster.
+
+The reference repo drove robustness testing with three shell scripts —
+``kill.py`` (SIGKILL a geth by index), ``re-start.py`` (relaunch it on
+the surviving datadir) and ``start.py`` (cluster bring-up) — run by hand
+against a real cluster while ``grep.py`` scraped the logs.  This module
+is that workflow made deterministic and composable: a :class:`FaultPlan`
+is a timestamped script of fault actions, and a :class:`FaultInjector`
+arms it against a :class:`~eges_tpu.sim.cluster.SimCluster` on the
+virtual clock, so an entire kill/partition/corruption storm replays
+bit-identically from its seed.
+
+Actions (all virtual-time stamped, freely composable):
+
+* ``crash`` / ``restart`` — tear a node down and rebuild it from its
+  surviving chain (the GeecNode constructor replay path — the
+  ``re-start.py`` analogue);
+* ``partition`` / ``heal`` — symmetric cut of both planes;
+* ``block_link`` / ``heal_link`` / ``set_link`` — ONE direction of a
+  link (``A -> B`` drops while ``B -> A`` flows), with per-link
+  loss/latency/corruption/duplication/reorder overrides;
+* ``set_net`` — net-wide loss/jitter/corruption/duplication/reorder;
+* ``skew`` — offset one node's local oscillator;
+* ``kill_leader`` — a leader-targeted trigger: watch every node's
+  journal for ``election_won`` and crash the winner the moment the
+  event lands (optionally restarting it a fixed delay later).
+
+Every executed action is recorded in the injector's own journal (the
+synthetic ``faults`` node in ``SimCluster.journals()``) so the
+observatory renders the fault timeline next to the consensus events it
+caused.
+"""
+
+from __future__ import annotations
+
+from eges_tpu.utils.journal import Journal
+
+#: action kinds a FaultPlan accepts (anything else raises at add time,
+#: mirroring the journal's closed event vocabulary)
+ACTION_KINDS = frozenset({
+    "crash", "restart", "partition", "heal", "block_link", "heal_link",
+    "set_link", "set_net", "skew", "kill_leader",
+})
+
+
+class FaultPlan:
+    """A timestamped, composable script of fault actions.
+
+    Builder-style: every method returns ``self`` so plans read as one
+    chained scenario description::
+
+        plan = (FaultPlan()
+                .set_net(2.0, drop_rate=0.2, jitter_s=0.05)
+                .block_link(2.0, "node2", "node1")
+                .kill_leader(1.0, restart_after=20.0)
+                .heal_all(90.0))
+    """
+
+    def __init__(self):
+        self.actions: list[tuple[float, int, str, dict]] = []
+
+    def add(self, t: float, kind: str, **kw) -> "FaultPlan":
+        if kind not in ACTION_KINDS:
+            raise ValueError(f"unknown fault action kind: {kind!r}")
+        # (t, insertion-seq) keys give same-timestamp actions a stable,
+        # scripted order — determinism must not hinge on sort stability
+        self.actions.append((float(t), len(self.actions), kind, kw))
+        return self
+
+    # -- sugar ----------------------------------------------------------
+
+    def crash(self, t: float, node: str) -> "FaultPlan":
+        return self.add(t, "crash", node=node)
+
+    def restart(self, t: float, node: str) -> "FaultPlan":
+        return self.add(t, "restart", node=node)
+
+    def partition(self, t: float, node: str) -> "FaultPlan":
+        return self.add(t, "partition", node=node)
+
+    def heal(self, t: float, node: str) -> "FaultPlan":
+        return self.add(t, "heal", node=node)
+
+    def block_link(self, t: float, src: str, dst: str) -> "FaultPlan":
+        return self.add(t, "block_link", src=src, dst=dst)
+
+    def heal_link(self, t: float, src: str, dst: str) -> "FaultPlan":
+        return self.add(t, "heal_link", src=src, dst=dst)
+
+    def set_link(self, t: float, src: str, dst: str, **ov) -> "FaultPlan":
+        return self.add(t, "set_link", src=src, dst=dst, overrides=ov)
+
+    def set_net(self, t: float, **fields) -> "FaultPlan":
+        return self.add(t, "set_net", fields=fields)
+
+    def skew(self, t: float, node: str, skew_s: float) -> "FaultPlan":
+        return self.add(t, "skew", node=node, skew_s=skew_s)
+
+    def kill_leader(self, t: float, times: int = 1,
+                    restart_after: float | None = None) -> "FaultPlan":
+        """Arm the leader-targeted trigger at ``t``: the next ``times``
+        ``election_won`` events each get their winner crashed on the
+        spot; ``restart_after`` (seconds after the kill) brings each
+        victim back via the restart-replay path."""
+        return self.add(t, "kill_leader", times=times,
+                        restart_after=restart_after)
+
+    def heal_all(self, t: float) -> "FaultPlan":
+        """Clear every net-wide knob, link rule and partition at ``t`` —
+        the "then heal" step every recovery scenario ends with."""
+        return self.add(t, "set_net", fields={
+            "drop_rate": 0.0, "corrupt_rate": 0.0, "duplicate_rate": 0.0,
+            "reorder_rate": 0.0}).add(t, "heal_link", src=None, dst=None) \
+            .add(t, "heal", node=None)
+
+
+class FaultInjector:
+    """Arms a :class:`FaultPlan` against a live :class:`SimCluster`.
+
+    All actions execute as virtual-clock callbacks; the injector's own
+    :class:`~eges_tpu.utils.journal.Journal` (registered as
+    ``cluster.fault_journal``) records one ``fault_*`` event per
+    executed action, timestamped in virtual time, so two same-seed runs
+    dump byte-identical fault timelines.
+    """
+
+    def __init__(self, cluster, journal: Journal | None = None):
+        self.cluster = cluster
+        self.journal = journal or Journal(node="faults",
+                                          clock=cluster.clock.now)
+        cluster.fault_journal = self.journal
+        self._idx = {sn.name: i for i, sn in enumerate(cluster.nodes)}
+        # node journals are keyed by coinbase prefix, sim nodes by name
+        self._by_journal = {sn.addr.hex()[:8]: i
+                            for i, sn in enumerate(cluster.nodes)}
+        # leader-kill trigger state
+        self._kill_budget = 0
+        self._kill_restart_after: float | None = None
+        self._armed = False
+        self.fired: list[dict] = []   # executed actions, for tests
+
+    # -- plan scheduling ------------------------------------------------
+
+    def apply(self, plan: FaultPlan) -> None:
+        """Schedule every plan action on the cluster's virtual clock
+        (times are absolute virtual seconds; past times fire on the next
+        tick)."""
+        now = self.cluster.clock.now()
+        for t, _seq, kind, kw in sorted(plan.actions,
+                                        key=lambda a: (a[0], a[1])):
+            self.cluster.clock.call_later(
+                max(t - now, 0.0),
+                (lambda k, a: lambda: self._fire(k, a))(kind, kw))
+
+    def fire_now(self, kind: str, **kw) -> None:
+        """Execute one action immediately (block-driven scenarios that
+        cannot pre-compute the virtual time of a phase change, e.g.
+        "heal once the TTL actually expired").  Journaled and counted
+        exactly like a scheduled action."""
+        self._fire(kind, kw)
+
+    def _fire(self, kind: str, kw: dict) -> None:
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+
+        getattr(self, "_do_" + kind)(**kw)
+        metrics.counter("sim.faults_injected").inc()
+        self.fired.append({"t": self.cluster.clock.now(),
+                           "kind": kind, **kw})
+
+    # -- actions --------------------------------------------------------
+
+    def _do_crash(self, node: str) -> None:
+        i = self._idx[node]
+        if self.cluster.nodes[i].crashed:
+            return
+        self.journal.record("fault_crash", target=node)
+        self.cluster.crash(i)
+
+    def _do_restart(self, node: str) -> None:
+        i = self._idx[node]
+        if not self.cluster.nodes[i].crashed:
+            return
+        self.journal.record("fault_restart", target=node)
+        self.cluster.restart(i)
+        if self._armed:
+            # the rebuilt node has a fresh journal: re-attach the
+            # leader-kill tap or its next election win goes unseen
+            self.cluster.nodes[i].node.journal.on_record = self._tap
+
+    def _do_partition(self, node: str) -> None:
+        self.journal.record("fault_partition", target=node)
+        self.cluster.net.partition(node)
+
+    def _do_heal(self, node: str | None) -> None:
+        names = ([node] if node is not None
+                 else sorted(self.cluster.net._partitioned))
+        for name in names:
+            self.journal.record("fault_heal", target=name)
+            self.cluster.net.heal(name)
+
+    def _do_block_link(self, src: str, dst: str) -> None:
+        self.journal.record("fault_link", src=src, dst=dst, change="block")
+        self.cluster.net.block_link(src, dst)
+
+    def _do_heal_link(self, src: str | None, dst: str | None) -> None:
+        if src is None or dst is None:
+            # heal_all leg: drop every rule
+            for s, d in sorted(self.cluster.net._links):
+                self.journal.record("fault_link", src=s, dst=d,
+                                    change="clear")
+                self.cluster.net.clear_link(s, d)
+            return
+        self.journal.record("fault_link", src=src, dst=dst, change="clear")
+        self.cluster.net.clear_link(src, dst)
+
+    def _do_set_link(self, src: str, dst: str, overrides: dict) -> None:
+        self.journal.record("fault_link", src=src, dst=dst, change="set",
+                            **{k: v for k, v in sorted(overrides.items())})
+        self.cluster.net.set_link(src, dst, **overrides)
+
+    def _do_set_net(self, fields: dict) -> None:
+        net = self.cluster.net
+        for k in fields:
+            if not hasattr(net, k) or k.startswith("_"):
+                raise TypeError(f"unknown net field: {k!r}")
+        self.journal.record("fault_net",
+                            **{k: v for k, v in sorted(fields.items())})
+        for k, v in fields.items():
+            setattr(net, k, v)
+
+    def _do_skew(self, node: str, skew_s: float) -> None:
+        i = self._idx[node]
+        self.journal.record("fault_skew", target=node, skew_s=skew_s)
+        self.cluster.nodes[i].clock.skew_s = skew_s
+
+    def _do_kill_leader(self, times: int,
+                        restart_after: float | None) -> None:
+        self._kill_budget += times
+        self._kill_restart_after = restart_after
+        self.journal.record("fault_trigger", event="armed",
+                            kills=times, restart_after=restart_after)
+        if not self._armed:
+            self._armed = True
+            for sn in self.cluster.live_nodes():
+                sn.node.journal.on_record = self._tap
+
+    # -- leader-targeted trigger ----------------------------------------
+
+    def _tap(self, ev: dict) -> None:
+        """Journal tap (runs inside the winning node's record call):
+        schedule the kill for the next clock tick — tearing a node down
+        from inside its own election handler would be reentrant."""
+        if ev.get("type") != "election_won" or self._kill_budget <= 0:
+            return
+        i = self._by_journal.get(ev.get("node"))
+        if i is None or self.cluster.nodes[i].crashed:
+            return
+        self._kill_budget -= 1
+        name = self.cluster.nodes[i].name
+        self.journal.record("fault_trigger", event="leader_kill",
+                            target=name, blk=ev.get("blk"))
+        self.cluster.clock.call_later(
+            0.0, (lambda n: lambda: self._fire("crash", {"node": n}))(name))
+        if self._kill_restart_after is not None:
+            self.cluster.clock.call_later(
+                self._kill_restart_after,
+                (lambda n: lambda: self._fire("restart",
+                                              {"node": n}))(name))

@@ -59,6 +59,14 @@ EVENT_TYPES = frozenset({
     # verifier scheduler (crypto/scheduler.py): one coalesced dispatch
     # window flushed to the device or host-diverted
     "verifier_flush",
+    # fault injection (sim/faults.py + harness/chaos.py): every
+    # scripted fault lands in the journal stream so the observatory can
+    # render the fault timeline next to the consensus events it caused
+    "fault_crash", "fault_restart", "fault_partition", "fault_heal",
+    "fault_link", "fault_net", "fault_skew", "fault_trigger",
+    # verifier circuit breaker (crypto/scheduler.py): device declared
+    # dead / half-open re-probe / recovered
+    "fault_breaker",
 })
 
 # The registered ``_breakdown`` phase vocabulary (consensus/node.py);
@@ -86,6 +94,10 @@ class Journal:
         # restart replay re-runs historical inserts through the live emit
         # sites; flipping this off keeps replayed history out of the ring
         self.enabled = True
+        # optional event tap: called with each recorded event dict AFTER
+        # it is appended.  The fault injector's leader-targeted triggers
+        # ("kill the winner the moment it wins") listen here.
+        self.on_record = None
 
     # -- recording ------------------------------------------------------
     def record(self, type: str, blk: int | None = None,
@@ -111,6 +123,9 @@ class Journal:
             if len(self._events) == self._events.maxlen:
                 self.dropped += 1
             self._events.append(ev)
+        tap = self.on_record
+        if tap is not None:
+            tap(ev)  # outside the ring lock: taps may record elsewhere
         return ev
 
     # -- export ---------------------------------------------------------

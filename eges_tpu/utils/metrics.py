@@ -37,8 +37,10 @@ METRIC_FAMILIES = frozenset({
     "consensus.forced_empties", "consensus.phase_seconds",
     "consensus.sealed", "membership.min_ttl", "membership.size",
     # net/ + sim/simnet.py
-    "net.direct_bytes", "net.direct_msgs", "net.gossip_bytes",
-    "net.gossip_msgs", "net.peer_count",
+    "net.dead_letters", "net.direct_bytes", "net.direct_msgs",
+    "net.gossip_bytes", "net.gossip_msgs", "net.peer_count",
+    # sim/faults.py — deterministic fault injection
+    "sim.faults_injected",
     # core/txpool.py
     "txpool.pending",
     # crypto/ verifiers
@@ -53,6 +55,9 @@ METRIC_FAMILIES = frozenset({
     "verifier.prewarmed_buckets", "verifier.sched_batch_rows",
     "verifier.sched_occupancy", "verifier.sched_queue_wait_seconds",
     "verifier.singleton_batches",
+    # crypto/scheduler.py — fail-safe circuit breaker around the device
+    "verifier.breaker_probes", "verifier.breaker_state",
+    "verifier.breaker_trips", "verifier.device_errors",
 })
 
 

@@ -1,0 +1,440 @@
+"""Chaos scenario runner: scripted fault storms with safety/liveness checks.
+
+The executable form of the reference's manual robustness drill —
+``start.py`` a cluster, ``kill.py`` a node mid-run, ``re-start.py`` it,
+then grep the logs to see whether consensus survived — rebuilt on the
+deterministic simulator: every scenario is a :class:`FaultPlan`
+(``eges_tpu/sim/faults.py``) armed against a virtual-time
+:class:`SimCluster`, and every run checks the two properties that
+matter:
+
+* **safety** — no two live nodes ever commit conflicting blocks: for
+  every height up to the shortest live chain, all live nodes hold the
+  SAME block hash (and after heal the heights themselves converge);
+* **liveness** — commit lag recovers: within a bounded number of
+  *virtual* seconds after the last fault heals, every live node commits
+  a fixed number of NEW blocks.
+
+Runs are bit-deterministic: same scenario + same seed dumps a
+byte-identical merged journal (``--check-determinism`` runs twice and
+compares).  The only real-time field a journal row carries
+(``waited_ms`` on ``verifier_flush``) is stripped from the canonical
+dump.
+
+Usage::
+
+    python harness/chaos.py --list
+    python harness/chaos.py --scenario combo --seed 0
+    python harness/chaos.py --all --fast
+    python harness/chaos.py --scenario combo --check-determinism
+    python harness/chaos.py --scenario leader_kill_storm --dump /tmp/chaos
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from eges_tpu.sim.cluster import SimCluster
+from eges_tpu.sim.faults import FaultInjector, FaultPlan
+from harness import observatory
+
+# journal attrs measured in real (wall-clock) time, per event type —
+# stripped from the canonical dump so determinism is judged on protocol
+# content only (everything else is virtual-time stamped)
+VOLATILE_KEYS = {
+    "verifier_flush": ("waited_ms",),      # real queue wait
+    "block_committed": ("dt",),            # real insert duration
+}
+
+
+# -- checks ---------------------------------------------------------------
+
+def check_safety(cluster) -> tuple[bool, int]:
+    """No two live nodes hold conflicting blocks: every height up to the
+    shortest live chain maps to ONE hash across all live nodes.
+    Returns (ok, heights_checked)."""
+    live = cluster.live_nodes()
+    if not live:
+        return True, 0
+    hmin = min(sn.chain.height() for sn in live)
+    for h in range(1, hmin + 1):
+        hashes = {sn.chain.store.get_hash_by_number(h) for sn in live}
+        if len(hashes) != 1:
+            return False, h
+    return True, hmin
+
+
+def canonical_dump(by_node: dict[str, list[dict]]) -> bytes:
+    """Deterministic byte serialization of a merged journal collection:
+    sorted node order, sorted JSON keys, volatile (wall-clock) fields
+    stripped.  Two same-seed runs of one scenario must produce identical
+    bytes — the acceptance criterion for the whole fault layer."""
+    lines = []
+    for name in sorted(by_node):
+        for ev in by_node[name]:
+            drop = VOLATILE_KEYS.get(ev.get("type"), ())
+            ev = {k: v for k, v in ev.items() if k not in drop}
+            lines.append(json.dumps(ev, sort_keys=True))
+    return ("\n".join(lines) + "\n").encode()
+
+
+# -- scenario skeleton ----------------------------------------------------
+
+def _finish(name: str, seed: int, cluster, extra_blocks: int,
+            bound_s: float, grace_s: float = 120.0,
+            checks: dict | None = None) -> dict:
+    """Shared recovery phase: called once the last fault has healed.
+    Measures liveness (``extra_blocks`` new commits on every live node
+    within ``bound_s`` virtual seconds), then convergence (equal live
+    heights), then safety over the common prefix."""
+    live = cluster.live_nodes()
+    base = min(sn.chain.height() for sn in live)
+    target = base + extra_blocks
+    t0 = cluster.clock.now()
+
+    def _reached() -> bool:
+        return min(sn.chain.height()
+                   for sn in cluster.live_nodes()) >= target
+
+    cluster.run(bound_s, stop_condition=_reached)
+    liveness = _reached()
+    recovered_in = round(cluster.clock.now() - t0, 6)
+
+    def _equal() -> bool:
+        return len({sn.chain.height()
+                    for sn in cluster.live_nodes()}) == 1
+
+    cluster.run(grace_s, stop_condition=_equal)
+    converged = _equal()
+    safety, checked = check_safety(cluster)
+
+    checks = dict(checks or {})
+    ok = bool(safety and liveness and converged
+              and all(checks.values()))
+    for sn in cluster.live_nodes():
+        sn.node.stop()
+    return {
+        "scenario": name, "seed": seed, "ok": ok,
+        "safety": safety, "liveness": liveness, "converged": converged,
+        "heights": cluster.heights(), "heights_checked": checked,
+        "recovered_in_s": recovered_in, "bound_s": bound_s,
+        "extra_blocks": extra_blocks, "net": cluster.net_stats(),
+        "checks": checks,
+        "journals": cluster.journals(),
+    }
+
+
+def _names(cluster) -> list[str]:
+    return [sn.name for sn in cluster.nodes]
+
+
+# -- scenarios ------------------------------------------------------------
+
+def _scn_leader_kill_storm(seed: int, fast: bool) -> dict:
+    """Kill the elected leader the moment it wins, repeatedly; each
+    victim restarts from its surviving chain (the kill.py/re-start.py
+    drill aimed at the worst possible instant)."""
+    kills = 1 if fast else 3
+    cluster = SimCluster(4, seed=seed)
+    inj = FaultInjector(cluster)
+    inj.apply(FaultPlan().kill_leader(1.0, times=kills,
+                                      restart_after=15.0))
+    cluster.start()
+
+    def _crashes() -> int:
+        return sum(1 for f in inj.fired if f["kind"] == "crash")
+
+    cluster.run(600.0, stop_condition=lambda: (
+        _crashes() >= kills
+        and not any(sn.crashed for sn in cluster.nodes)))
+    healed = (_crashes() >= kills
+              and not any(sn.crashed for sn in cluster.nodes))
+    return _finish("leader_kill_storm", seed, cluster,
+                   extra_blocks=3 if fast else 4, bound_s=300.0,
+                   checks={"all_kills_fired_and_recovered": healed,
+                           "leader_kills": _crashes() == kills})
+
+
+def _scn_rolling_restarts(seed: int, fast: bool) -> dict:
+    """Crash and restart every node in turn — each restart replays the
+    surviving chain through the GeecNode constructor and must catch up
+    on blocks it missed while down."""
+    cluster = SimCluster(4, seed=seed)
+    inj = FaultInjector(cluster)
+    plan = FaultPlan()
+    idxs = range(1, 3) if fast else range(4)
+    step = 20.0 if fast else 30.0
+    last = 0.0
+    for j, i in enumerate(idxs):
+        plan.crash(5.0 + step * j, f"node{i}")
+        plan.restart(12.0 + step * j, f"node{i}")
+        last = 12.0 + step * j
+    inj.apply(plan)
+    cluster.start()
+    cluster.run(last + 2.0 - cluster.clock.now())
+    cluster.run(60.0, stop_condition=lambda: not any(
+        sn.crashed for sn in cluster.nodes))
+    return _finish("rolling_restarts", seed, cluster,
+                   extra_blocks=3 if fast else 4, bound_s=240.0,
+                   checks={"all_restarted": not any(
+                       sn.crashed for sn in cluster.nodes)})
+
+
+def _scn_loss_jitter(seed: int, fast: bool) -> dict:
+    """20% message loss plus latency jitter on both planes — the retry
+    ladders and version-bump recovery must keep the chain advancing,
+    and fully recover once the link cleans up."""
+    heal_t = 30.0 if fast else 60.0
+    cluster = SimCluster(4, seed=seed)
+    inj = FaultInjector(cluster)
+    inj.apply(FaultPlan()
+              .set_net(2.0, drop_rate=0.2, jitter_s=0.05)
+              .set_net(heal_t, drop_rate=0.0, jitter_s=0.002))
+    cluster.start()
+    cluster.run(heal_t + 1.0)
+    return _finish("loss_jitter", seed, cluster,
+                   extra_blocks=3 if fast else 4, bound_s=240.0,
+                   checks={"saw_drops": cluster.net.stats["dropped"] > 0})
+
+
+def _scn_asym_partition_ttl(seed: int, fast: bool) -> dict:
+    """Asymmetric partition: node3's OUTBOUND links are cut while
+    inbound still flows, so it keeps ingesting blocks but its votes and
+    TTL renewals never land.  The membership economy must expire it on
+    the live side (~5 decay intervals), and after the heal it must
+    detect its own expiry and re-register cleanly."""
+    cluster = SimCluster(4, seed=seed, failure_test=True)
+    inj = FaultInjector(cluster)
+    plan = FaultPlan()
+    for dst in ("node0", "node1", "node2"):
+        plan.block_link(2.0, "node3", dst)
+    inj.apply(plan)
+    cluster.start()
+    victim = cluster.nodes[3]
+    others = [sn for sn in cluster.nodes[:3]]
+    # run until every live peer has expired node3 from its membership
+    # (TTL floor: initial_ttl=50 decaying by 10 every 10 blocks)
+    cluster.run(4000.0, stop_condition=lambda: all(
+        victim.addr not in sn.node.membership for sn in others))
+    expired = all(victim.addr not in sn.node.membership for sn in others)
+    # heal: clear every link rule (journaled like any scripted action)
+    inj.fire_now("heal_link", src=None, dst=None)
+    # rejoin: node3 catches up, notices its own expiry, re-registers
+    cluster.run(600.0, stop_condition=lambda: (
+        victim.node.registered
+        and all(victim.addr in sn.node.membership
+                for sn in cluster.nodes)))
+    rejoined = (victim.node.registered
+                and all(victim.addr in sn.node.membership
+                        for sn in cluster.nodes))
+    return _finish("asym_partition_ttl", seed, cluster,
+                   extra_blocks=4, bound_s=300.0,
+                   checks={"ttl_expired_under_partition": expired,
+                           "clean_reregistration": rejoined})
+
+
+def _scn_corruption_flood(seed: int, fast: bool) -> dict:
+    """25% of datagrams truncated or bit-flipped: every mangled message
+    must be rejected by decode/auth — a node crash surfaces as an
+    exception out of the event loop and fails the run."""
+    heal_t = 30.0 if fast else 60.0
+    cluster = SimCluster(4, seed=seed)
+    inj = FaultInjector(cluster)
+    inj.apply(FaultPlan()
+              .set_net(2.0, corrupt_rate=0.25)
+              .set_net(heal_t, corrupt_rate=0.0))
+    cluster.start()
+    cluster.run(heal_t + 1.0)
+    return _finish("corruption_flood", seed, cluster,
+                   extra_blocks=3 if fast else 4, bound_s=240.0,
+                   checks={"saw_corruption":
+                           cluster.net.stats["corrupted"] > 0})
+
+
+def _scn_verifier_blackout(seed: int, fast: bool) -> dict:
+    """The accelerator dies permanently: every device dispatch raises.
+    The scheduler must fail over each window to the host recover path,
+    trip the circuit breaker (with half-open re-probes that keep
+    failing), and consensus must keep committing signed blocks."""
+    from eges_tpu.crypto.scheduler import VerifierScheduler
+    from eges_tpu.crypto.verify_host import NativeBatchVerifier
+
+    # long window => flushes are kick-driven only (deterministic rows);
+    # the breaker cooldown runs on the VIRTUAL clock
+    sched = VerifierScheduler(NativeBatchVerifier(), window_ms=10_000.0,
+                              breaker_cooldown_s=30.0)
+    cluster = SimCluster(4, seed=seed, verifier=sched, signed=True)
+    sched.breaker_clock = cluster.clock.now
+
+    def _dead_device(rows: int) -> None:
+        raise RuntimeError("device lost (injected blackout)")
+
+    sched.failure_hook = _dead_device
+    inj = FaultInjector(cluster)     # journals the (empty) fault plan
+    cluster.start()
+    blocks = 4 if fast else 6
+    cluster.run(600.0,
+                stop_condition=lambda: cluster.min_height() >= blocks)
+    stats = sched.stats()
+    res = _finish("verifier_blackout", seed, cluster,
+                  extra_blocks=2, bound_s=240.0,
+                  checks={"breaker_tripped": stats["breaker_trips"] >= 1,
+                          "device_never_recovered":
+                              stats["breaker"] == "open",
+                          "windows_host_diverted":
+                              stats["breaker_diverted"] > 0
+                              or stats["host_diverted"] > 0})
+    sched.close()
+    res["verifier"] = sched.stats()
+    return res
+
+
+def _scn_combo(seed: int, fast: bool) -> dict:
+    """The acceptance storm: leader-kill + 20% loss + an asymmetric
+    partition, all at once, then heal everything.  Live nodes must
+    converge to equal heights with no conflicting commits, within the
+    virtual-time bound, bit-identically across same-seed runs."""
+    heal_t = 45.0 if fast else 90.0
+    cluster = SimCluster(4, seed=seed)
+    inj = FaultInjector(cluster)
+    inj.apply(FaultPlan()
+              .kill_leader(1.0, times=1, restart_after=15.0)
+              .set_net(2.0, drop_rate=0.2, jitter_s=0.05)
+              .block_link(2.0, "node2", "node1")
+              .set_net(heal_t, drop_rate=0.0, jitter_s=0.002)
+              .heal_link(heal_t, "node2", "node1"))
+    cluster.start()
+    cluster.run(heal_t + 1.0)
+    cluster.run(120.0, stop_condition=lambda: (
+        any(f["kind"] == "crash" for f in inj.fired)
+        and not any(sn.crashed for sn in cluster.nodes)))
+    return _finish("combo", seed, cluster,
+                   extra_blocks=3 if fast else 4, bound_s=300.0,
+                   checks={"leader_killed": any(
+                       f["kind"] == "crash" for f in inj.fired),
+                       "all_recovered": not any(
+                           sn.crashed for sn in cluster.nodes)})
+
+
+SCENARIOS = {
+    "leader_kill_storm": _scn_leader_kill_storm,
+    "rolling_restarts": _scn_rolling_restarts,
+    "loss_jitter": _scn_loss_jitter,
+    "asym_partition_ttl": _scn_asym_partition_ttl,
+    "corruption_flood": _scn_corruption_flood,
+    "verifier_blackout": _scn_verifier_blackout,
+    "combo": _scn_combo,
+}
+
+
+def run_scenario(name: str, seed: int = 0, fast: bool = False) -> dict:
+    """Run one named scenario; returns the result dict (``ok`` plus the
+    safety/liveness breakdown, net stats, and the merged journals)."""
+    if name not in SCENARIOS:
+        raise KeyError(f"unknown scenario {name!r}; have: "
+                       + ", ".join(sorted(SCENARIOS)))
+    return SCENARIOS[name](seed, fast)
+
+
+def check_determinism(name: str, seed: int = 0,
+                      fast: bool = False) -> tuple[bool, bytes, bytes]:
+    """Run a scenario twice with the same seed and compare the canonical
+    journal dumps byte-for-byte."""
+    a = canonical_dump(run_scenario(name, seed, fast)["journals"])
+    b = canonical_dump(run_scenario(name, seed, fast)["journals"])
+    return a == b, a, b
+
+
+# -- rendering ------------------------------------------------------------
+
+def render_result(res: dict) -> str:
+    out = ["chaos %-20s seed=%d  %s" % (
+        res["scenario"], res["seed"], "OK" if res["ok"] else "FAILED")]
+    out.append("  safety=%s liveness=%s converged=%s  heights=%s "
+               "(checked %d)" % (res["safety"], res["liveness"],
+                                 res["converged"], res["heights"],
+                                 res["heights_checked"]))
+    out.append("  recovered %d new block(s) in %.3f virtual s "
+               "(bound %.0f s)" % (res["extra_blocks"],
+                                   res["recovered_in_s"], res["bound_s"]))
+    net = res["net"]
+    out.append("  net: " + "  ".join(
+        "%s %d" % (k, net[k]) for k in sorted(net)))
+    for k, v in sorted(res["checks"].items()):
+        out.append("  check %-32s %s" % (k, "ok" if v else "FAILED"))
+    if "verifier" in res:
+        vs = res["verifier"]
+        out.append("  verifier: breaker=%s trips=%d probes=%d "
+                   "diverted=%d host=%d batches=%d" % (
+                       vs["breaker"], vs["breaker_trips"],
+                       vs["breaker_probes"], vs["breaker_diverted"],
+                       vs["host_diverted"], vs["batches"]))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", default=None,
+                    help="run one named scenario")
+    ap.add_argument("--all", action="store_true",
+                    help="run the full scenario matrix")
+    ap.add_argument("--list", action="store_true",
+                    help="list scenarios and exit")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--fast", action="store_true",
+                    help="reduced-scale variants (smoke-test sized)")
+    ap.add_argument("--check-determinism", action="store_true",
+                    help="run each scenario twice and require "
+                         "byte-identical canonical journal dumps")
+    ap.add_argument("--dump", metavar="DIR", default=None,
+                    help="dump merged journals as JSONL (observatory "
+                         "--replay format)")
+    ap.add_argument("--observatory", action="store_true",
+                    help="render the observatory report (fault timeline "
+                         "included) for each run")
+    ap.add_argument("--json", action="store_true",
+                    help="emit result dicts as JSON lines")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in sorted(SCENARIOS):
+            print("%-20s %s" % (name, (SCENARIOS[name].__doc__ or "")
+                                .strip().splitlines()[0]))
+        return 0
+
+    names = (sorted(SCENARIOS) if args.all
+             else [args.scenario] if args.scenario else ["combo"])
+    failed = 0
+    for name in names:
+        res = run_scenario(name, seed=args.seed, fast=args.fast)
+        if args.check_determinism:
+            same, _, _ = check_determinism(name, seed=args.seed,
+                                           fast=args.fast)
+            res["checks"]["deterministic"] = same
+            res["ok"] = res["ok"] and same
+        journals = res.pop("journals")
+        if args.dump:
+            outdir = os.path.join(args.dump, name)
+            for p in observatory.dump_journals(journals, outdir):
+                print("dumped %s" % p, file=sys.stderr)
+        if args.json:
+            print(json.dumps(res, sort_keys=True))
+        else:
+            print(render_result(res))
+            if args.observatory:
+                print(observatory.render(
+                    observatory.summarize(journals), net=res["net"]))
+        if not res["ok"]:
+            failed += 1
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,10 +46,47 @@ from eges_tpu.utils.metrics import percentile
 # subset of journal.EVENT_TYPES so parser and emit sites cannot drift.
 CONSUMED = ("election_started", "election_won", "election_lost",
             "validate_quorum", "version_bump", "block_committed",
-            "block_confirmed")
+            "block_confirmed",
+            "fault_crash", "fault_restart", "fault_partition",
+            "fault_heal", "fault_link", "fault_net", "fault_skew",
+            "fault_trigger", "fault_breaker")
 
 _TIMELINE = ("election_started", "election_won", "election_lost",
              "version_bump")
+
+_FAULTS = ("fault_crash", "fault_restart", "fault_partition",
+           "fault_heal", "fault_link", "fault_net", "fault_skew",
+           "fault_trigger", "fault_breaker")
+
+
+def _fault_line(name: str, ev: dict) -> str:
+    typ = ev["type"]
+    if typ == "fault_crash":
+        return "crash %s" % ev.get("target", "?")
+    if typ == "fault_restart":
+        return "restart %s" % ev.get("target", "?")
+    if typ == "fault_partition":
+        return "partition %s" % ev.get("target", "?")
+    if typ == "fault_heal":
+        return "heal %s" % ev.get("target", "?")
+    if typ == "fault_link":
+        return "link %s->%s %s" % (ev.get("src", "?"), ev.get("dst", "?"),
+                                   ev.get("change", "?"))
+    if typ == "fault_net":
+        knobs = ", ".join(
+            "%s=%s" % (k, v) for k, v in sorted(ev.items())
+            if k not in ("ts", "seq", "node", "type", "trace"))
+        return "net-wide: %s" % knobs
+    if typ == "fault_skew":
+        return "skew %s by %ss" % (ev.get("target", "?"),
+                                   ev.get("skew_s", "?"))
+    if typ == "fault_trigger":
+        if ev.get("event") == "leader_kill":
+            return "leader-kill trigger fired on %s" % ev.get("target", "?")
+        return "leader-kill armed (kills=%s)" % ev.get("kills", "?")
+    # fault_breaker (recorded by the verifier scheduler into the
+    # adopting node's journal)
+    return "verifier breaker %s on %s" % (ev.get("state", "?"), name)
 
 
 def summarize(by_node: dict[str, list[dict]],
@@ -65,11 +102,18 @@ def summarize(by_node: dict[str, list[dict]],
     commits: dict[int, dict[str, float]] = {}
     # blk -> [(ts, seq, name, line)]
     timeline: dict[int, list[tuple]] = {}
+    # flat, time-ordered fault timeline (injector + breaker events)
+    faults: list[tuple] = []
 
     for name in sorted(by_node):
         for ev in by_node[name]:
             typ = ev.get("type")
             blk = ev.get("blk")
+            if typ in _FAULTS:
+                faults.append((round(float(ev["ts"]), 6),
+                               int(ev.get("seq", 0)), name, typ,
+                               _fault_line(name, ev)))
+                continue
             if typ == "election_won" and "dt" in ev:
                 election_lat.append(float(ev["dt"]))
             elif typ == "validate_quorum" and "dt" in ev:
@@ -143,6 +187,9 @@ def summarize(by_node: dict[str, list[dict]],
         "commit_lag": commit_lag,
         "stalls": stalls,
         "max_commit_gap_s": round(max_gap, 6),
+        "fault_timeline": [
+            {"ts": ts, "node": name, "type": typ, "line": line}
+            for ts, _seq, name, typ, line in sorted(faults)],
     }
 
 
@@ -195,10 +242,13 @@ def run_sim(nodes: int = 4, blocks: int = 6, seconds: float = 600.0,
 
 # -- rendering ------------------------------------------------------------
 
-def render(summary: dict) -> str:
+def render(summary: dict, net: dict | None = None) -> str:
     out = []
     out.append("consensus observatory — %d node(s), %d block(s)" % (
         len(summary["nodes"]), summary["blocks"]))
+    if net:
+        out.append("  net: " + "  ".join(
+            "%s %d" % (k, net[k]) for k in sorted(net)))
     e, a = summary["election"], summary["ack_quorum"]
     out.append("  elections   : %4d  p50 %s ms  p99 %s ms" % (
         e["count"], e["p50_ms"], e["p99_ms"]))
@@ -218,6 +268,10 @@ def render(summary: dict) -> str:
     for blk, rows in summary["election_timeline"].items():
         out.append("    blk %s:" % blk)
         for r in rows:
+            out.append("      %12.6f  %s" % (r["ts"], r["line"]))
+    if summary.get("fault_timeline"):
+        out.append("  fault timeline:")
+        for r in summary["fault_timeline"]:
             out.append("      %12.6f  %s" % (r["ts"], r["line"]))
     return "\n".join(out)
 
@@ -240,6 +294,7 @@ def main(argv=None) -> int:
                     help="emit the summary as one JSON object")
     args = ap.parse_args(argv)
 
+    net = None
     if args.replay:
         by_node = load_journals(args.replay)
         if not by_node:
@@ -249,14 +304,17 @@ def main(argv=None) -> int:
     else:
         cluster = run_sim(args.nodes, args.blocks, args.seconds, args.seed)
         by_node = collect_live(cluster)
+        net = cluster.net_stats()
         if args.dump:
             for p in dump_journals(by_node, args.dump):
                 print("dumped %s" % p, file=sys.stderr)
 
     summary = summarize(by_node, stall_gap_s=args.stall_gap)
+    if args.json and net is not None:
+        summary = dict(summary, net=net)
     try:
         print(json.dumps(summary, sort_keys=True) if args.json
-              else render(summary))
+              else render(summary, net=net))
     except BrokenPipeError:
         os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
     return 0

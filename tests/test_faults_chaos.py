@@ -1,0 +1,387 @@
+"""Fault-injection layer + chaos harness: link rules, crash/restart,
+leader-targeted triggers, verifier fail-safe degradation, and the
+deterministic chaos scenarios.
+
+The tier-1 smoke here runs ONE reduced-scale combo storm twice and
+requires byte-identical journals; the full scenario matrix rides the
+``slow`` marker (``harness/chaos.py --all`` is the manual equivalent).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from eges_tpu.sim.cluster import SimCluster
+from eges_tpu.sim.faults import FaultInjector, FaultPlan
+from eges_tpu.sim.simnet import SimClock, SimNet, SkewedClock
+from harness import chaos
+
+
+# -- network fault primitives ---------------------------------------------
+
+def test_link_rules_are_asymmetric():
+    """Blocking A->B must leave B->A untouched (the asymmetric partition
+    the symmetric SimNet.partition cannot express)."""
+    clock = SimClock()
+    net = SimNet(clock, seed=3)
+    got = {"a": [], "b": []}
+    net.join("a", "10.0.0.1", 1, lambda d: got["a"].append(d),
+             lambda d: got["a"].append(d))
+    net.join("b", "10.0.0.2", 2, lambda d: got["b"].append(d),
+             lambda d: got["b"].append(d))
+    net.block_link("a", "b")
+    net.deliver_gossip("a", b"from-a")
+    net.deliver_gossip("b", b"from-b")
+    net.deliver_direct("a", ("10.0.0.2", 2), b"direct-a")
+    net.deliver_direct("b", ("10.0.0.1", 1), b"direct-b")
+    clock.run_until(1.0)
+    assert got["b"] == []                      # a -> b fully blocked
+    assert got["a"] == [b"from-b", b"direct-b"]  # b -> a flows
+    assert net.stats["dropped"] == 2
+    net.clear_link("a", "b")
+    net.deliver_gossip("a", b"healed")
+    clock.run_until(2.0)
+    assert got["b"] == [b"healed"]
+
+
+def test_per_link_overrides_and_unknown_key():
+    clock = SimClock()
+    net = SimNet(clock, seed=0)
+    rule = net.set_link("a", "b", drop_rate=1.0)
+    assert rule.drop_rate == 1.0
+    with pytest.raises(TypeError):
+        net.set_link("a", "b", nonsense=1)
+
+
+def test_dead_letter_counter():
+    """A direct datagram to an unbound (ip, port) — e.g. a crashed
+    node's port — must count as a dead letter, not crash or vanish."""
+    clock = SimClock()
+    net = SimNet(clock, seed=0)
+    net.join("a", "10.0.0.1", 1, lambda d: None, lambda d: None)
+    net.deliver_direct("a", ("10.0.0.9", 9), b"to-nobody")
+    assert net.stats["dead_letter"] == 1
+    # in-flight datagram to a node that leaves before delivery
+    net.join("b", "10.0.0.2", 2, lambda d: None, lambda d: None)
+    net.deliver_direct("a", ("10.0.0.2", 2), b"late")
+    net.leave("b")
+    clock.run_until(1.0)
+    assert net.stats["dead_letter"] == 2
+
+
+def test_mangle_changes_or_truncates():
+    clock = SimClock()
+    net = SimNet(clock, seed=7)
+    for _ in range(32):
+        data = bytes(range(64))
+        out = net._mangle(data)
+        assert out != data
+        assert len(out) <= len(data)
+
+
+def test_skewed_clock_offsets_now_only():
+    base = SimClock()
+    sk = SkewedClock(base, skew_s=1.5)
+    assert sk.now() == pytest.approx(1.5)
+    fired = []
+    sk.call_later(0.5, lambda: fired.append(base.now()))
+    base.run_until(1.0)
+    assert fired == [0.5]  # timers fire on the SHARED timeline
+
+
+def test_faultplan_rejects_unknown_kind_and_net_field():
+    with pytest.raises(ValueError):
+        FaultPlan().add(1.0, "explode")
+    cluster = SimCluster(2, seed=0)
+    inj = FaultInjector(cluster)
+    with pytest.raises(TypeError):
+        inj.fire_now("set_net", fields={"warp_speed": 9})
+
+
+# -- crash / restart / triggers -------------------------------------------
+
+def test_crash_restart_replays_chain():
+    """A crashed node rebuilt from its surviving chain (the GeecNode
+    constructor replay — re-start.py analogue) must rejoin and catch up
+    to the blocks it missed while down."""
+    cluster = SimCluster(4, seed=2)
+    cluster.start()
+    cluster.run(120.0, stop_condition=lambda: cluster.min_height() >= 3)
+    h_crash = cluster.nodes[1].chain.height()
+    cluster.crash(1)
+    assert [sn.name for sn in cluster.live_nodes()] == \
+        ["node0", "node2", "node3"]
+    cluster.run(120.0, stop_condition=lambda: min(
+        sn.chain.height() for sn in cluster.live_nodes()) >= h_crash + 3)
+    cluster.restart(1)
+    cluster.run(120.0, stop_condition=lambda: len(
+        {sn.chain.height() for sn in cluster.nodes}) == 1)
+    heights = cluster.heights()
+    assert len(set(heights)) == 1 and heights[0] > h_crash
+    ok, checked = chaos.check_safety(cluster)
+    assert ok and checked == heights[0]
+    # the fault timeline + archived journal survive the rebuild
+    journals = cluster.journals()
+    assert any(e["type"] == "block_committed"
+               for e in journals["node1"])
+
+
+def test_leader_kill_trigger_hits_election_winner():
+    """kill_leader must crash exactly the node whose journal emitted
+    election_won, on the very next clock tick."""
+    cluster = SimCluster(4, seed=5)
+    inj = FaultInjector(cluster)
+    inj.apply(FaultPlan().kill_leader(0.5, times=1))
+    cluster.start()
+    cluster.run(120.0, stop_condition=lambda: any(
+        f["kind"] == "crash" for f in inj.fired))
+    crashes = [f for f in inj.fired if f["kind"] == "crash"]
+    assert len(crashes) == 1
+    victim = crashes[0]["node"]
+    assert cluster.nodes[int(victim[-1])].crashed
+    evs = inj.journal.events()
+    trig = [e for e in evs if e["type"] == "fault_trigger"
+            and e.get("event") == "leader_kill"]
+    assert trig and trig[0]["target"] == victim
+    # the winner recorded election_won before dying
+    won = [e for e in cluster.journals()[victim]
+           if e["type"] == "election_won"]
+    assert won
+    cluster.restart(int(victim[-1]))
+    cluster.run(60.0, stop_condition=lambda: len(
+        {sn.chain.height() for sn in cluster.nodes}) == 1)
+
+
+def test_corruption_never_crashes_a_node():
+    """With a quarter of all datagrams truncated/bit-flipped, every node
+    must reject them in decode/auth — an unhandled handler exception
+    would propagate out of run() and fail this test."""
+    cluster = SimCluster(3, seed=4)
+    inj = FaultInjector(cluster)
+    inj.apply(FaultPlan().set_net(0.2, corrupt_rate=0.3))
+    cluster.start()
+    cluster.run(30.0)
+    assert cluster.net.stats["corrupted"] > 0
+    assert cluster.min_height() >= 1  # consensus survived the flood
+
+
+def test_clock_skew_action_desyncs_timestamps():
+    cluster = SimCluster(3, seed=0)
+    inj = FaultInjector(cluster)
+    inj.apply(FaultPlan().skew(1.0, "node1", 5.0))
+    cluster.start()
+    cluster.run(10.0)
+    assert cluster.nodes[1].clock.now() == \
+        pytest.approx(cluster.clock.now() + 5.0)
+    assert cluster.nodes[0].clock.now() == pytest.approx(cluster.clock.now())
+    assert any(e["type"] == "fault_skew" for e in inj.journal.events())
+
+
+# -- verifier fail-safe degradation ---------------------------------------
+
+def _entries(n: int, salt: int = 0):
+    from tests.test_scheduler import _sign_entries
+    return _sign_entries(n, salt)
+
+
+def _host_model(entries):
+    from tests.test_scheduler import _host_model as hm
+    return hm(entries)
+
+
+def test_device_failure_diverts_window_and_trips_breaker():
+    """A device exception inside a flush must (a) still resolve every
+    future — via the host recover path — and (b) trip the circuit
+    breaker so following windows never touch the device."""
+    from eges_tpu.crypto.scheduler import VerifierScheduler
+    from eges_tpu.crypto.verify_host import NativeBatchVerifier
+
+    fake_now = [0.0]
+    sched = VerifierScheduler(NativeBatchVerifier(), window_ms=50.0,
+                              breaker_cooldown_s=10.0,
+                              breaker_clock=lambda: fake_now[0])
+    calls = []
+
+    def dead(rows):
+        calls.append(rows)
+        raise RuntimeError("device lost")
+
+    sched.failure_hook = dead
+    entries = _entries(6, salt=1)
+    assert sched.recover_signers(entries) == _host_model(entries)
+    st = sched.stats()
+    assert st["breaker"] == "open"
+    assert st["breaker_trips"] == 1 and st["device_errors"] == 1
+    assert calls == [6]
+
+    # breaker open: the next window host-diverts WITHOUT calling the
+    # device (the hook would raise again and it is not invoked at all)
+    entries2 = _entries(5, salt=2)
+    assert sched.recover_signers(entries2) == _host_model(entries2)
+    st = sched.stats()
+    assert st["breaker_diverted"] == 5 and st["breaker_trips"] == 1
+    assert calls == [6]
+
+    # cooldown elapses -> half-open probe; device healed -> breaker closes
+    sched.failure_hook = None
+    fake_now[0] = 11.0
+    entries3 = _entries(4, salt=3)
+    assert sched.recover_signers(entries3) == _host_model(entries3)
+    st = sched.stats()
+    assert st["breaker"] == "closed" and st["breaker_probes"] == 1
+    sched.close()
+
+
+def test_failed_probe_reopens_breaker():
+    from eges_tpu.crypto.scheduler import VerifierScheduler
+    from eges_tpu.crypto.verify_host import NativeBatchVerifier
+
+    fake_now = [0.0]
+    sched = VerifierScheduler(NativeBatchVerifier(), window_ms=50.0,
+                              breaker_cooldown_s=10.0,
+                              breaker_clock=lambda: fake_now[0])
+    sched.failure_hook = lambda rows: (_ for _ in ()).throw(
+        RuntimeError("still dead"))
+    e1 = _entries(3, salt=4)
+    assert sched.recover_signers(e1) == _host_model(e1)
+    fake_now[0] = 10.5  # past cooldown -> probe admitted -> fails again
+    e2 = _entries(3, salt=5)
+    assert sched.recover_signers(e2) == _host_model(e2)
+    st = sched.stats()
+    assert st["breaker"] == "open"
+    assert st["breaker_probes"] == 1 and st["breaker_trips"] == 2
+    sched.close()
+
+
+def test_dispatch_thread_death_fails_every_future():
+    """If the dispatch thread dies on an unexpected (non-Exception)
+    error, every pending future must resolve with that error instead of
+    hanging its caller; the next submit restarts the thread."""
+    from eges_tpu.crypto.scheduler import VerifierScheduler
+    from eges_tpu.crypto.verify_host import NativeBatchVerifier
+
+    class DeviceGone(BaseException):
+        pass
+
+    sched = VerifierScheduler(NativeBatchVerifier(), window_ms=50.0)
+    sched.failure_hook = lambda rows: (_ for _ in ()).throw(
+        DeviceGone("catastrophic"))
+    old_hook = threading.excepthook
+    threading.excepthook = lambda *a: None  # the thread re-raises by design
+    try:
+        futs = [sched.submit(h, s) for h, s in _entries(4, salt=6)]
+        sched.kick()
+        for f in futs:
+            with pytest.raises(DeviceGone):
+                f.result(timeout=30)
+        # the synchronous facade rides the same failure over to the host
+        # path: consensus callers never see the dead thread at all
+        sched.failure_hook = None
+        e = _entries(3, salt=7)
+        assert sched.recover_signers(e) == _host_model(e)
+    finally:
+        threading.excepthook = old_hook
+        sched.close()
+
+
+def test_close_fails_leftover_futures_instead_of_hanging():
+    from eges_tpu.crypto.scheduler import VerifierScheduler
+    from eges_tpu.crypto.verify_host import NativeBatchVerifier
+
+    sched = VerifierScheduler(NativeBatchVerifier(), window_ms=50.0)
+    sched._ensure_thread = lambda: None  # dispatch thread never starts
+    futs = [sched.submit(h, s) for h, s in _entries(3, salt=8)]
+    sched.close(timeout=0.1)
+    for f in futs:
+        with pytest.raises(RuntimeError, match="unresolved"):
+            f.result(timeout=1)
+
+
+# -- membership TTL under partition (fast leg) ----------------------------
+
+def test_stale_registered_flag_clears_and_rereg_starts():
+    """A node that discovers its OWN membership expiry (typical while
+    replaying blocks missed behind a partition) must drop the stale
+    ``registered`` flag and start re-registration from scratch."""
+    cluster = SimCluster(3, seed=1, failure_test=True)
+    cluster.start()
+    ttl_i = cluster.nodes[0].node.membership.ttl_interval
+    cluster.run(600.0,
+                stop_condition=lambda: cluster.min_height() >= ttl_i)
+    node = cluster.nodes[0].node
+    with node._lock:
+        assert node.registered
+        node.membership.remove(node.coinbase)
+        # the TTL check runs on decay-interval blocks only
+        node._check_membership(node.chain.get_block_by_number(ttl_i))
+        assert not node.registered
+    # the restarted registration loop re-registers it cleanly
+    cluster.run(120.0, stop_condition=lambda: node.registered)
+    assert node.registered and node.coinbase in node.membership
+
+
+# -- chaos harness --------------------------------------------------------
+
+def test_chaos_smoke_combo_same_seed_byte_identical():
+    """Tier-1 smoke: the acceptance storm (leader-kill + 20% loss +
+    asymmetric partition, then heal) converges safely AND two same-seed
+    runs dump byte-identical canonical journals."""
+    res = chaos.run_scenario("combo", seed=0, fast=True)
+    assert res["ok"], res
+    assert res["safety"] and res["liveness"] and res["converged"]
+    assert len(set(res["heights"])) == 1
+    assert res["recovered_in_s"] <= res["bound_s"]
+    same, a, b = chaos.check_determinism("combo", seed=0, fast=True)
+    assert same and a  # non-empty, identical bytes
+    # the fault journal rode along under the synthetic "faults" node
+    assert any(e["type"] == "fault_net"
+               for e in res["journals"]["faults"])
+
+
+def test_chaos_net_stats_surface_in_report():
+    res = chaos.run_scenario("loss_jitter", seed=0, fast=True)
+    assert res["net"]["dropped"] > 0
+    text = chaos.render_result(res)
+    assert "dropped" in text and "OK" in text
+    from harness import observatory
+    summary = observatory.summarize(res["journals"])
+    assert summary["fault_timeline"]
+    rendered = observatory.render(summary, net=res["net"])
+    assert "net:" in rendered and "fault timeline:" in rendered
+
+
+@pytest.mark.slow
+def test_chaos_full_matrix():
+    """Every named scenario passes its safety/liveness checks (the
+    ``harness/chaos.py --all`` matrix, reduced-scale variants)."""
+    for name in sorted(chaos.SCENARIOS):
+        res = chaos.run_scenario(name, seed=0, fast=True)
+        assert res["ok"], (name, {k: v for k, v in res.items()
+                                  if k != "journals"})
+
+
+@pytest.mark.slow
+def test_chaos_membership_ttl_partition_scenario():
+    """Full sim leg of the TTL satellite: asymmetric partition ->
+    peers expire the victim -> heal -> clean re-registration."""
+    res = chaos.run_scenario("asym_partition_ttl", seed=0)
+    assert res["ok"], res
+    assert res["checks"]["ttl_expired_under_partition"]
+    assert res["checks"]["clean_reregistration"]
+    faults = res["journals"]["faults"]
+    assert any(e["type"] == "fault_link" and e.get("change") == "block"
+               for e in faults)
+    assert any(e["type"] == "fault_link" and e.get("change") == "clear"
+               for e in faults)
+
+
+@pytest.mark.slow
+def test_chaos_verifier_blackout_scenario_deterministic():
+    res = chaos.run_scenario("verifier_blackout", seed=0, fast=True)
+    assert res["ok"], res
+    assert res["verifier"]["breaker_trips"] >= 1
+    same, _, _ = chaos.check_determinism("verifier_blackout", seed=0,
+                                         fast=True)
+    assert same
