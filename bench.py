@@ -29,6 +29,13 @@ whose rejection is asserted against the independent host model.
 baseline and the 16 k/s reference-class figure (BASELINE.md: the
 libsecp256k1 cgo path is ~12-20 k verifies/s/core), so the ratio is
 conservative even though our schoolbook C++ recover is slower.
+
+``bench.py mesh`` is a separate stage: it regenerates MESH_SCALING.json
+through ``harness/mesh_scaling.run`` (psum/ring A/B, recorded collective
+winner, and the mesh scheduler saturation pass with per-device
+occupancy, per point) and appends a ``mesh_sharded_rows_per_s`` line to
+the same history file, gated independently by
+``harness/check_regression.py``.
 """
 
 from __future__ import annotations
@@ -427,6 +434,58 @@ def _spawn(kind: str, deadline: float, max_batch: int) -> subprocess.Popen:
         env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
 
 
+def mesh_main() -> None:
+    """``bench.py mesh``: regenerate the MESH_SCALING.json artifact
+    (psum/ring A/B + recorded collective winner + scheduler saturation
+    stage with per-device occupancy, per point) and append one
+    ``mesh_sharded_rows_per_s`` history line — the series
+    ``harness/check_regression.py`` gates independently of the
+    single-chip verifies/s metric."""
+    rows, devices = 2048, (1, 2, 4, 8)
+    out_path = None
+    for a in sys.argv[2:]:
+        if a.startswith("--rows="):
+            rows = int(a[len("--rows="):])
+        elif a.startswith("--devices="):
+            devices = tuple(int(x)
+                            for x in a[len("--devices="):].split(","))
+        elif a.startswith("--out="):
+            out_path = a[len("--out="):]
+
+    from harness.mesh_scaling import run
+
+    doc = run(rows, devices, out=out_path)
+    # the gated aggregate: the dispatch front's rows/s at the widest
+    # device count measured (the scheduler fans one window across every
+    # lane, so this IS the mesh-wide number)
+    scored = [p for p in doc["points"] if p.get("sched")]
+    line = {"metric": "mesh_sharded_rows_per_s", "unit": "rows/s",
+            "rows": rows}
+    if scored:
+        top = max(scored, key=lambda p: p["devices"])
+        line.update({
+            "value": top["sched"]["rows_per_s"],
+            "devices": top["devices"],
+            "collective": top.get("collective"),
+            "window_splits": top["sched"]["window_splits"],
+            "per_device_occupancy": [
+                d["occupancy"] for d in top["sched"]["per_device"]],
+            "points": [{
+                "devices": p["devices"],
+                "collective": p.get("collective"),
+                "sched_rows_per_s": p["sched"]["rows_per_s"],
+                "psum_rows_per_s": p["psum"]["rows_per_s"],
+                "ring_rows_per_s": p["ring"]["rows_per_s"],
+            } for p in scored],
+        })
+    else:
+        line.update({"value": 0.0,
+                     "error": "no device count produced a sched stage"})
+    line.update(_provenance())
+    print(json.dumps(line), flush=True)
+    _append_history(line)
+
+
 def main() -> None:
     args = [a for a in sys.argv[1:] if not a.startswith("-")]
     max_batch = int(args[0]) if args else 16384
@@ -627,5 +686,8 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         _child(float(sys.argv[2]), int(sys.argv[3]))
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "mesh":
+        mesh_main()
         sys.exit(0)
     main()

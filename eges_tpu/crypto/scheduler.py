@@ -1,4 +1,4 @@
-"""Asynchronous coalescing verifier scheduler with a sender-recovery cache.
+"""Mesh-sharded coalescing verifier scheduler with a sender-recovery cache.
 
 Every consensus/txpool call site used to drive the batch verifier
 synchronously — including one-row dispatches per candidacy/registration
@@ -10,9 +10,9 @@ JAX-free :class:`~eges_tpu.crypto.verify_host.NativeBatchVerifier`):
 * callers :meth:`submit` ``(sighash, sig)`` requests and get futures;
 * a background dispatch thread coalesces concurrent requests across
   callers (txpool sender recovery + vote quorums + single-message
-  checks) into ONE device batch per micro-window — flushed when the
-  bucket fills, when the deadline measured from the oldest pending
-  entry expires, or when a synchronous caller *kicks* the window;
+  checks) into ONE batch per micro-window — flushed when the bucket
+  fills, when the deadline measured from the oldest pending entry
+  expires, or when a synchronous caller *kicks* the window;
 * an LRU ``(sighash, sig) -> address-or-None`` recovery cache makes
   gossip re-delivery and commit-time re-verification free — the role
   split the reference implements host-side as the concurrent sender
@@ -23,40 +23,97 @@ JAX-free :class:`~eges_tpu.crypto.verify_host.NativeBatchVerifier`):
   costs more than one native recover, and diverting keeps
   ``verifier.singleton_batches`` at zero in steady state.
 
+**Mesh dispatch.** When the backing verifier exposes ``device_targets()``
+(:class:`~eges_tpu.crypto.verifier.MeshBatchVerifier`, or the host-model
+``NativeMeshVerifier``), the admission front above feeds one *window
+lane* per device instead of calling the verifier inline:
+
+* each lane owns a FIFO queue and a worker thread, so a slow chip
+  stalls only the windows placed on it (stragglers never head-of-line
+  block the mesh);
+* placement fills the least-loaded lane (queued + in-flight rows; ties
+  rotate round-robin so idle meshes still spread sequential windows),
+  and a window larger than ``max_batch / n_lanes`` splits into
+  contiguous chunks across distinct lanes — saturated load reaches
+  every device;
+* the PR 5 circuit breaker is scoped PER LANE: one dead device trips
+  one breaker, that lane's windows host-divert, every other lane keeps
+  the device path (per-lane ``straggler_diverts`` counts the rescue);
+* completion is per chunk — each chunk resolves (or fails) its own
+  futures independently, reusing the fail-safe resolution, so one
+  device's death diverts exactly its own in-flight windows.
+
+With one visible device the lane machinery collapses to the PR 4/5
+behavior: the admission thread dispatches inline, no extra threads.
+
 This module must stay importable WITHOUT JAX (same contract as
 ``verify_host.py``): the bench parent and host-fallback node processes
 construct schedulers around native verifiers.
 
 Thread model: ``submit``/``kick``/``close`` arrive on any caller thread
 (RPC workers, the sim clock thread, consensus dispatch); the flush loop
-runs on one daemon thread.  Every mutable field is guarded by the one
-condition ``self._lock``; the dispatch thread calls only the backing
-verifier outside it, never a caller's lock — so it can never deadlock
-against the node/txpool lock domain.
+runs on one daemon thread, plus one daemon worker per device lane in
+mesh mode.  Every mutable field — pending map, cache, stats, every lane
+queue and breaker — is guarded by the one condition ``self._lock``; the
+dispatch and lane threads call only the backing verifier outside it,
+never a caller's lock — so they can never deadlock against the
+node/txpool lock domain.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from concurrent.futures import Future
 
 import numpy as np
+
+from eges_tpu.crypto.bucketing import bucket_round
 
 # sentinel distinguishing "cached None" (a signature that verifiably
 # fails recovery) from "not cached"
 _MISS = object()
 
+# the shared bucket model (back-compat alias: scheduler and verifier
+# both round through crypto/bucketing.bucket_round now)
+_bucket16 = bucket_round
 
-def _bucket16(n: int) -> int:
-    """The device bucket model (power of two, minimum 16) used to score
-    occupancy when the backing verifier exposes no ``_pad`` of its own
-    (e.g. the native verifier, which does not pad at all)."""
-    b = 16
-    while b < n:
-        b *= 2
-    return b
+
+class _DeviceLane:
+    """One device's window queue + dispatch bookkeeping (a mesh lane).
+
+    Single-device schedulers have exactly one lane driven inline by the
+    admission thread; in mesh mode each lane owns a worker thread
+    draining its queue, so one slow or dead device stalls only the
+    windows placed on it.  Every field here is guarded by the owning
+    scheduler's ``self._lock``.
+    """
+
+    __slots__ = ("index", "target", "queue", "thread", "breaker",
+                 "breaker_until", "inflight_rows", "queued_rows",
+                 "max_queue_depth", "stats")
+
+    def __init__(self, index: int, target):
+        self.index = index
+        self.target = target
+        self.queue: deque = deque()  # (batch, reason)
+        self.thread: threading.Thread | None = None
+        self.breaker = "closed"      # "closed" | "open"
+        self.breaker_until = 0.0
+        self.inflight_rows = 0       # rows at the device right now
+        self.queued_rows = 0         # rows waiting in self.queue
+        self.max_queue_depth = 0     # high-water of len(self.queue)
+        self.stats = {
+            "batches": 0, "rows": 0, "bucket_rows": 0,
+            "host_diverted": 0, "straggler_diverts": 0,
+            "device_errors": 0, "breaker_trips": 0,
+            "breaker_probes": 0, "breaker_diverted": 0,
+        }
+
+    def load(self) -> int:
+        """Placement score: rows waiting plus rows in flight."""
+        return self.queued_rows + self.inflight_rows
 
 
 class VerifierScheduler:
@@ -70,28 +127,45 @@ class VerifierScheduler:
 
     def __init__(self, verifier, *, window_ms: float = 2.0,
                  max_batch: int = 1024, cache_size: int = 4096,
-                 breaker_cooldown_s: float = 5.0, breaker_clock=None):
+                 breaker_cooldown_s: float = 5.0, breaker_clock=None,
+                 min_split: int = 16):
         self._verifier = verifier
         self._window_s = window_ms / 1e3
         self.max_batch = max_batch
         self.cache_size = cache_size
         # injectable device-failure hook (chaos harness / tests): called
-        # with the row count right before every device dispatch; raising
-        # is treated exactly like the device itself raising
+        # with the row count right before every device dispatch, on any
+        # lane; raising is treated exactly like the device itself
+        # raising.  Per-lane kills go through the lane target's own
+        # ``failure_hook`` instead.
         self.failure_hook = None
-        # circuit breaker around the device path: a device exception
-        # trips it OPEN (every window host-diverts, no device calls) for
-        # ``breaker_cooldown_s``; the first window after the cooldown is
-        # a HALF-OPEN probe — success closes the breaker, failure
-        # re-opens it.  ``breaker_clock`` is injectable so chaos runs
-        # can measure the cooldown in deterministic virtual time.
+        # circuit breaker around each lane's device path: a device
+        # exception trips that lane OPEN (its windows host-divert, no
+        # device calls) for ``breaker_cooldown_s``; the first window
+        # after the cooldown is a HALF-OPEN probe — success closes the
+        # lane's breaker, failure re-opens it.  ``breaker_clock`` is
+        # injectable so chaos runs can measure the cooldown in
+        # deterministic virtual time.
         self.breaker_cooldown_s = breaker_cooldown_s
         self.breaker_clock = breaker_clock or time.monotonic
-        self._breaker = "closed"          # "closed" | "open"
-        self._breaker_until = 0.0
-        # ONE condition guards every mutable field below; the dispatch
-        # thread waits on it for work / deadline / kick.
+        # ONE condition guards every mutable field below (including all
+        # lane queues); dispatch + lane threads wait on it.
         self._lock = threading.Condition()
+        # one window lane per device the verifier exposes; a verifier
+        # without device_targets() is itself the single lane's target
+        targets = None
+        probe = getattr(verifier, "device_targets", None)
+        if callable(probe):
+            targets = list(probe())
+        if not targets:
+            targets = [verifier]
+        self._lanes = [_DeviceLane(i, t) for i, t in enumerate(targets)]
+        # placement: a window larger than this splits across lanes
+        # (floor min_split keeps chunks worth a device dispatch)
+        self.min_split = max(1, min_split)
+        self._chunk_cap = max(self.min_split,
+                              -(-max_batch // len(self._lanes)))
+        self._rr = 0  # round-robin cursor breaking equal-load ties
         # LRU recovery cache: (sighash, sig) -> 20-byte address or None
         # (a deterministic recovery failure is cached too — re-gossiped
         # garbage must not re-reach the device either)
@@ -101,6 +175,7 @@ class VerifierScheduler:
         self._pending: OrderedDict[tuple, list] = OrderedDict()
         self._kick = False
         self._closed = False
+        self._admission_done = False  # set once the dispatch loop exits
         self._thread: threading.Thread | None = None
         self._stats = {
             "cache_hits": 0, "cache_misses": 0, "coalesced_rows": 0,
@@ -108,11 +183,15 @@ class VerifierScheduler:
             "kicks": 0, "flush_full": 0, "flush_deadline": 0,
             "flush_kick": 0, "flush_close": 0, "invalid": 0,
             "device_errors": 0, "breaker_trips": 0, "breaker_probes": 0,
-            "breaker_diverted": 0,
+            "breaker_diverted": 0, "window_splits": 0,
+            "straggler_diverts": 0,
         }
         # optional consensus event journal (utils/journal.py), attached
         # by the first owning node; flush decisions land in its stream
         self.journal = None
+        if len(self._lanes) > 1:
+            from eges_tpu.utils.metrics import DEFAULT as metrics
+            metrics.gauge("verifier.mesh_devices").set(len(self._lanes))
 
     # -- public async API -------------------------------------------------
 
@@ -237,10 +316,23 @@ class VerifierScheduler:
             return self._closed
 
     def close(self, timeout: float | None = 30.0) -> None:  # thread-entry
-        """Drain every pending future, then stop and join the dispatch
-        thread — no lost futures, no leaked thread.  If the dispatch
-        thread died (or the join times out), whatever is still pending
-        is failed with an error rather than left to hang callers."""
+        """Drain every pending future, then stop and join every thread —
+        no lost futures, no leaked threads.
+
+        The drain order is deterministic and documented:
+
+        1. the admission front flushes whatever is pending as one final
+           ``flush_close`` window (placed/run like any other) and the
+           dispatch thread exits;
+        2. each device lane drains its queue FIFO — lane workers exit
+           only after the admission thread is done, so a final window
+           placed during shutdown is always served — and lanes are
+           joined in ascending device index;
+        3. anything still unresolved (a dead thread or a join timeout)
+           is FAILED rather than left to hang callers: lane queues
+           first in ascending device index (FIFO within each lane), the
+           admission front last.
+        """
         with self._lock:
             self._closed = True
             self._kick = True
@@ -249,7 +341,23 @@ class VerifierScheduler:
         if t is not None:
             t.join(timeout)
         with self._lock:
-            leftovers = list(self._pending.values())
+            # the admission thread sets this on exit; force it if the
+            # thread never ran or the join timed out, so lane workers
+            # can stop waiting for more placements
+            self._admission_done = True
+            self._lock.notify_all()
+            lane_threads = [lane.thread for lane in self._lanes]
+        for lt in lane_threads:
+            if lt is not None:
+                lt.join(timeout)
+        leftovers: list[list] = []
+        with self._lock:
+            for lane in self._lanes:
+                while lane.queue:
+                    batch, _reason = lane.queue.popleft()
+                    lane.queued_rows -= len(batch)
+                    leftovers.extend(row for _k, row in batch)
+            leftovers.extend(self._pending.values())
             self._pending.clear()
         for futs, _t in leftovers:
             for f in futs:
@@ -259,12 +367,32 @@ class VerifierScheduler:
 
     def stats(self) -> dict:
         """Snapshot of scheduler counters (tests and the bench stage
-        read deltas here instead of the process-global registry)."""
+        read deltas here instead of the process-global registry).  The
+        flat keys are scheduler-wide aggregates — exactly the pre-mesh
+        surface — plus ``lanes`` and a ``devices`` list of per-lane
+        breakdowns (queue depth, in-flight rows, breaker state, rows /
+        batches / diverts / occupancy per device)."""
         with self._lock:
             out = dict(self._stats)
             out["cached_entries"] = len(self._cache)
             out["pending"] = len(self._pending)
-            out["breaker"] = self._breaker
+            out["breaker"] = ("open" if any(
+                lane.breaker == "open" for lane in self._lanes)
+                else "closed")
+            out["lanes"] = len(self._lanes)
+            devices = []
+            for lane in self._lanes:
+                d = {"device": lane.index,
+                     "queue_depth": len(lane.queue),
+                     "max_queue_depth": lane.max_queue_depth,
+                     "inflight_rows": lane.inflight_rows,
+                     "breaker": lane.breaker}
+                d.update(lane.stats)
+                d["occupancy"] = (
+                    round(lane.stats["rows"] / lane.stats["bucket_rows"], 4)
+                    if lane.stats["bucket_rows"] else None)
+                devices.append(d)
+            out["devices"] = devices
         return out
 
     # -- internals --------------------------------------------------------
@@ -272,10 +400,20 @@ class VerifierScheduler:
     def _ensure_thread(self) -> None:
         # caller holds self._lock
         if self._thread is None or not self._thread.is_alive():
+            self._admission_done = False
             self._thread = threading.Thread(
                 target=self._dispatch_loop, name="verifier-scheduler",
                 daemon=True)
             self._thread.start()
+
+    def _ensure_lane_thread(self, lane: _DeviceLane) -> None:
+        # caller holds self._lock; lane workers start lazily on first
+        # placement so single-lane schedulers never spawn them
+        if lane.thread is None or not lane.thread.is_alive():
+            lane.thread = threading.Thread(
+                target=self._lane_loop, args=(lane,),
+                name=f"verifier-lane-{lane.index}", daemon=True)
+            lane.thread.start()
 
     def _cache_put(self, key: tuple, addr) -> None:
         # caller holds self._lock
@@ -321,11 +459,17 @@ class VerifierScheduler:
                     if not f.done():
                         f.set_exception(exc)
             raise
+        finally:
+            with self._lock:
+                # lane workers drain-and-exit only once the admission
+                # front can place no further windows
+                self._admission_done = True
+                self._lock.notify_all()
 
     def _dispatch_forever(self) -> None:
         """Background flush loop: wait for work, coalesce inside the
-        micro-window, dispatch ONE batch, repeat.  Exits only once
-        closed AND drained."""
+        micro-window, place/dispatch ONE window, repeat.  Exits only
+        once closed AND drained."""
         while True:
             with self._lock:
                 while not self._pending and not self._closed:
@@ -345,45 +489,139 @@ class VerifierScheduler:
                     self._lock.wait(left)
                 if not self._pending:
                     continue
+                # "close" outranks "kick": close() raises the kick flag
+                # to wake the window wait, and the shutdown drain must
+                # be journaled as the documented flush_close step
                 reason = ("full" if len(self._pending) >= self.max_batch
-                          else "kick" if self._kick
-                          else "close" if self._closed else "deadline")
+                          else "close" if self._closed
+                          else "kick" if self._kick else "deadline")
                 self._stats["flush_" + reason] += 1
                 keys = list(self._pending)[: self.max_batch]
                 batch = [(k, self._pending.pop(k)) for k in keys]
                 if not self._pending:
                     self._kick = False
+            if len(self._lanes) > 1 and len(batch) > 1:
+                self._place(batch, reason)
+                continue
             try:
-                self._run_batch(batch, reason)
+                # single-lane (or singleton) windows dispatch inline on
+                # this thread — the pre-mesh behavior, no lane workers
+                self._run_batch(self._lanes[0], batch, reason)
             # the batch's futures were already resolved or failed inside
             # _run_batch's finally; the loop survives to the next window
             # analysis: allow-swallow(futures already resolved/failed in _run_batch finally)
             except Exception:
                 pass
 
-    def _breaker_admits(self) -> tuple[bool, bool]:
+    # -- mesh placement ---------------------------------------------------
+
+    def _place(self, batch, reason: str) -> None:
+        """Place one flushed window onto the device lanes.
+
+        A window at most ``chunk_cap = max(min_split, max_batch/lanes)``
+        rows fills the single least-loaded lane; a larger one splits
+        into contiguous near-equal chunks (each >= ``min_split`` rows)
+        placed on DISTINCT lanes in ascending load order, so a
+        saturating window reaches every device at once.  Equal-load
+        ties rotate round-robin — an idle mesh still spreads
+        back-to-back windows instead of pinning device 0.
+        """
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+
+        rows = len(batch)
+        n_chunks = 1
+        if rows > self._chunk_cap:
+            n_chunks = min(len(self._lanes), -(-rows // self._chunk_cap))
+            n_chunks = min(n_chunks, max(1, rows // self.min_split))
+        size = -(-rows // n_chunks)
+        chunks = [batch[i:i + size] for i in range(0, rows, size)]
+        with self._lock:
+            order = sorted(
+                self._lanes,
+                key=lambda L: (L.load(),
+                               (L.index - self._rr) % len(self._lanes)))
+            self._rr = (self._rr + 1) % len(self._lanes)
+            if len(chunks) > 1:
+                self._stats["window_splits"] += 1
+                metrics.counter("verifier.mesh_window_splits").inc()
+            for chunk, lane in zip(chunks, order):
+                lane.queue.append((chunk, reason))
+                lane.queued_rows += len(chunk)
+                lane.max_queue_depth = max(lane.max_queue_depth,
+                                           len(lane.queue))
+                metrics.gauge(
+                    f"verifier.mesh_queue_depth;device={lane.index}") \
+                    .set(len(lane.queue))
+                self._ensure_lane_thread(lane)
+            self._lock.notify_all()
+
+    def _lane_loop(self, lane: _DeviceLane) -> None:
+        """One device lane's worker: drain the lane queue FIFO; on an
+        unexpected loop death fail THIS lane's queued futures — other
+        lanes keep serving (straggler isolation)."""
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+        try:
+            while True:
+                with self._lock:
+                    while not lane.queue and not (
+                            self._closed and self._admission_done):
+                        self._lock.wait()
+                    if not lane.queue:
+                        return  # closed, admission drained, queue empty
+                    batch, reason = lane.queue.popleft()
+                    lane.queued_rows -= len(batch)
+                    lane.inflight_rows += len(batch)
+                    metrics.gauge(
+                        f"verifier.mesh_queue_depth;device={lane.index}") \
+                        .set(len(lane.queue))
+                try:
+                    self._run_batch(lane, batch, reason)
+                # analysis: allow-swallow(futures already resolved/failed in _run_batch finally; the lane survives to its next window)
+                except Exception:
+                    pass
+                finally:
+                    with self._lock:
+                        lane.inflight_rows -= len(batch)
+        except BaseException as exc:
+            with self._lock:
+                leftovers = list(lane.queue)
+                lane.queue.clear()
+                lane.queued_rows = 0
+            for b, _r in leftovers:
+                for _k, (futs, _t) in b:
+                    for f in futs:
+                        if not f.done():
+                            f.set_exception(exc)
+            raise
+
+    # -- breaker (per lane) -----------------------------------------------
+
+    def _breaker_admits(self, lane: _DeviceLane) -> tuple[bool, bool]:
         """(use_device, probing): closed -> dispatch normally; open ->
         host-divert until the cooldown elapses, then admit ONE half-open
         probe window."""
         from eges_tpu.utils.metrics import DEFAULT as metrics
         with self._lock:
-            if self._breaker == "closed":
+            if lane.breaker == "closed":
                 return True, False
-            if self.breaker_clock() >= self._breaker_until:
+            if self.breaker_clock() >= lane.breaker_until:
                 self._stats["breaker_probes"] += 1
+                lane.stats["breaker_probes"] += 1
                 probe = True
             else:
                 return False, False
         metrics.counter("verifier.breaker_probes").inc()
         return True, probe
 
-    def _breaker_trip(self, probing: bool) -> None:
+    def _breaker_trip(self, lane: _DeviceLane, probing: bool) -> None:
         from eges_tpu.utils.metrics import DEFAULT as metrics
         with self._lock:
             self._stats["device_errors"] += 1
             self._stats["breaker_trips"] += 1
-            self._breaker = "open"
-            self._breaker_until = self.breaker_clock() \
+            lane.stats["device_errors"] += 1
+            lane.stats["breaker_trips"] += 1
+            lane.breaker = "open"
+            lane.breaker_until = self.breaker_clock() \
                 + self.breaker_cooldown_s
         metrics.counter("verifier.device_errors").inc()
         metrics.counter("verifier.breaker_trips").inc()
@@ -391,22 +629,26 @@ class VerifierScheduler:
         journal = self.journal
         if journal is not None:
             journal.record("fault_breaker", state="open",
-                           probe=bool(probing),
+                           probe=bool(probing), device=lane.index,
                            cooldown_s=self.breaker_cooldown_s)
 
-    def _breaker_close(self) -> None:
+    def _breaker_close(self, lane: _DeviceLane) -> None:
         from eges_tpu.utils.metrics import DEFAULT as metrics
         with self._lock:
-            self._breaker = "closed"
-        metrics.gauge("verifier.breaker_state").set(0)
+            lane.breaker = "closed"
+            any_open = any(x.breaker == "open" for x in self._lanes)
+        metrics.gauge("verifier.breaker_state").set(1 if any_open else 0)
         journal = self.journal
         if journal is not None:
-            journal.record("fault_breaker", state="closed")
+            journal.record("fault_breaker", state="closed",
+                           device=lane.index)
 
-    def _run_batch(self, batch, reason: str) -> None:
-        """Dispatch one coalesced batch OUTSIDE the scheduler lock (the
-        device call is the long pole; submitters keep queueing into the
-        next window meanwhile)."""
+    # -- window execution -------------------------------------------------
+
+    def _run_batch(self, lane: _DeviceLane, batch, reason: str) -> None:
+        """Dispatch one coalesced window (or mesh chunk) on ``lane``,
+        OUTSIDE the scheduler lock (the device call is the long pole;
+        submitters keep queueing into the next window meanwhile)."""
         from eges_tpu.utils import tracing
         from eges_tpu.utils.metrics import DEFAULT as metrics
 
@@ -415,7 +657,9 @@ class VerifierScheduler:
         keys = [k for k, _ in batch]
         results = [None] * rows
         computed = False
+        diverted = False
         failure: BaseException | None = None
+        mesh = len(self._lanes) > 1
         try:
             if rows == 1:
                 # singleton divert: a padded 1-row device dispatch costs
@@ -424,15 +668,19 @@ class VerifierScheduler:
                 results[0] = self._host_recover(keys[0])
                 with self._lock:
                     self._stats["host_diverted"] += 1
+                    lane.stats["host_diverted"] += 1
             else:
-                use_device, probing = self._breaker_admits()
+                use_device, probing = self._breaker_admits(lane)
                 if not use_device:
-                    # breaker open: the device is presumed dead — the
-                    # whole window takes the host recover path so
-                    # consensus keeps committing
+                    # breaker open: this lane's device is presumed dead
+                    # — the whole window takes the host recover path so
+                    # consensus keeps committing (other lanes are
+                    # unaffected: the breaker is lane-scoped)
                     results = [self._host_recover(k) for k in keys]
+                    diverted = True
                     with self._lock:
                         self._stats["breaker_diverted"] += rows
+                        lane.stats["breaker_diverted"] += rows
                 else:
                     sigs = np.zeros((rows, 65), np.uint8)
                     hashes = np.zeros((rows, 32), np.uint8)
@@ -443,22 +691,24 @@ class VerifierScheduler:
                         hook = self.failure_hook
                         if hook is not None:
                             hook(rows)
-                        addrs, ok = self._verifier.recover_addresses(
+                        addrs, ok = lane.target.recover_addresses(
                             sigs, hashes)
                         results = [bytes(addrs[i]) if ok[i] else None
                                    for i in range(rows)]
                         if probing:
-                            self._breaker_close()
+                            self._breaker_close(lane)
                     # analysis: allow-swallow(a device exception diverts
                     # exactly this window to the host model — the queued
-                    # futures still resolve correctly — and trips the
-                    # circuit breaker for the windows after it)
+                    # futures still resolve correctly — and trips this
+                    # lane's circuit breaker for the windows after it)
                     except Exception:
-                        self._breaker_trip(probing)
+                        self._breaker_trip(lane, probing)
                         results = [self._host_recover(k) for k in keys]
+                        diverted = True
             computed = True
             dt = time.monotonic() - t0
-            pad = getattr(self._verifier, "_pad", _bucket16)
+            pad = getattr(lane.target, "_pad", None) \
+                or getattr(self._verifier, "_pad", None) or bucket_round
             bucket = pad(rows) if rows > 1 else 1  # diverted rows pad nothing
             waited = t0 - min(t for _, (_, t) in batch)
             with self._lock:
@@ -467,21 +717,43 @@ class VerifierScheduler:
                 self._stats["batches"] += 1
                 self._stats["rows"] += rows
                 self._stats["bucket_rows"] += bucket
+                lane.stats["batches"] += 1
+                lane.stats["rows"] += rows
+                lane.stats["bucket_rows"] += bucket
+                if diverted and mesh:
+                    self._stats["straggler_diverts"] += 1
+                    lane.stats["straggler_diverts"] += 1
             for _, (_, t_sub) in batch:
                 metrics.histogram("verifier.sched_queue_wait_seconds") \
                     .observe(t0 - t_sub)
             metrics.histogram("verifier.sched_batch_rows").observe(rows)
             metrics.histogram("verifier.sched_occupancy") \
                 .observe(rows / bucket)
+            if mesh:
+                metrics.counter(
+                    f"verifier.mesh_rows;device={lane.index}").inc(rows)
+                metrics.histogram(
+                    f"verifier.mesh_occupancy;device={lane.index}") \
+                    .observe(rows / bucket)
+                if diverted:
+                    metrics.counter(
+                        f"verifier.mesh_straggler_diverts"
+                        f";device={lane.index}").inc()
             tracing.DEFAULT.record_span(
                 "verifier.sched_dispatch", dt, rows=rows, bucket=bucket,
                 reason=reason, occupancy=round(rows / bucket, 4),
-                waited_ms=round(waited * 1e3, 3))
+                device=lane.index, waited_ms=round(waited * 1e3, 3))
             journal = self.journal
             if journal is not None:
                 journal.record("verifier_flush", rows=rows, reason=reason,
                                occupancy=round(rows / bucket, 4),
                                waited_ms=round(waited * 1e3, 3))
+                if mesh:
+                    journal.record("verifier_mesh_dispatch",
+                                   device=lane.index, rows=rows,
+                                   occupancy=round(rows / bucket, 4),
+                                   diverted=diverted,
+                                   queue_wait_ms=round(waited * 1e3, 3))
         except BaseException as exc:
             failure = exc
             raise
@@ -508,9 +780,9 @@ def scheduler_for(verifier, **kwargs) -> VerifierScheduler | None:
     The scheduler rides as an attribute on the verifier itself, so every
     component holding the same device facade — all sim-cluster nodes,
     the chain, the txpool — shares one coalescing window and one
-    recovery cache, and the pair is garbage-collected together.  ``None``
-    (host-fallback mode) passes through: those nodes keep the per-entry
-    host path.
+    recovery cache (and, for mesh verifiers, one set of device lanes),
+    and the pair is garbage-collected together.  ``None`` (host-fallback
+    mode) passes through: those nodes keep the per-entry host path.
     """
     if verifier is None:
         return None

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from eges_tpu.crypto.bucketing import bucket_round
 from eges_tpu.ops import bigint, ec, keccak_tpu
 
 
@@ -123,13 +124,6 @@ def make_sharded_ecrecover(mesh: jax.sharding.Mesh, axis: str = "dp"):
                       tally_out=2)
 
 
-def _bucket(n: int, minimum: int = 16) -> int:
-    b = minimum
-    while b < n:
-        b *= 2
-    return b
-
-
 class BatchVerifier:
     """Host facade over the jitted verifier graphs.
 
@@ -139,13 +133,21 @@ class BatchVerifier:
     """
 
     def __init__(self, mesh: jax.sharding.Mesh | None = None, axis: str = "dp",
-                 min_bucket: int = 16, debug_timing: bool | None = None):
+                 min_bucket: int = 16, debug_timing: bool | None = None,
+                 collective: str = "auto"):
         self._mesh = mesh
         self._axis = axis
         self._min_bucket = min_bucket
+        # topology-aware tally collective: "auto" resolves psum-vs-ring
+        # per (device count, bucket) from the measured MESH_SCALING.json
+        # A/B the first time each bucket is dispatched; "psum"/"ring"
+        # pin it (EGES_MESH_COLLECTIVE pins it process-wide)
+        self._collective = collective
+        self._collective_fns: dict[str, object] = {}
+        self._collective_by_bucket: dict[int, str] = {}
         if mesh is not None:
-            self._sharded = make_sharded_ecrecover(mesh, axis)
             self._ndev = mesh.shape[axis]
+            self._sharded = self._sharded_dispatch
         else:
             self._sharded = None
             self._ndev = 1
@@ -181,6 +183,40 @@ class BatchVerifier:
         hook = self.failure_hook
         if hook is not None:
             hook(n)
+
+    def collective_for(self, bucket: int) -> str:
+        """Resolve (and pin) the tally collective for one bucket —
+        ``"psum"`` or ``"ring"`` per the measured A/B (or the env/ctor
+        override).  Single-device facades have no collective."""
+        if self._mesh is None:
+            return "none"
+        name = self._collective_by_bucket.get(bucket)
+        if name is None:
+            name = self._collective
+            if name == "auto":
+                from eges_tpu.parallel.ring import preferred_collective
+                name = preferred_collective(self._ndev, bucket)
+            if self._ndev <= 1:
+                name = "psum"  # a 1-wide ring is just overhead
+            self._collective_by_bucket[bucket] = name
+        return name
+
+    def _sharded_dispatch(self, ds, dh):
+        """The mesh path: route one padded batch through the collective
+        chosen for its bucket (both variants return the identical
+        ``(addrs, pubs, ok, tally)`` — the tally is bitwise-equal by
+        construction, only the traffic pattern differs)."""
+        name = self.collective_for(int(ds.shape[0]))
+        fn = self._collective_fns.get(name)
+        if fn is None:
+            if name == "ring":
+                from eges_tpu.parallel.ring import ring_tally
+                fn = ring_tally(ecrecover_batch, self._mesh, self._axis,
+                                n_in=2, n_out=3, tally_out=2)
+            else:
+                fn = make_sharded_ecrecover(self._mesh, self._axis)
+            self._collective_fns[name] = fn
+        return fn(ds, dh)
 
     def _staging(self, b: int, with_pubs: bool = False) -> dict:
         # caller holds self._staging_lock
@@ -223,7 +259,7 @@ class BatchVerifier:
             metrics.counter("verifier.prewarmed_buckets").inc()
 
     def _pad(self, n: int) -> int:
-        b = _bucket(max(n, 1), self._min_bucket)
+        b = bucket_round(max(n, 1), self._min_bucket)
         # round up to a device multiple so shards stay even (works for any
         # device count, not just powers of two)
         return -(-b // self._ndev) * self._ndev
@@ -340,10 +376,111 @@ class BatchVerifier:
         return out
 
 
+class _DeviceTarget:
+    """Single-device dispatch facade — one mesh lane's endpoint.
+
+    The scheduler's per-device window queues need an object that runs a
+    whole micro-window on ONE chip: pad to the plain bucket (no
+    device-multiple rounding — nothing is sharded here), pin the staged
+    arrays to this lane's device with ``device_put``, and drive the
+    parent's shared jitted single-device graph.  Each target owns its
+    staging buffers and lock so lanes upload/dispatch concurrently
+    instead of serializing on the parent's staging lock."""
+
+    def __init__(self, parent: "MeshBatchVerifier", device, index: int):
+        self._parent = parent
+        self.device = device
+        self.index = index
+        # per-lane fault injection: the chaos harness kills ONE device's
+        # dispatch by raising here; the scheduler's per-lane breaker is
+        # the consumer
+        self.failure_hook = None
+        self._stage: dict[int, tuple] = {}
+        self._lock = threading.Lock()
+
+    def _pad(self, n: int) -> int:
+        return bucket_round(max(n, 1), self._parent._min_bucket)
+
+    def recover_addresses(self, sigs: np.ndarray, hashes: np.ndarray):
+        import time
+
+        n = sigs.shape[0]
+        if n == 0:
+            return np.zeros((0, 20), np.uint8), np.zeros((0,), bool)
+        hook = self.failure_hook
+        if hook is not None:
+            hook(n)
+        parent = self._parent
+        b = self._pad(n)
+        cached = b in parent._compiled_buckets
+        with self._lock:
+            st = self._stage.get(b)
+            if st is None:
+                st = (np.zeros((b, 65), np.uint8),
+                      np.zeros((b, 32), np.uint8))
+                self._stage[b] = st
+            ps, ph = st
+            ps[:n] = sigs
+            ps[n:] = 0
+            ph[:n] = hashes
+            ph[n:] = 0
+            t0 = time.monotonic()
+            ds = jax.device_put(ps, self.device)
+            dh = jax.device_put(ph, self.device)
+            if parent.debug_timing:
+                jax.block_until_ready((ds, dh))
+            t1 = time.monotonic()
+            addrs, _pubs, ok = parent._recover(ds, dh)
+            jax.block_until_ready(ok)
+            t2 = time.monotonic()
+            out = (np.asarray(addrs)[:n],
+                   np.asarray(ok)[:n].astype(bool))
+            t3 = time.monotonic()
+        parent._compiled_buckets.add(b)
+        parent._record_batch("ecrecover", n, b, cached, t0, t1, t2, t3)
+        return out
+
+
+class MeshBatchVerifier(BatchVerifier):
+    """The multi-device facade the mesh scheduler targets.
+
+    Two dispatch surfaces over one device set:
+
+    * the inherited full-mesh path (``ecrecover``/``verify`` shard rows
+      over every chip, ACK tally via the topology-aware psum/ring
+      collective) for monolithic block-sized batches;
+    * :meth:`device_targets` — per-device single-chip facades the
+      scheduler's window lanes drive independently, so concurrent
+      micro-windows land on different chips instead of all riding one
+      sharded computation (the load-balancing the flat MESH_SCALING
+      curve was missing).
+    """
+
+    def __init__(self, mesh: jax.sharding.Mesh | None = None,
+                 axis: str = "dp", min_bucket: int = 16,
+                 debug_timing: bool | None = None,
+                 collective: str = "auto"):
+        if mesh is None:
+            from eges_tpu.parallel import data_parallel_mesh
+            mesh = data_parallel_mesh(axis=axis)
+        super().__init__(mesh=mesh, axis=axis, min_bucket=min_bucket,
+                         debug_timing=debug_timing, collective=collective)
+        self._targets = [
+            _DeviceTarget(self, d, i)
+            for i, d in enumerate(np.asarray(mesh.devices).reshape(-1))
+        ]
+
+    def device_targets(self) -> list:
+        """The per-device dispatch facades, in device order — the
+        scheduler builds one window lane per entry."""
+        return list(self._targets)
+
+
 @functools.lru_cache(maxsize=1)
 def default_verifier() -> BatchVerifier:
-    """Process-wide verifier on the default device set: a 1-axis mesh over
-    all local devices if there are several, else single-device."""
+    """Process-wide verifier on the default device set: a mesh-sharded
+    facade over all local devices if there are several (so the attached
+    scheduler grows one window lane per device), else single-device."""
     devs = jax.devices()
     # surface WHICH device serves the batches through thw_metrics so a
     # cluster run's >95%-on-device claim names its hardware (BASELINE
@@ -353,5 +490,5 @@ def default_verifier() -> BatchVerifier:
     metrics.gauge("verifier.device_name").set(str(devs[0]))
     if len(devs) > 1:
         mesh = jax.sharding.Mesh(np.array(devs), ("dp",))
-        return BatchVerifier(mesh=mesh)
+        return MeshBatchVerifier(mesh=mesh)
     return BatchVerifier()

@@ -103,6 +103,28 @@ class NativeBatchVerifier:
         return ok & (addrs == want).all(axis=1)
 
 
+class NativeMeshVerifier(NativeBatchVerifier):
+    """An N-lane *virtual mesh* of host verifiers — the JAX-free
+    analogue of :class:`~eges_tpu.crypto.verifier.MeshBatchVerifier`.
+
+    ``device_targets()`` hands the scheduler one independent
+    :class:`NativeBatchVerifier` per virtual device, so sims, tier-1
+    tests, and chaos scenarios exercise the full mesh dispatch machinery
+    (per-device window lanes, placement, splitting, per-lane breakers)
+    on hosts with no accelerator at all.  Results are bit-identical to a
+    single :class:`NativeBatchVerifier` — only the dispatch fan-out
+    differs."""
+
+    def __init__(self, n_devices: int):
+        super().__init__()
+        if n_devices < 1:
+            raise ValueError("a mesh needs at least one device")
+        self._targets = [NativeBatchVerifier() for _ in range(n_devices)]
+
+    def device_targets(self) -> list:
+        return list(self._targets)
+
+
 def batch_verify_txns(txns, verifier) -> bool:
     """Verify the signed (non-Geec) transactions of a block as one device
     batch; the single shared implementation behind both the acceptor ACK

@@ -133,6 +133,14 @@ class NodeService:
         if verifier is not None:
             from eges_tpu.crypto.scheduler import scheduler_for
             verifier = scheduler_for(verifier)
+            # a mesh verifier (default_verifier over >1 visible device)
+            # turns the scheduler into the mesh dispatcher: one window
+            # lane per device.  Surface the topology in the service log
+            # so an operator can see the fan-out without scraping stats.
+            lanes = verifier.stats()["lanes"]
+            if lanes > 1:
+                self.log.geec("verifier mesh dispatch enabled",
+                              devices=lanes)
 
         os.makedirs(cfg.datadir, exist_ok=True)
         store = FileStore(os.path.join(cfg.datadir, "chaindata"))

@@ -12,6 +12,20 @@ from __future__ import annotations
 import numpy as np
 
 
+def shard_map_fn():
+    """Resolve ``shard_map`` across JAX versions: new releases export it
+    as ``jax.shard_map``; the pinned toolchain here still ships it under
+    ``jax.experimental.shard_map``.  Every shard_map user in the tree
+    goes through this one resolver so a JAX bump touches one line."""
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
 def data_parallel_mesh(devices=None, axis: str = "dp"):
     """A 1-axis mesh over ``devices`` (default: all local devices)."""
     import jax
@@ -48,6 +62,6 @@ def shard_rows(fn, mesh, axis: str = "dp", *, n_in: int, n_out: int,
     out_specs = tuple([PS(axis)] * n_out
                       + ([PS()] if tally_out is not None else []))
     return jax.jit(
-        jax.shard_map(shard_fn, mesh=mesh,
-                      in_specs=tuple([PS(axis)] * n_in),
-                      out_specs=out_specs))
+        shard_map_fn()(shard_fn, mesh=mesh,
+                       in_specs=tuple([PS(axis)] * n_in),
+                       out_specs=out_specs))

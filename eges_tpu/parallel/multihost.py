@@ -174,12 +174,14 @@ def _worker_body(process_id: int, num_processes: int,
     assert int(tally) == rows, f"pid {process_id}: tally {int(tally)} != {rows}"
     # outputs are globally sharded; each process checks the rows it owns
     checked = 0
-    ok_shards = {s.index[0]: np.asarray(s.data)
+    # slice objects are unhashable before py3.12 — key by their bounds
+    ok_shards = {(s.index[0].start, s.index[0].stop): np.asarray(s.data)
                  for s in ok.addressable_shards}
     for shard in addrs.addressable_shards:
         rs = shard.index[0]
         data = np.asarray(shard.data)
-        assert ok_shards[rs].all(), f"pid {process_id}: rejected valid rows"
+        assert ok_shards[(rs.start, rs.stop)].all(), (
+            f"pid {process_id}: rejected valid rows")
         for j, i in enumerate(range(*rs.indices(rows))):
             want = host.pubkey_to_address(host.privkey_to_pubkey(privs[i]))
             assert bytes(data[j]) == want, (

@@ -38,16 +38,26 @@ class SimCluster:
                  drop_rate: float = 0.0, failure_test: bool = False,
                  verifier=None, mine=None, signed: bool = True,
                  alloc: dict | None = None, txpool: bool = False,
-                 fast_sync: set | None = None, defer: set | None = None):
+                 fast_sync: set | None = None, defer: set | None = None,
+                 mesh_devices: int | None = None):
         self.clock = SimClock()
         self.net = SimNet(self.clock, seed=seed, drop_rate=drop_rate)
         self.nodes: list[SimNode] = []
 
+        # mesh_devices builds an N-lane virtual mesh of host verifiers
+        # (JAX-free), so sims and chaos runs exercise the scheduler's
+        # per-device window lanes without an accelerator
+        if verifier is None and mesh_devices:
+            from eges_tpu.crypto.verify_host import NativeMeshVerifier
+            verifier = NativeMeshVerifier(mesh_devices)
+
         # every node shares ONE coalescing scheduler + recovery cache
         # around the supplied verifier (crypto/scheduler.py): the same
         # vote signature verified by N sim nodes costs one device row
-        # and N-1 cache hits.  verifier=None (host fallback) passes
-        # through untouched.
+        # and N-1 cache hits.  A mesh verifier (device_targets()) makes
+        # that shared scheduler a mesh dispatcher — one window lane per
+        # device, shared by every sim node.  verifier=None (host
+        # fallback) passes through untouched.
         from eges_tpu.crypto.scheduler import scheduler_for
         verifier = scheduler_for(verifier)
         self.verifier = verifier

@@ -59,6 +59,10 @@ EVENT_TYPES = frozenset({
     # verifier scheduler (crypto/scheduler.py): one coalesced dispatch
     # window flushed to the device or host-diverted
     "verifier_flush",
+    # mesh dispatch (crypto/scheduler.py): one window/chunk served by a
+    # specific device lane — device index, rows, queue wait, and whether
+    # the lane host-diverted it (straggler rescue)
+    "verifier_mesh_dispatch",
     # fault injection (sim/faults.py + harness/chaos.py): every
     # scripted fault lands in the journal stream so the observatory can
     # render the fault timeline next to the consensus events it caused

@@ -58,6 +58,11 @@ METRIC_FAMILIES = frozenset({
     # crypto/scheduler.py — fail-safe circuit breaker around the device
     "verifier.breaker_probes", "verifier.breaker_state",
     "verifier.breaker_trips", "verifier.device_errors",
+    # crypto/scheduler.py — mesh dispatch (per-device window lanes);
+    # the per-device families carry a ``;device=N`` label
+    "verifier.mesh_devices", "verifier.mesh_occupancy",
+    "verifier.mesh_queue_depth", "verifier.mesh_rows",
+    "verifier.mesh_straggler_diverts", "verifier.mesh_window_splits",
 })
 
 

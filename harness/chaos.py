@@ -51,6 +51,12 @@ from harness import observatory
 VOLATILE_KEYS = {
     "verifier_flush": ("waited_ms",),      # real queue wait
     "block_committed": ("dt",),            # real insert duration
+    # real queue wait + thread-race-dependent lane choice: which device
+    # serves a window depends on real dispatch timing, so the whole
+    # event is scheduling metadata, not protocol content ("bit-identical
+    # modulo device index")
+    "verifier_mesh_dispatch": ("queue_wait_ms", "device", "occupancy",
+                               "rows", "diverted"),
 }
 
 
@@ -296,6 +302,56 @@ def _scn_verifier_blackout(seed: int, fast: bool) -> dict:
     return res
 
 
+def _scn_mesh_device_blackout(seed: int, fast: bool) -> dict:
+    """One device of a 4-lane verifier mesh dies: every dispatch on that
+    lane raises.  Only THAT lane's windows may divert — its per-lane
+    breaker trips and stays open (cooldown beyond the run) — while every
+    other lane keeps the device path, and consensus keeps committing
+    signed blocks throughout."""
+    from eges_tpu.crypto.scheduler import VerifierScheduler
+    from eges_tpu.crypto.verify_host import NativeMeshVerifier
+
+    mesh = NativeMeshVerifier(4)
+    # long window => flushes are kick-driven only (deterministic rows);
+    # a huge cooldown pins the dead lane's breaker open for the run
+    sched = VerifierScheduler(mesh, window_ms=10_000.0,
+                              breaker_cooldown_s=1e9)
+    cluster = SimCluster(4, seed=seed, verifier=sched, signed=True)
+    sched.breaker_clock = cluster.clock.now
+    victim = 2
+
+    def _dead_lane(rows: int) -> None:
+        raise RuntimeError("device 2 lost (injected mesh blackout)")
+
+    mesh.device_targets()[victim].failure_hook = _dead_lane
+    inj = FaultInjector(cluster)     # journals the (empty) fault plan
+    cluster.start()
+    blocks = 4 if fast else 6
+    cluster.run(600.0,
+                stop_condition=lambda: cluster.min_height() >= blocks)
+    stats = sched.stats()
+    devs = stats["devices"]
+    dead = devs[victim]
+    healthy = [d for d in devs if d["device"] != victim]
+    res = _finish("mesh_device_blackout", seed, cluster,
+                  extra_blocks=2, bound_s=240.0,
+                  checks={
+                      "dead_lane_breaker_open":
+                          dead["breaker"] == "open",
+                      "dead_lane_diverted":
+                          dead["straggler_diverts"] > 0
+                          or dead["breaker_diverted"] > 0,
+                      "healthy_lanes_untouched": all(
+                          d["device_errors"] == 0
+                          and d["breaker"] == "closed" for d in healthy),
+                      "healthy_lanes_served": any(
+                          d["rows"] > 0 for d in healthy),
+                  })
+    sched.close()
+    res["verifier"] = sched.stats()
+    return res
+
+
 def _scn_combo(seed: int, fast: bool) -> dict:
     """The acceptance storm: leader-kill + 20% loss + an asymmetric
     partition, all at once, then heal everything.  Live nodes must
@@ -330,6 +386,7 @@ SCENARIOS = {
     "asym_partition_ttl": _scn_asym_partition_ttl,
     "corruption_flood": _scn_corruption_flood,
     "verifier_blackout": _scn_verifier_blackout,
+    "mesh_device_blackout": _scn_mesh_device_blackout,
     "combo": _scn_combo,
 }
 

@@ -1,13 +1,17 @@
 """Bench regression gate over ``harness/bench_history.jsonl``.
 
 Each ``bench.py`` round appends its final JSON line to the history
-file.  This gate compares the newest entry's primary metric
-(``value``, verifies/s/chip) against the previous entry and exits
-non-zero when it dropped more than the threshold (default 20%) — the
-CI tripwire for perf regressions that unit tests can't see.
+file.  This gate groups entries by their ``metric`` name (legacy lines
+without one form their own group), compares each group's newest
+``value`` against its previous one, and exits non-zero when ANY metric
+dropped more than the threshold (default 20%) — the CI tripwire for
+perf regressions that unit tests can't see.  The verifier bench's
+``secp256k1_ecrecover_verifies_per_sec_per_chip`` and the mesh stage's
+aggregate ``mesh_sharded_rows_per_s`` gate independently: a mesh
+dispatch regression cannot hide behind a healthy single-chip number.
 
-Exit codes: 0 ok (or fewer than two comparable entries), 1 regression,
-2 unreadable history.
+Exit codes: 0 ok (or fewer than two comparable entries per metric),
+1 regression, 2 unreadable history.
 
 Usage::
 
@@ -45,22 +49,43 @@ def load_history(path: str) -> list[dict]:
 
 
 def check(entries: list[dict], threshold: float = 0.20) -> tuple[int, str]:
-    """(exit_code, message) for the newest-vs-previous comparison."""
-    if len(entries) < 2:
-        return 0, "ok: %d comparable entr%s — nothing to compare" % (
-            len(entries), "y" if len(entries) == 1 else "ies")
-    prev, last = entries[-2], entries[-1]
-    pv, lv = float(prev["value"]), float(last["value"])
-    if pv <= 0:
-        return 0, "ok: previous value %.1f is not a usable baseline" % pv
-    drop = (pv - lv) / pv
-    detail = "%.1f -> %.1f %s (%+.1f%%)" % (
-        pv, lv, last.get("unit", ""), -drop * 100.0)
-    if drop > threshold:
-        return 1, "REGRESSION: %s exceeds the %.0f%% threshold" % (
-            detail, threshold * 100.0)
-    return 0, "ok: %s within the %.0f%% threshold" % (
-        detail, threshold * 100.0)
+    """(exit_code, message) for the per-metric newest-vs-previous
+    comparison.  Entries are grouped by their ``metric`` name; legacy
+    lines without one share the verifier bench's default group so the
+    pre-mesh history keeps gating unchanged."""
+    groups: dict[str, list[dict]] = {}
+    for e in entries:
+        name = e.get("metric")
+        if not isinstance(name, str) or not name:
+            name = "secp256k1_ecrecover_verifies_per_sec_per_chip"
+        groups.setdefault(name, []).append(e)
+    lines, code = [], 0
+    for name in sorted(groups):
+        series = groups[name]
+        if len(series) < 2:
+            lines.append("ok [%s]: %d comparable entr%s — nothing to "
+                         "compare" % (name, len(series),
+                                      "y" if len(series) == 1 else "ies"))
+            continue
+        prev, last = series[-2], series[-1]
+        pv, lv = float(prev["value"]), float(last["value"])
+        if pv <= 0:
+            lines.append("ok [%s]: previous value %.1f is not a usable "
+                         "baseline" % (name, pv))
+            continue
+        drop = (pv - lv) / pv
+        detail = "%.1f -> %.1f %s (%+.1f%%)" % (
+            pv, lv, last.get("unit", ""), -drop * 100.0)
+        if drop > threshold:
+            code = 1
+            lines.append("REGRESSION [%s]: %s exceeds the %.0f%% "
+                         "threshold" % (name, detail, threshold * 100.0))
+        else:
+            lines.append("ok [%s]: %s within the %.0f%% threshold" % (
+                name, detail, threshold * 100.0))
+    if not lines:
+        return 0, "ok: 0 comparable entries — nothing to compare"
+    return code, "\n".join(lines)
 
 
 def main(argv=None) -> int:

@@ -68,6 +68,51 @@ for name, fn in (
     out[name] = {{"rows_per_s": round(rows / dt, 1),
                   "step_s": round(dt, 3),
                   "compile_s": round(compile_s, 1)}}
+# the measured A/B is the ground truth preferred_collective() consults:
+# record the winner so the artifact is self-describing
+out["collective"] = ("psum" if out["psum"]["rows_per_s"]
+                     >= out["ring"]["rows_per_s"] else "ring")
+
+# scheduler saturation stage: the SAME rows admitted through the mesh
+# dispatcher (one window lane per device) instead of one monolithic
+# sharded call — measures the dispatch front's aggregate throughput and
+# each lane's occupancy, the numbers the mesh regression gate watches
+from eges_tpu.crypto.scheduler import VerifierScheduler
+from eges_tpu.crypto.verifier import MeshBatchVerifier
+
+mesh_v = MeshBatchVerifier(mesh=mesh, axis="dp")
+# cache_size=1 so every timed pass re-reaches the device (the LRU would
+# otherwise absorb passes 2+); window_ms huge + max_batch=rows so each
+# pass flushes as ONE full window that _place() splits across all lanes
+sched = VerifierScheduler(mesh_v, window_ms=10_000.0, max_batch=rows,
+                          cache_size=1)
+entries = [(bytes(hashes[i]), bytes(sigs[i])) for i in range(rows)]
+
+def one_pass():
+    futs = [sched.submit(h, s) for (h, s) in entries]
+    sched.kick()
+    for f in futs:
+        f.result()
+
+t0 = time.monotonic()
+one_pass()  # compiles each lane's per-device graph
+sched_compile_s = time.monotonic() - t0
+reps, t0 = 3, time.monotonic()
+for _ in range(reps):
+    one_pass()
+dt = (time.monotonic() - t0) / reps
+st = sched.stats()
+sched.close()
+out["sched"] = {{
+    "rows_per_s": round(rows / dt, 1),
+    "step_s": round(dt, 3),
+    "compile_s": round(sched_compile_s, 1),
+    "window_splits": st["window_splits"],
+    "per_device": [
+        {{"device": d["device"], "rows": d["rows"],
+          "batches": d["batches"], "occupancy": d["occupancy"]}}
+        for d in st["devices"]],
+}}
 print("SCALING " + json.dumps(out), flush=True)
 """
 
@@ -97,16 +142,17 @@ def measure(devices: int, rows: int, timeout: float = 1200.0) -> dict | None:
     return None
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--rows", type=int, default=2048)
-    ap.add_argument("--devices", default="1,2,4,8")
-    ap.add_argument("--out", default=os.path.join(REPO,
-                                                  "MESH_SCALING.json"))
-    args = ap.parse_args()
+def run(rows: int = 2048, devices: tuple[int, ...] = (1, 2, 4, 8),
+        out: str | None = None, timeout: float = 1200.0) -> dict:
+    """Measure every device count and (re)write the scaling artifact.
+
+    The callable core behind both the CLI below and ``bench.py mesh`` —
+    returns the artifact document (each point carries the psum/ring A/B,
+    the recorded ``collective`` winner, and the ``sched`` stage's
+    aggregate rows/s + per-device occupancy)."""
     points = []
-    for d in [int(x) for x in args.devices.split(",")]:
-        got = measure(d, args.rows)
+    for d in devices:
+        got = measure(d, rows, timeout)
         print(f"[mesh-scaling] devices={d}: {got}")
         if got is not None:
             points.append(got)
@@ -118,9 +164,23 @@ def main() -> None:
                 "real multi-chip hardware",
         "points": points,
     }
-    with open(args.out, "w") as f:
+    if out is None:
+        out = os.path.join(REPO, "MESH_SCALING.json")
+    with open(out, "w") as f:
         json.dump(doc, f, indent=1)
-    print(f"[mesh-scaling] wrote {args.out}")
+    print(f"[mesh-scaling] wrote {out}")
+    return doc
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=2048)
+    ap.add_argument("--devices", default="1,2,4,8")
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "MESH_SCALING.json"))
+    args = ap.parse_args()
+    run(args.rows, tuple(int(x) for x in args.devices.split(",")),
+        args.out)
 
 
 if __name__ == "__main__":

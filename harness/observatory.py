@@ -49,7 +49,7 @@ CONSUMED = ("election_started", "election_won", "election_lost",
             "block_confirmed",
             "fault_crash", "fault_restart", "fault_partition",
             "fault_heal", "fault_link", "fault_net", "fault_skew",
-            "fault_trigger", "fault_breaker")
+            "fault_trigger", "fault_breaker", "verifier_mesh_dispatch")
 
 _TIMELINE = ("election_started", "election_won", "election_lost",
              "version_bump")
@@ -104,11 +104,23 @@ def summarize(by_node: dict[str, list[dict]],
     timeline: dict[int, list[tuple]] = {}
     # flat, time-ordered fault timeline (injector + breaker events)
     faults: list[tuple] = []
+    # device index -> aggregated mesh-dispatch stats (the scheduler's
+    # per-device window lanes); occupancy is deterministic (rows vs
+    # bucket), queue wait is wall-clock and deliberately excluded
+    mesh: dict[int, dict] = {}
 
     for name in sorted(by_node):
         for ev in by_node[name]:
             typ = ev.get("type")
             blk = ev.get("blk")
+            if typ == "verifier_mesh_dispatch":
+                d = mesh.setdefault(int(ev.get("device", -1)), {
+                    "windows": 0, "rows": 0, "diverted": 0, "_occ": 0.0})
+                d["windows"] += 1
+                d["rows"] += int(ev.get("rows", 0))
+                d["diverted"] += 1 if ev.get("diverted") else 0
+                d["_occ"] += float(ev.get("occupancy", 0.0))
+                continue
             if typ in _FAULTS:
                 faults.append((round(float(ev["ts"]), 6),
                                int(ev.get("seq", 0)), name, typ,
@@ -190,6 +202,11 @@ def summarize(by_node: dict[str, list[dict]],
         "fault_timeline": [
             {"ts": ts, "node": name, "type": typ, "line": line}
             for ts, _seq, name, typ, line in sorted(faults)],
+        "verifier_mesh": {
+            dev: {"windows": d["windows"], "rows": d["rows"],
+                  "diverted": d["diverted"],
+                  "mean_occupancy": round(d["_occ"] / d["windows"], 4)}
+            for dev, d in sorted(mesh.items())},
     }
 
 
@@ -273,6 +290,14 @@ def render(summary: dict, net: dict | None = None) -> str:
         out.append("  fault timeline:")
         for r in summary["fault_timeline"]:
             out.append("      %12.6f  %s" % (r["ts"], r["line"]))
+    if summary.get("verifier_mesh"):
+        out.append("  verifier mesh dispatch (per device):")
+        for dev, d in summary["verifier_mesh"].items():
+            out.append(
+                "    device %-3s %4d window(s)  %6d rows  "
+                "occupancy %.4f  diverted %d" % (
+                    dev, d["windows"], d["rows"],
+                    d["mean_occupancy"], d["diverted"]))
     return "\n".join(out)
 
 

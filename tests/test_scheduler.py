@@ -216,8 +216,15 @@ def test_cluster_sim_no_singleton_batches_and_warm_cache():
 
 
 def test_bucket16_model():
+    # _bucket16 is the shared crypto/bucketing.bucket_round — the ONE
+    # padding model the scheduler and both verifier facades round with
+    from eges_tpu.crypto.bucketing import bucket_round
+
+    assert _bucket16 is bucket_round
     assert [_bucket16(n) for n in (1, 15, 16, 17, 129)] == \
         [16, 16, 16, 32, 256]
+    # per-device targets pad from their own (smaller) floor
+    assert [bucket_round(n, 4) for n in (1, 4, 5, 9)] == [4, 4, 8, 16]
 
 
 @pytest.mark.slow
