@@ -30,6 +30,14 @@ baseline and the 16 k/s reference-class figure (BASELINE.md: the
 libsecp256k1 cgo path is ~12-20 k verifies/s/core), so the ratio is
 conservative even though our schoolbook C++ recover is slower.
 
+Two further independently-gated series ride every round:
+``cold_start_seconds`` (child entry to first verified batch — the
+number the ``crypto/aotstore.py`` artifact store shrinks by
+deserializing stored executables instead of recompiling; gated
+lower-is-better) and ``pipeline_overlap_ratio`` (the scheduler's
+double-buffered lane pipeline measured host-side over
+``PipelinedNativeVerifier`` — overlapped windows / pipelined windows).
+
 ``bench.py mesh`` is a separate stage: it regenerates MESH_SCALING.json
 through ``harness/mesh_scaling.run`` (psum/ring A/B, recorded collective
 winner, and the mesh scheduler saturation pass with per-device
@@ -107,25 +115,33 @@ def _append_history(line: dict) -> None:
 # ---------------------------------------------------------------------------
 
 def _child(deadline: float, max_batch: int) -> None:
+    t_child0 = time.monotonic()
+
     def left() -> float:
         return deadline - time.monotonic()
 
     import jax
 
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(_REPO, ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-    except Exception:
-        pass
+    from eges_tpu.crypto.aotstore import default_store, enable_persistent_cache
+
+    enable_persistent_cache(os.path.join(_REPO, ".jax_cache"))
     import jax.numpy as jnp
     import numpy as np
 
-    from eges_tpu.crypto.verifier import ecrecover_batch
+    from eges_tpu.crypto.verifier import _jax_export, ecrecover_batch
     from eges_tpu.models.flagship import example_batch
 
-    device = str(jax.devices()[0])
+    d0 = jax.devices()[0]
+    device = str(d0)
+    kind = "%s:%s" % (d0.platform,
+                      getattr(d0, "device_kind", "") or d0.platform)
     fn = jax.jit(ecrecover_batch)
+    # the AOT artifact store (crypto/aotstore.py): a bucket whose
+    # serialized executable survives from a previous round deserializes
+    # in seconds instead of recompiling in minutes — the bench measures
+    # that as cold_start_s and labels each stage load/compile
+    store = default_store()
+    exp_mod = _jax_export()
 
     base_s, base_h, valid, expect = example_batch(max_batch, invalid_every=17)
 
@@ -157,6 +173,7 @@ def _child(deadline: float, max_batch: int) -> None:
     # run with max_batch < 1024 must still measure SOMETHING
     order = tuple(dict.fromkeys(min(b, max_batch) for b in order))
     first = True
+    cold_start_s = None
     for batch in order:
         if batch > max_batch:
             continue
@@ -165,9 +182,20 @@ def _child(deadline: float, max_batch: int) -> None:
         if not first and left() < 90:
             break
         sigs, hashes = base_s[:batch], base_h[:batch]
+        # per-bucket executable: an AOT artifact (if one is stored for
+        # this exact bucket/device-kind/code-rev) beats a fresh trace
+        fn_b, aot_src = fn, "jit"
+        if store is not None and exp_mod is not None:
+            payload = store.load("recover", batch, kind)
+            if payload is not None:
+                try:
+                    fn_b = jax.jit(exp_mod.deserialize(payload).call)
+                    aot_src = "load"
+                except Exception:
+                    fn_b, aot_src = fn, "jit"
         t0 = time.monotonic()
         js, jh = jnp.asarray(sigs), jnp.asarray(hashes)
-        out = fn(js, jh)
+        out = fn_b(js, jh)
         jax.block_until_ready(out)
         compile_s = time.monotonic() - t0
 
@@ -184,6 +212,10 @@ def _child(deadline: float, max_batch: int) -> None:
                 else:
                     assert not ok[i], f"row {i}: invalid signature accepted"
             first = False
+            # cold start: child entry (JAX import + init included) to
+            # the first VERIFIED batch on this backend — the number the
+            # AOT store exists to shrink
+            cold_start_s = round(time.monotonic() - t_child0, 1)
 
         # Distinct pre-uploaded inputs per call: the runtime memoizes
         # repeat dispatches of (executable, same buffers), so timing a
@@ -201,7 +233,7 @@ def _child(deadline: float, max_batch: int) -> None:
         while True:
             a, b = sets[n_iters % n_sets]
             t1 = time.monotonic()
-            jax.block_until_ready(fn(a, b))
+            jax.block_until_ready(fn_b(a, b))
             lats.append(time.monotonic() - t1)
             n_iters += 1
             el = time.monotonic() - t0
@@ -214,7 +246,10 @@ def _child(deadline: float, max_batch: int) -> None:
                 break
         dt = time.monotonic() - t0
         res = {"batch": batch, "per_sec": batch * n_iters / dt,
-               "compile_s": round(compile_s, 1)}
+               "compile_s": round(compile_s, 1), "aot": aot_src}
+        if cold_start_s is not None:
+            res["cold_start_s"] = cold_start_s
+            cold_start_s = None  # rides the FIRST stage's line only
         # tail latencies for EVERY bucket (matching the runtime
         # verifier.device_seconds histograms), not just the 1024 point —
         # BENCH_*.json consumers get the full batch->tail curve
@@ -242,12 +277,25 @@ def _child(deadline: float, max_batch: int) -> None:
                 b = jnp.asarray(np.roll(hashes, i + 10, axis=0))
                 jax.block_until_ready((a, b))
                 t1 = time.monotonic()
-                jax.block_until_ready(fn(a, b))
+                jax.block_until_ready(fn_b(a, b))
                 lats.append(time.monotonic() - t1)
             lats.sort()
             res["p50_ms"] = round(percentile(lats, 50) * 1e3, 3)
             res["p99_ms"] = round(percentile(lats, 99) * 1e3, 3)
             emit(res)
+
+        if store is not None and exp_mod is not None and aot_src != "load" \
+                and left() > max(90.0, compile_s):
+            # bank this bucket's executable for the NEXT round: export
+            # re-lowers the graph (roughly another compile), so it only
+            # runs when the budget clearly survives it
+            try:
+                exported = exp_mod.export(jax.jit(ecrecover_batch))(js, jh)
+                store.save("recover", batch, kind, exported.serialize())
+            # analysis: allow-swallow(artifact banking is best-effort; the
+            # measurement already emitted)
+            except Exception:
+                pass
 
         if res["per_sec"] < 500 and "CPU" in device.upper():
             # CPU-class fallback backend: larger batches change nothing
@@ -420,6 +468,57 @@ def _coalesced_stage() -> dict | None:
         return None
 
 
+def _pipeline_stage() -> dict | None:
+    """Double-buffered lane pipeline stage: back-to-back multi-row
+    windows drive the verifier scheduler over a
+    :class:`~eges_tpu.crypto.verify_host.PipelinedNativeVerifier`, so
+    each lane stages window N+1 (the H2D analogue) while window N
+    computes — the stage reports the scheduler's
+    ``pipeline_overlap_ratio`` (overlapped windows / pipelined windows).
+
+    Runs in the PARENT like ``_coalesced_stage``: the split-phase host
+    verifier imports no JAX and the overlap mechanics it measures are
+    backend-independent.  None when the workload can't be signed."""
+    try:
+        from eges_tpu.crypto import native
+        from eges_tpu.crypto import secp256k1 as host
+        from eges_tpu.crypto.scheduler import VerifierScheduler
+        from eges_tpu.crypto.verify_host import PipelinedNativeVerifier
+
+        n_windows, rows = 8, 32
+        entries = []
+        for i in range(n_windows * rows):
+            msg = (i + 1).to_bytes(4, "big") * 8
+            priv = bytes([(i % 200) + 9]) * 32
+            sig = (native.ec_sign(msg, priv) if native.available()
+                   else host.ecdsa_sign(msg, priv))
+            entries.append((msg, sig))
+
+        sched = VerifierScheduler(PipelinedNativeVerifier(),
+                                  window_ms=1.0, max_batch=rows)
+        t0 = time.monotonic()
+        # all windows submitted up-front: the lane queue stays deep
+        # enough that every window after the first has a predecessor
+        # still computing when its staging starts
+        futs = [sched.submit(h, s) for h, s in entries]
+        bad = sum(1 for f in futs if f.result(120) is None)
+        sched.close()
+        dt = time.monotonic() - t0
+
+        st = sched.stats()
+        return {
+            "windows": st.get("pipeline_windows", 0),
+            "overlapped": st.get("pipeline_overlapped", 0),
+            "overlap_ratio": st.get("pipeline_overlap_ratio", 0.0),
+            "rows": st["rows"],
+            "rows_per_s": round(st["rows"] / max(dt, 1e-9), 1),
+            "verify_failures": bad,
+            "elapsed_s": round(dt, 2),
+        }
+    except Exception:
+        return None
+
+
 def _spawn(kind: str, deadline: float, max_batch: int) -> subprocess.Popen:
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -495,9 +594,10 @@ def main() -> None:
 
     measured = _cpu_baseline()
     denom = max(measured or 0.0, REF_CLASS_CPU_PER_S)
-    # backend-independent scheduler stage, measured up front in the
-    # parent so it rides every later line (including the fail line)
+    # backend-independent scheduler stages, measured up front in the
+    # parent so they ride every later line (including the fail line)
     coalesced = _coalesced_stage()
+    pipeline = _pipeline_stage()
 
     best: dict = {}      # kind -> best stage result for that backend
     # kind -> {batch(str): {p50_ms, p99_ms}} — every stage's tails, not
@@ -525,8 +625,14 @@ def main() -> None:
             "elapsed_s": round(time.monotonic() - t_start, 1),
         }
         out.update(_provenance())
+        if "cold_start_s" in res:
+            out["cold_start_seconds"] = res["cold_start_s"]
+        if "aot" in res:
+            out["aot"] = res["aot"]
         if coalesced:
             out["coalesced"] = dict(coalesced)
+        if pipeline:
+            out["pipeline"] = dict(pipeline)
         if probe_state:
             out["tpu_probe"] = dict(probe_state)
         if "tpu" not in best:
@@ -668,6 +774,7 @@ def main() -> None:
             "value": 0.0, "unit": "verifies/s", "vs_baseline": 0.0,
             "error": "no backend produced a result within budget",
             "coalesced": coalesced,
+            "pipeline": pipeline,
             "tpu_probe": dict(probe_state),
             "watcher_tpu_capture": _watcher_capture(),
             "cpu_baseline_measured_per_s":
@@ -681,6 +788,29 @@ def main() -> None:
         final = compose()
         if final:
             _append_history(final)
+            if "cold_start_seconds" in final:
+                # independently gated series (check_regression.py treats
+                # cold_start_seconds as lower-is-better): a broken AOT
+                # store shows up as a cold-start RISE even when
+                # steady-state verifies/s stays healthy
+                line = {"metric": "cold_start_seconds",
+                        "value": final["cold_start_seconds"], "unit": "s",
+                        "device": final.get("device"),
+                        "aot": final.get("aot")}
+                line.update(_provenance())
+                print(json.dumps(line), flush=True)
+                _append_history(line)
+    if pipeline and pipeline.get("windows"):
+        # parent-side stage: emitted whether or not a backend answered —
+        # the overlap mechanics are host-measurable every round
+        line = {"metric": "pipeline_overlap_ratio",
+                "value": pipeline["overlap_ratio"], "unit": "ratio",
+                "windows": pipeline["windows"],
+                "overlapped": pipeline["overlapped"],
+                "rows": pipeline["rows"]}
+        line.update(_provenance())
+        print(json.dumps(line), flush=True)
+        _append_history(line)
 
 
 if __name__ == "__main__":

@@ -46,6 +46,19 @@ lane* per device instead of calling the verifier inline:
 With one visible device the lane machinery collapses to the PR 4/5
 behavior: the admission thread dispatches inline, no extra threads.
 
+**Double-buffered window pipeline.** A target exposing the split-phase
+``stage_recover`` / ``commit_recover`` / ``collect_recover`` trio
+(:class:`~eges_tpu.crypto.verifier.BatchVerifier` and its mesh lane
+facades) gets its windows run on a lane worker even single-lane: the
+worker begins window k+1 — numpy fill, H2D upload into the verifier's
+double buffers, async device dispatch — BEFORE blocking on window k's
+collect, so consecutive windows overlap H2D/compute/D2H instead of
+serializing.  ``verifier.pipeline_overlap_ratio`` (and per-lane
+``pipeline_windows``/``pipeline_overlapped`` stats) report how often
+the overlap actually happened.  Native verifiers don't expose the trio,
+so sims and the chaos harness keep the inline path and its
+byte-deterministic event ordering.
+
 This module must stay importable WITHOUT JAX (same contract as
 ``verify_host.py``): the bench parent and host-fallback node processes
 construct schedulers around native verifiers.
@@ -109,11 +122,27 @@ class _DeviceLane:
             "host_diverted": 0, "straggler_diverts": 0,
             "device_errors": 0, "breaker_trips": 0,
             "breaker_probes": 0, "breaker_diverted": 0,
+            "pipeline_windows": 0, "pipeline_overlapped": 0,
         }
 
     def load(self) -> int:
         """Placement score: rows waiting plus rows in flight."""
         return self.queued_rows + self.inflight_rows
+
+
+class _PendingWindow:
+    """One window's begin-to-finish state in the split-phase pipeline.
+
+    ``_begin_batch`` fills it (and, on a pipeline-capable target, leaves
+    the staged+dispatched device computation in ``staged``);
+    ``_finish_batch`` collects, records and resolves it.  A lane worker
+    holds at most ONE of these in flight — beginning window k+1 before
+    finishing window k is exactly the H2D/compute/D2H overlap.
+    """
+
+    __slots__ = ("batch", "keys", "reason", "t0", "rows", "results",
+                 "staged", "probing", "diverted", "computed", "failure",
+                 "finished")
 
 
 class VerifierScheduler:
@@ -160,6 +189,15 @@ class VerifierScheduler:
         if not targets:
             targets = [verifier]
         self._lanes = [_DeviceLane(i, t) for i, t in enumerate(targets)]
+        # double-buffered pipeline capability: targets exposing the
+        # split-phase stage/commit/collect trio get their windows run
+        # on a lane worker even single-lane, so window k+1's H2D
+        # staging overlaps window k's compute + D2H.  Native verifiers
+        # don't expose it — sims keep the inline path and its
+        # byte-deterministic event ordering.
+        self._pipelined = any(
+            callable(getattr(lane.target, "stage_recover", None))
+            for lane in self._lanes)
         # placement: a window larger than this splits across lanes
         # (floor min_split keeps chunks worth a device dispatch)
         self.min_split = max(1, min_split)
@@ -184,7 +222,8 @@ class VerifierScheduler:
             "flush_kick": 0, "flush_close": 0, "invalid": 0,
             "device_errors": 0, "breaker_trips": 0, "breaker_probes": 0,
             "breaker_diverted": 0, "window_splits": 0,
-            "straggler_diverts": 0,
+            "straggler_diverts": 0, "pipeline_windows": 0,
+            "pipeline_overlapped": 0,
         }
         # optional consensus event journal (utils/journal.py), attached
         # by the first owning node; flush decisions land in its stream
@@ -380,6 +419,10 @@ class VerifierScheduler:
                 lane.breaker == "open" for lane in self._lanes)
                 else "closed")
             out["lanes"] = len(self._lanes)
+            out["pipeline_overlap_ratio"] = (
+                round(out["pipeline_overlapped"]
+                      / out["pipeline_windows"], 4)
+                if out["pipeline_windows"] else 0.0)
             devices = []
             for lane in self._lanes:
                 d = {"device": lane.index,
@@ -391,6 +434,10 @@ class VerifierScheduler:
                 d["occupancy"] = (
                     round(lane.stats["rows"] / lane.stats["bucket_rows"], 4)
                     if lane.stats["bucket_rows"] else None)
+                d["pipeline_overlap_ratio"] = (
+                    round(lane.stats["pipeline_overlapped"]
+                          / lane.stats["pipeline_windows"], 4)
+                    if lane.stats["pipeline_windows"] else 0.0)
                 devices.append(d)
             out["devices"] = devices
         return out
@@ -500,7 +547,11 @@ class VerifierScheduler:
                 batch = [(k, self._pending.pop(k)) for k in keys]
                 if not self._pending:
                     self._kick = False
-            if len(self._lanes) > 1 and len(batch) > 1:
+            if (len(self._lanes) > 1 or self._pipelined) and len(batch) > 1:
+                # mesh windows go to the per-device lanes; single-lane
+                # pipeline-capable targets ALSO route through the lane
+                # worker, whose begin/finish split overlaps consecutive
+                # windows (inline dispatch can't — it must block)
                 self._place(batch, reason)
                 continue
             try:
@@ -558,41 +609,106 @@ class VerifierScheduler:
     def _lane_loop(self, lane: _DeviceLane) -> None:
         """One device lane's worker: drain the lane queue FIFO; on an
         unexpected loop death fail THIS lane's queued futures — other
-        lanes keep serving (straggler isolation)."""
+        lanes keep serving (straggler isolation).
+
+        On a pipeline-capable target the worker is double-buffered: it
+        holds ONE collected-later window in ``pending`` and, when the
+        queue has a successor, begins (fills + uploads + dispatches)
+        that successor BEFORE blocking on ``pending``'s collect — so
+        window k+1's H2D stages while window k computes and drains.
+        Windows still finish strictly FIFO, so cache inserts and
+        journal events keep their queue order.
+        """
         from eges_tpu.utils.metrics import DEFAULT as metrics
+        pipelined = callable(getattr(lane.target, "stage_recover", None))
+        pending: _PendingWindow | None = None
+        nxt_p: _PendingWindow | None = None
         try:
             while True:
                 with self._lock:
-                    while not lane.queue and not (
+                    while not lane.queue and pending is None and not (
                             self._closed and self._admission_done):
                         self._lock.wait()
-                    if not lane.queue:
+                    if not lane.queue and pending is None:
                         return  # closed, admission drained, queue empty
-                    batch, reason = lane.queue.popleft()
-                    lane.queued_rows -= len(batch)
-                    lane.inflight_rows += len(batch)
-                    metrics.gauge(
-                        f"verifier.mesh_queue_depth;device={lane.index}") \
-                        .set(len(lane.queue))
-                try:
-                    self._run_batch(lane, batch, reason)
-                # analysis: allow-swallow(futures already resolved/failed in _run_batch finally; the lane survives to its next window)
-                except Exception:
-                    pass
-                finally:
-                    with self._lock:
-                        lane.inflight_rows -= len(batch)
+                    nxt = None
+                    reason = ""
+                    if lane.queue:
+                        nxt, reason = lane.queue.popleft()
+                        lane.queued_rows -= len(nxt)
+                        lane.inflight_rows += len(nxt)
+                        metrics.gauge(
+                            f"verifier.mesh_queue_depth;device={lane.index}") \
+                            .set(len(lane.queue))
+                nxt_p: _PendingWindow | None = None
+                if nxt is not None:
+                    if pipelined:
+                        nxt_p = self._begin_batch(lane, nxt, reason)
+                        if (pending is not None and nxt_p.staged is not None
+                                and nxt_p.failure is None):
+                            # this begin's H2D ran while the previous
+                            # window was still on the device — the
+                            # overlap the ratio metric reports
+                            with self._lock:
+                                self._stats["pipeline_overlapped"] += 1
+                                lane.stats["pipeline_overlapped"] += 1
+                    else:
+                        try:
+                            self._run_batch(lane, nxt, reason)
+                        # analysis: allow-swallow(futures already resolved/failed in _run_batch finally; the lane survives to its next window)
+                        except Exception:
+                            pass
+                        finally:
+                            with self._lock:
+                                lane.inflight_rows -= len(nxt)
+                if pending is not None:
+                    self._finish_lane_window(lane, pending)
+                    pending = None
+                if nxt_p is not None:
+                    if (nxt_p.staged is not None and not nxt_p.computed
+                            and nxt_p.failure is None):
+                        pending = nxt_p
+                    else:
+                        # host-diverted / singleton / failed windows
+                        # have nothing on the device — finish them now
+                        self._finish_lane_window(lane, nxt_p)
         except BaseException as exc:
             with self._lock:
                 leftovers = list(lane.queue)
                 lane.queue.clear()
                 lane.queued_rows = 0
+            unfinished = []
+            if pending is not None and not pending.finished:
+                unfinished.append(pending)
+            if (nxt_p is not None and nxt_p is not pending
+                    and not nxt_p.finished):
+                unfinished.append(nxt_p)
+            for p in unfinished:
+                with self._lock:
+                    lane.inflight_rows -= p.rows
+                for _k, (futs, _t) in p.batch:
+                    for f in futs:
+                        if not f.done():
+                            f.set_exception(exc)
             for b, _r in leftovers:
                 for _k, (futs, _t) in b:
                     for f in futs:
                         if not f.done():
                             f.set_exception(exc)
             raise
+
+    def _finish_lane_window(self, lane: _DeviceLane,
+                            p: _PendingWindow) -> None:
+        """Collect + record + resolve one lane window, releasing its
+        in-flight rows whatever happens."""
+        try:
+            self._finish_batch(lane, p)
+        # analysis: allow-swallow(futures already resolved/failed in _finish_batch finally; the lane survives to its next window)
+        except Exception:
+            pass
+        finally:
+            with self._lock:
+                lane.inflight_rows -= p.rows
 
     # -- breaker (per lane) -----------------------------------------------
 
@@ -648,130 +764,211 @@ class VerifierScheduler:
     def _run_batch(self, lane: _DeviceLane, batch, reason: str) -> None:
         """Dispatch one coalesced window (or mesh chunk) on ``lane``,
         OUTSIDE the scheduler lock (the device call is the long pole;
-        submitters keep queueing into the next window meanwhile)."""
-        from eges_tpu.utils import tracing
-        from eges_tpu.utils.metrics import DEFAULT as metrics
+        submitters keep queueing into the next window meanwhile).  The
+        inline composition of the split-phase halves: begin (fill +
+        dispatch) then finish (collect + record + resolve) with no
+        overlap — the pre-pipeline behavior."""
+        self._finish_batch(lane, self._begin_batch(lane, batch, reason))
 
-        t0 = time.monotonic()
-        rows = len(batch)
-        keys = [k for k, _ in batch]
-        results = [None] * rows
-        computed = False
-        diverted = False
-        failure: BaseException | None = None
-        mesh = len(self._lanes) > 1
+    def _begin_batch(self, lane: _DeviceLane, batch,
+                     reason: str) -> _PendingWindow:
+        """Phase 1 of one window: singleton/breaker divert decisions,
+        numpy fill, and the device dispatch.  On a pipeline-capable
+        target the dispatch is split-phase (stage H2D + async commit,
+        left in ``staged`` for ``_finish_batch`` to collect); otherwise
+        the device call runs to completion here.  NEVER raises — any
+        error lands in ``failure`` so the caller always gets a window
+        to finish (and the futures always resolve there)."""
+        p = _PendingWindow()
+        p.batch = batch
+        p.keys = [k for k, _ in batch]
+        p.reason = reason
+        p.rows = len(batch)
+        p.results = [None] * p.rows
+        p.staged = None
+        p.probing = False
+        p.diverted = False
+        p.computed = False
+        p.failure = None
+        p.finished = False
+        p.t0 = time.monotonic()
         try:
-            if rows == 1:
+            if p.rows == 1:
                 # singleton divert: a padded 1-row device dispatch costs
                 # more than one native recover — keep the device for
                 # real batches and verifier.singleton_batches at zero
-                results[0] = self._host_recover(keys[0])
+                p.results[0] = self._host_recover(p.keys[0])
                 with self._lock:
                     self._stats["host_diverted"] += 1
                     lane.stats["host_diverted"] += 1
-            else:
-                use_device, probing = self._breaker_admits(lane)
-                if not use_device:
-                    # breaker open: this lane's device is presumed dead
-                    # — the whole window takes the host recover path so
-                    # consensus keeps committing (other lanes are
-                    # unaffected: the breaker is lane-scoped)
-                    results = [self._host_recover(k) for k in keys]
-                    diverted = True
+                p.computed = True
+                return p
+            use_device, p.probing = self._breaker_admits(lane)
+            if not use_device:
+                # breaker open: this lane's device is presumed dead
+                # — the whole window takes the host recover path so
+                # consensus keeps committing (other lanes are
+                # unaffected: the breaker is lane-scoped)
+                p.results = [self._host_recover(k) for k in p.keys]
+                p.diverted = True
+                with self._lock:
+                    self._stats["breaker_diverted"] += p.rows
+                    lane.stats["breaker_diverted"] += p.rows
+                p.computed = True
+                return p
+            sigs = np.zeros((p.rows, 65), np.uint8)
+            hashes = np.zeros((p.rows, 32), np.uint8)
+            for i, (h, sig) in enumerate(p.keys):
+                sigs[i] = np.frombuffer(sig, np.uint8)
+                hashes[i] = np.frombuffer(h, np.uint8)
+            stage = getattr(lane.target, "stage_recover", None)
+            try:
+                hook = self.failure_hook
+                if hook is not None:
+                    hook(p.rows)
+                if callable(stage):
+                    # split-phase: fill + H2D + async device dispatch
+                    # now; the blocking collect happens in
+                    # _finish_batch — possibly after the NEXT window's
+                    # stage (that concurrency is the pipeline)
+                    p.staged = lane.target.commit_recover(
+                        stage(sigs, hashes))
                     with self._lock:
-                        self._stats["breaker_diverted"] += rows
-                        lane.stats["breaker_diverted"] += rows
+                        self._stats["pipeline_windows"] += 1
+                        lane.stats["pipeline_windows"] += 1
                 else:
-                    sigs = np.zeros((rows, 65), np.uint8)
-                    hashes = np.zeros((rows, 32), np.uint8)
-                    for i, (h, sig) in enumerate(keys):
-                        sigs[i] = np.frombuffer(sig, np.uint8)
-                        hashes[i] = np.frombuffer(h, np.uint8)
-                    try:
-                        hook = self.failure_hook
-                        if hook is not None:
-                            hook(rows)
-                        addrs, ok = lane.target.recover_addresses(
-                            sigs, hashes)
-                        results = [bytes(addrs[i]) if ok[i] else None
-                                   for i in range(rows)]
-                        if probing:
-                            self._breaker_close(lane)
-                    # analysis: allow-swallow(a device exception diverts
-                    # exactly this window to the host model — the queued
-                    # futures still resolve correctly — and trips this
-                    # lane's circuit breaker for the windows after it)
-                    except Exception:
-                        self._breaker_trip(lane, probing)
-                        results = [self._host_recover(k) for k in keys]
-                        diverted = True
-            computed = True
-            dt = time.monotonic() - t0
-            pad = getattr(lane.target, "_pad", None) \
-                or getattr(self._verifier, "_pad", None) or bucket_round
-            bucket = pad(rows) if rows > 1 else 1  # diverted rows pad nothing
-            waited = t0 - min(t for _, (_, t) in batch)
-            with self._lock:
-                for k, r in zip(keys, results):
-                    self._cache_put(k, r)
-                self._stats["batches"] += 1
-                self._stats["rows"] += rows
-                self._stats["bucket_rows"] += bucket
-                lane.stats["batches"] += 1
-                lane.stats["rows"] += rows
-                lane.stats["bucket_rows"] += bucket
-                if diverted and mesh:
-                    self._stats["straggler_diverts"] += 1
-                    lane.stats["straggler_diverts"] += 1
-            for _, (_, t_sub) in batch:
-                metrics.histogram("verifier.sched_queue_wait_seconds") \
-                    .observe(t0 - t_sub)
-            metrics.histogram("verifier.sched_batch_rows").observe(rows)
-            metrics.histogram("verifier.sched_occupancy") \
-                .observe(rows / bucket)
-            if mesh:
-                metrics.counter(
-                    f"verifier.mesh_rows;device={lane.index}").inc(rows)
-                metrics.histogram(
-                    f"verifier.mesh_occupancy;device={lane.index}") \
-                    .observe(rows / bucket)
-                if diverted:
-                    metrics.counter(
-                        f"verifier.mesh_straggler_diverts"
-                        f";device={lane.index}").inc()
-            tracing.DEFAULT.record_span(
-                "verifier.sched_dispatch", dt, rows=rows, bucket=bucket,
-                reason=reason, occupancy=round(rows / bucket, 4),
-                device=lane.index, waited_ms=round(waited * 1e3, 3))
-            journal = self.journal
-            if journal is not None:
-                journal.record("verifier_flush", rows=rows, reason=reason,
-                               occupancy=round(rows / bucket, 4),
-                               waited_ms=round(waited * 1e3, 3))
-                if mesh:
-                    journal.record("verifier_mesh_dispatch",
-                                   device=lane.index, rows=rows,
-                                   occupancy=round(rows / bucket, 4),
-                                   diverted=diverted,
-                                   queue_wait_ms=round(waited * 1e3, 3))
+                    addrs, ok = lane.target.recover_addresses(
+                        sigs, hashes)
+                    p.results = [bytes(addrs[i]) if ok[i] else None
+                                 for i in range(p.rows)]
+                    if p.probing:
+                        self._breaker_close(lane)
+                    p.computed = True
+            # analysis: allow-swallow(a device exception diverts
+            # exactly this window to the host model — the queued
+            # futures still resolve correctly — and trips this
+            # lane's circuit breaker for the windows after it)
+            except Exception:
+                self._breaker_trip(lane, p.probing)
+                p.results = [self._host_recover(k) for k in p.keys]
+                p.diverted = True
+                p.computed = True
         except BaseException as exc:
-            failure = exc
-            raise
+            p.failure = exc
+        return p
+
+    def _finish_batch(self, lane: _DeviceLane, p: _PendingWindow) -> None:
+        """Phase 2 of one window: collect the staged device result (if
+        split-phase), insert into the cache, record stats/metrics/
+        journal, and — always, in the ``finally`` — resolve the
+        window's futures.  Re-raises the window's failure after
+        resolution, matching the old ``_run_batch`` contract."""
+        batch, keys, rows = p.batch, p.keys, p.rows
+        mesh = len(self._lanes) > 1
+        try:
+            if p.failure is None and p.staged is not None and not p.computed:
+                try:
+                    addrs, ok = lane.target.collect_recover(p.staged)
+                    p.results = [bytes(addrs[i]) if ok[i] else None
+                                 for i in range(rows)]
+                    if p.probing:
+                        self._breaker_close(lane)
+                # analysis: allow-swallow(a device exception surfacing
+                # at collect diverts exactly this window to the host
+                # model and trips the lane breaker, like a synchronous
+                # dispatch failure would)
+                except Exception:
+                    self._breaker_trip(lane, p.probing)
+                    p.results = [self._host_recover(k) for k in keys]
+                    p.diverted = True
+                p.computed = True
+            if p.failure is None and p.computed:
+                self._record_window(lane, p, mesh)
+        except BaseException as exc:
+            if p.failure is None:
+                p.failure = exc
         finally:
             # futures resolve even if the instrumentation path raises —
             # a blocked recover_signers caller is a wedged consensus
             # node.  If the batch died before results were computed,
             # its futures FAIL with that error rather than masquerading
             # as None ("invalid signature").
-            for (_, (futs, _)), r in zip(batch, results):
+            p.finished = True
+            for (_, (futs, _)), r in zip(batch, p.results):
                 for f in futs:
                     if f.done():
                         continue
-                    if computed:
+                    if p.computed:
                         f.set_result(r)
                     else:
-                        f.set_exception(failure or RuntimeError(
+                        f.set_exception(p.failure or RuntimeError(
                             "verifier batch dispatch failed"))
+        if p.failure is not None:
+            raise p.failure
+
+    def _record_window(self, lane: _DeviceLane, p: _PendingWindow,
+                       mesh: bool) -> None:
+        """Cache inserts + stats + metrics + tracing + journal for one
+        computed window — the bookkeeping tail shared by the inline and
+        pipelined paths (errors here propagate to ``_finish_batch``,
+        which still resolves the futures in its ``finally``)."""
+        from eges_tpu.utils import tracing
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+
+        batch, keys, rows = p.batch, p.keys, p.rows
+        dt = time.monotonic() - p.t0
+        pad = getattr(lane.target, "_pad", None) \
+            or getattr(self._verifier, "_pad", None) or bucket_round
+        bucket = pad(rows) if rows > 1 else 1  # diverted rows pad nothing
+        waited = p.t0 - min(t for _, (_, t) in batch)
+        with self._lock:
+            for k, r in zip(keys, p.results):
+                self._cache_put(k, r)
+            self._stats["batches"] += 1
+            self._stats["rows"] += rows
+            self._stats["bucket_rows"] += bucket
+            lane.stats["batches"] += 1
+            lane.stats["rows"] += rows
+            lane.stats["bucket_rows"] += bucket
+            if p.diverted and mesh:
+                self._stats["straggler_diverts"] += 1
+                lane.stats["straggler_diverts"] += 1
+            windows = self._stats["pipeline_windows"]
+            overlapped = self._stats["pipeline_overlapped"]
+        for _, (_, t_sub) in batch:
+            metrics.histogram("verifier.sched_queue_wait_seconds") \
+                .observe(p.t0 - t_sub)
+        metrics.histogram("verifier.sched_batch_rows").observe(rows)
+        metrics.histogram("verifier.sched_occupancy") \
+            .observe(rows / bucket)
+        if windows:
+            metrics.gauge("verifier.pipeline_overlap_ratio") \
+                .set(round(overlapped / windows, 4))
+        if mesh:
+            metrics.counter(
+                f"verifier.mesh_rows;device={lane.index}").inc(rows)
+            metrics.histogram(
+                f"verifier.mesh_occupancy;device={lane.index}") \
+                .observe(rows / bucket)
+            if p.diverted:
+                metrics.counter(
+                    f"verifier.mesh_straggler_diverts"
+                    f";device={lane.index}").inc()
+        tracing.DEFAULT.record_span(
+            "verifier.sched_dispatch", dt, rows=rows, bucket=bucket,
+            reason=p.reason, occupancy=round(rows / bucket, 4),
+            device=lane.index, waited_ms=round(waited * 1e3, 3))
+        journal = self.journal
+        if journal is not None:
+            journal.record("verifier_flush", rows=rows, reason=p.reason,
+                           occupancy=round(rows / bucket, 4),
+                           waited_ms=round(waited * 1e3, 3))
+            if mesh:
+                journal.record("verifier_mesh_dispatch",
+                               device=lane.index, rows=rows,
+                               occupancy=round(rows / bucket, 4),
+                               diverted=p.diverted,
+                               queue_wait_ms=round(waited * 1e3, 3))
 
 
 def scheduler_for(verifier, **kwargs) -> VerifierScheduler | None:

@@ -109,6 +109,32 @@ def verify_batch(sigs: jnp.ndarray, hashes: jnp.ndarray, pubs: jnp.ndarray):
     return ec.ecdsa_verify_point(z, r, s, qx, qy)
 
 
+def _jax_export():
+    """The ``jax.export`` module (moved out of experimental over jax
+    releases), or ``None`` when this jax has neither spelling — every
+    AOT consumer then falls through to plain jit."""
+    try:
+        from jax import export as exp
+        return exp
+    except ImportError:
+        try:
+            from jax.experimental import export as exp
+            return exp
+        except ImportError:
+            return None
+
+
+class _StagedBatch:
+    """One window mid-flight through the split-phase dispatch pipeline:
+    ``stage_*`` filled + uploaded it (H2D), ``commit_*`` dispatched the
+    device computation (async), ``collect_*`` will block, download
+    (D2H) and record it.  Holding two of these per lane is what lets
+    the next window's upload overlap the current window's compute."""
+
+    __slots__ = ("op", "n", "b", "fn", "arrays", "out", "t0", "t1",
+                 "cached")
+
+
 def make_sharded_ecrecover(mesh: jax.sharding.Mesh, axis: str = "dp"):
     """Build the multi-chip ecrecover: rows sharded over ``mesh[axis]``
     (pure data parallel over ICI-connected chips), with the on-device
@@ -151,8 +177,9 @@ class BatchVerifier:
         else:
             self._sharded = None
             self._ndev = 1
-        self._recover = jax.jit(ecrecover_batch)
-        self._verify = jax.jit(verify_batch)
+        fns = self._graph_fns()
+        self._recover = jax.jit(fns["recover"])
+        self._verify = jax.jit(fns["verify"])
         # buckets whose recover graph this facade has already driven —
         # proxy for jit compile-cache hit/miss per request (the jit cache
         # itself is keyed on shapes, which map 1:1 to buckets here);
@@ -173,6 +200,21 @@ class BatchVerifier:
         # interleave writes into one buffer mid-upload.
         self._stage_bufs: dict[int, dict[str, np.ndarray]] = {}
         self._staging_lock = threading.Lock()
+        # AOT executable registry: (op, bucket) -> callable built from a
+        # serialized artifact (or a fresh export).  Shared across every
+        # mesh lane — the staging lock guards registration and the
+        # in-flight set dedupes concurrent warmers, so each bucket
+        # loads/compiles once per device-kind, not once per lane.
+        self._aot_execs: dict[tuple, object] = {}
+        self._aot_inflight: set = set()
+        self._aot_stats = {"aot_loads": 0, "aot_compiles": 0,
+                           "load_s": 0.0, "compile_s": 0.0}
+        # double-buffered pipeline staging: two host buffer pairs per
+        # bucket, toggled per stage_* call — at most two windows are
+        # ever in flight per lane (current compute + next staged), so
+        # a simple XOR toggle never reuses a buffer mid-upload
+        self._pipe_bufs: dict[int, list] = {}
+        self._pipe_toggle: dict[int, int] = {}
         # injectable device-failure hook (fault injection): called with
         # the row count at the head of every device entry point; raising
         # here models the accelerator dying mid-flush — the scheduler's
@@ -258,6 +300,165 @@ class BatchVerifier:
             self._compiled_buckets.add(b)
             metrics.counter("verifier.prewarmed_buckets").inc()
 
+    def _graph_fns(self) -> dict:
+        """The pure ``(sigs, hashes[, pubs])`` graphs this facade jits
+        and AOT-exports.  Subclasses (tests) override this with cheap
+        toy graphs so the IDENTICAL artifact machinery — export,
+        serialize, integrity check, load, registry — exercises in
+        milliseconds instead of the real graphs' minutes.  Called from
+        ``__init__``, so overrides must not depend on instance state."""
+        return {"recover": ecrecover_batch, "verify": verify_batch}
+
+    @property
+    def device_kind(self) -> str:
+        """The artifact-store device key: platform plus hardware kind
+        (e.g. ``tpu:TPU v5 lite`` / ``cpu:cpu``) — artifacts never
+        migrate across chip generations."""
+        d = jax.devices()[0]
+        return f"{d.platform}:{getattr(d, 'device_kind', '') or d.platform}"
+
+    def _zero_args(self, op: str, b: int) -> tuple:
+        zs = jnp.zeros((b, 65), jnp.uint8)
+        zh = jnp.zeros((b, 32), jnp.uint8)
+        if op == "verify":
+            return zs, zh, jnp.zeros((b, 64), jnp.uint8)
+        return zs, zh
+
+    def aot_prewarm(self, buckets=(16, 32, 64), store=None,
+                    background: bool = False, ops=("recover",)):
+        """Warm the per-bucket executables from the AOT artifact store
+        — the restart path's replacement for :meth:`prewarm`.  Each
+        bucket loads a serialized executable when a valid artifact
+        exists (milliseconds of deserialize instead of minutes of
+        trace+lower), else compiles once and saves the artifact for the
+        next process.  Synchronous calls return an info dict with the
+        load-vs-compile split (``aot_loads``/``aot_compiles``/
+        ``load_s``/``compile_s``) for the ``verifier_aot_load`` journal
+        event; background mode returns the warmer thread."""
+        if store is None:
+            from eges_tpu.crypto.aotstore import default_store
+            store = default_store()
+        buckets = tuple(dict.fromkeys(
+            bucket_round(max(b, 1), self._min_bucket) for b in buckets))
+        if background:
+            t = threading.Thread(target=self._aot_prewarm,
+                                 args=(buckets, store, ops),
+                                 name="verifier-aot-prewarm", daemon=True)
+            t.start()
+            return t
+        return self._aot_prewarm(buckets, store, ops)
+
+    def _aot_prewarm(self, buckets, store, ops) -> dict:
+        info = {"buckets": list(buckets), "device_kind": self.device_kind,
+                "aot_loads": 0, "aot_compiles": 0,
+                "load_s": 0.0, "compile_s": 0.0}
+        for op in ops:
+            for b in buckets:
+                mode, dt = self._aot_warm_one(op, b, store)
+                if mode == "load":
+                    info["aot_loads"] += 1
+                    info["load_s"] += dt
+                elif mode == "compile":
+                    info["aot_compiles"] += 1
+                    info["compile_s"] += dt
+        return info
+
+    def _aot_warm_one(self, op: str, b: int, store):
+        """Load-else-compile ONE (op, bucket) executable and register
+        it.  Returns ``("load"|"compile", seconds)`` or ``(None, 0.0)``
+        when another lane already holds/warms the key — the shared
+        registry plus in-flight set is what dedupes prewarm across mesh
+        lanes."""
+        import time
+
+        from eges_tpu.utils.log import get_logger
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+
+        key = (op, b)
+        with self._staging_lock:
+            if key in self._aot_execs or key in self._aot_inflight:
+                return None, 0.0
+            self._aot_inflight.add(key)
+        try:
+            graph = self._graph_fns()[op]
+            zeros = self._zero_args(op, b)
+            exp_mod = _jax_export()
+            kind = self.device_kind
+            fn = None
+            mode = "compile"
+            t0 = time.monotonic()
+            if store is not None and exp_mod is not None:
+                payload = store.load(op, b, kind)
+                if payload is not None:
+                    try:
+                        fn = jax.jit(exp_mod.deserialize(payload).call)
+                        jax.block_until_ready(fn(*zeros))
+                        mode = "load"
+                    # analysis: allow-swallow(an artifact that passed
+                    # the integrity check but fails to deserialize or
+                    # run still degrades to a fresh compile — BENCH_r02)
+                    except Exception as e:
+                        metrics.counter("verifier.aot_load_errors").inc()
+                        get_logger("geec.aot").warn(
+                            "aot deserialize failed; recompiling",
+                            op=op, bucket=b, err=str(e))
+                        fn = None
+            if fn is None:
+                exported = None
+                if exp_mod is not None:
+                    try:
+                        exported = exp_mod.export(jax.jit(graph))(*zeros)
+                        fn = jax.jit(exported.call)
+                    # analysis: allow-swallow(graphs jax.export cannot
+                    # lower — e.g. exotic custom calls — still warm via
+                    # plain jit; they just never get an artifact)
+                    except Exception as e:
+                        get_logger("geec.aot").warn(
+                            "aot export unavailable; plain jit warm",
+                            op=op, bucket=b, err=str(e))
+                        exported = None
+                        fn = None
+                if fn is None:
+                    fn = jax.jit(graph)
+                jax.block_until_ready(fn(*zeros))
+                if store is not None and exported is not None:
+                    try:
+                        store.save(op, b, kind, exported.serialize())
+                    # analysis: allow-swallow(an unwritable artifact dir
+                    # only costs the NEXT process its warm start; this
+                    # one already has the executable)
+                    except Exception as e:
+                        get_logger("geec.aot").warn(
+                            "aot artifact save failed",
+                            op=op, bucket=b, err=str(e))
+            dt = time.monotonic() - t0
+            with self._staging_lock:
+                self._aot_execs[key] = fn
+                (self._compiled_buckets if op == "recover"
+                 else self._verify_buckets).add(b)
+                if mode == "load":
+                    self._aot_stats["aot_loads"] += 1
+                    self._aot_stats["load_s"] += dt
+                else:
+                    self._aot_stats["aot_compiles"] += 1
+                    self._aot_stats["compile_s"] += dt
+            if mode == "load":
+                metrics.counter("verifier.aot_loads").inc()
+                metrics.histogram("verifier.aot_load_seconds").observe(dt)
+            else:
+                metrics.counter("verifier.aot_compiles").inc()
+                metrics.histogram("verifier.aot_export_seconds").observe(dt)
+            return mode, dt
+        finally:
+            with self._staging_lock:
+                self._aot_inflight.discard(key)
+
+    def aot_stats(self) -> dict:
+        """Load-vs-compile accounting since construction (the restart
+        test's "zero recompiles for prewarmed buckets" witness)."""
+        with self._staging_lock:
+            return dict(self._aot_stats)
+
     def _pad(self, n: int) -> int:
         b = bucket_round(max(n, 1), self._min_bucket)
         # round up to a device multiple so shards stay even (works for any
@@ -311,6 +512,12 @@ class BatchVerifier:
         b = self._pad(n)
         cached = b in self._compiled_buckets
         self._compiled_buckets.add(b)
+        # prewarmed AOT executable, if one was loaded/exported for this
+        # bucket (the sharded full-mesh path keeps its collective graphs
+        # — only single-device dispatch rides artifacts); resolved
+        # before the lock, the registry is only mutated under it
+        fn = (self._aot_execs.get(("recover", b))
+              if self._sharded is None else None)
         with self._staging_lock:
             st = self._staging(b)
             ps, ph = st["sigs"], st["hashes"]
@@ -323,7 +530,9 @@ class BatchVerifier:
             if self.debug_timing:
                 jax.block_until_ready((ds, dh))
             t1 = time.monotonic()
-            if self._sharded is not None:
+            if fn is not None:
+                addrs, pubs, ok = fn(ds, dh)
+            elif self._sharded is not None:
                 addrs, pubs, ok, _ = self._sharded(ds, dh)
             else:
                 addrs, pubs, ok = self._recover(ds, dh)
@@ -352,6 +561,8 @@ class BatchVerifier:
         b = self._pad(n)
         cached = b in self._verify_buckets
         self._verify_buckets.add(b)
+        fn = (self._aot_execs.get(("verify", b))
+              if self._sharded is None else None)
         with self._staging_lock:
             st = self._staging(b, with_pubs=True)
             ps, ph, pq = st["sigs"], st["hashes"], st["pubs"]
@@ -367,12 +578,84 @@ class BatchVerifier:
             if self.debug_timing:
                 jax.block_until_ready((ds, dh, dq))
             t1 = time.monotonic()
-            ok = self._verify(ds, dh, dq)
+            ok = fn(ds, dh, dq) if fn is not None else self._verify(ds, dh, dq)
             jax.block_until_ready(ok)
             t2 = time.monotonic()
             out = np.asarray(ok)[:n].astype(bool)
             t3 = time.monotonic()
         self._record_batch("verify", n, b, cached, t0, t1, t2, t3)
+        return out
+
+    def _pipeline_pair(self, b: int) -> tuple:
+        # caller holds self._staging_lock; toggle between the two host
+        # buffer pairs so staging window k+1 never scribbles over the
+        # buffers window k is still uploading from
+        pairs = self._pipe_bufs.get(b)
+        if pairs is None:
+            pairs = [(np.zeros((b, 65), np.uint8),
+                      np.zeros((b, 32), np.uint8)) for _ in range(2)]
+            self._pipe_bufs[b] = pairs
+        i = self._pipe_toggle.get(b, 0)
+        self._pipe_toggle[b] = i ^ 1
+        return pairs[i]
+
+    def stage_recover(self, sigs: np.ndarray,
+                      hashes: np.ndarray) -> _StagedBatch:
+        """Phase 1 of the pipelined dispatch: pad, fill a double buffer
+        and start the H2D upload.  Returns the staged window for
+        :meth:`commit_recover`/:meth:`collect_recover` — the scheduler's
+        lane worker stages window k+1 while window k computes."""
+        import time
+
+        n = sigs.shape[0]
+        self._maybe_fail(n)
+        b = self._pad(n)
+        st = _StagedBatch()
+        st.op, st.n, st.b = "ecrecover", n, b
+        st.fn = (self._aot_execs.get(("recover", b))
+                 if self._sharded is None else None)
+        st.cached = b in self._compiled_buckets
+        self._compiled_buckets.add(b)
+        with self._staging_lock:
+            ps, ph = self._pipeline_pair(b)
+            ps[:n] = sigs
+            ps[n:] = 0
+            ph[:n] = hashes
+            ph[n:] = 0
+            st.t0 = time.monotonic()
+            st.arrays = (jnp.asarray(ps), jnp.asarray(ph))
+        return st
+
+    def commit_recover(self, st: _StagedBatch) -> _StagedBatch:
+        """Phase 2: dispatch the device computation (async — jax
+        returns futures-like arrays; the device runtime queues this
+        behind whatever is already running)."""
+        import time
+
+        ds, dh = st.arrays
+        if st.fn is not None:
+            addrs, _pubs, ok = st.fn(ds, dh)
+        elif self._sharded is not None:
+            addrs, _pubs, ok, _ = self._sharded(ds, dh)
+        else:
+            addrs, _pubs, ok = self._recover(ds, dh)
+        st.out = (addrs, ok)
+        st.t1 = time.monotonic()
+        return st
+
+    def collect_recover(self, st: _StagedBatch):
+        """Phase 3: block on the computation, drain D2H, unpad, record
+        the batch metrics.  Returns ``(addrs [n,20], ok [n] bool)``."""
+        import time
+
+        addrs, ok = st.out
+        jax.block_until_ready(ok)
+        t2 = time.monotonic()
+        out = (np.asarray(addrs)[:st.n],
+               np.asarray(ok)[:st.n].astype(bool))
+        t3 = time.monotonic()
+        self._record_batch(st.op, st.n, st.b, st.cached, st.t0, st.t1,
+                           t2, t3)
         return out
 
 
@@ -397,9 +680,21 @@ class _DeviceTarget:
         self.failure_hook = None
         self._stage: dict[int, tuple] = {}
         self._lock = threading.Lock()
+        # per-lane double buffers for the split-phase pipeline (the
+        # AOT exec registry itself lives on the parent — shared across
+        # lanes so each bucket warms once per device-kind)
+        self._pipe: dict[int, list] = {}
+        self._pipe_toggle: dict[int, int] = {}
 
     def _pad(self, n: int) -> int:
         return bucket_round(max(n, 1), self._parent._min_bucket)
+
+    def _exec_for(self, b: int):
+        """The shared prewarmed executable for this bucket, else the
+        parent's plain jitted graph (dict read is lock-free; the
+        registry only grows)."""
+        return (self._parent._aot_execs.get(("recover", b))
+                or self._parent._recover)
 
     def recover_addresses(self, sigs: np.ndarray, hashes: np.ndarray):
         import time
@@ -413,6 +708,7 @@ class _DeviceTarget:
         parent = self._parent
         b = self._pad(n)
         cached = b in parent._compiled_buckets
+        fn = self._exec_for(b)
         with self._lock:
             st = self._stage.get(b)
             if st is None:
@@ -430,7 +726,7 @@ class _DeviceTarget:
             if parent.debug_timing:
                 jax.block_until_ready((ds, dh))
             t1 = time.monotonic()
-            addrs, _pubs, ok = parent._recover(ds, dh)
+            addrs, _pubs, ok = fn(ds, dh)
             jax.block_until_ready(ok)
             t2 = time.monotonic()
             out = (np.asarray(addrs)[:n],
@@ -438,6 +734,65 @@ class _DeviceTarget:
             t3 = time.monotonic()
         parent._compiled_buckets.add(b)
         parent._record_batch("ecrecover", n, b, cached, t0, t1, t2, t3)
+        return out
+
+    def stage_recover(self, sigs: np.ndarray,
+                      hashes: np.ndarray) -> _StagedBatch:
+        """Split-phase stage for this lane: fill a per-lane double
+        buffer and pin the upload to THIS device — so the scheduler's
+        lane worker overlaps the next window's H2D with the current
+        window's compute on the same chip."""
+        import time
+
+        n = sigs.shape[0]
+        hook = self.failure_hook
+        if hook is not None:
+            hook(n)
+        parent = self._parent
+        b = self._pad(n)
+        st = _StagedBatch()
+        st.op, st.n, st.b = "ecrecover", n, b
+        st.fn = self._exec_for(b)
+        st.cached = b in parent._compiled_buckets
+        parent._compiled_buckets.add(b)
+        with self._lock:
+            pairs = self._pipe.get(b)
+            if pairs is None:
+                pairs = [(np.zeros((b, 65), np.uint8),
+                          np.zeros((b, 32), np.uint8)) for _ in range(2)]
+                self._pipe[b] = pairs
+            i = self._pipe_toggle.get(b, 0)
+            self._pipe_toggle[b] = i ^ 1
+            ps, ph = pairs[i]
+            ps[:n] = sigs
+            ps[n:] = 0
+            ph[:n] = hashes
+            ph[n:] = 0
+            st.t0 = time.monotonic()
+            st.arrays = (jax.device_put(ps, self.device),
+                         jax.device_put(ph, self.device))
+        return st
+
+    def commit_recover(self, st: _StagedBatch) -> _StagedBatch:
+        import time
+
+        ds, dh = st.arrays
+        addrs, _pubs, ok = st.fn(ds, dh)
+        st.out = (addrs, ok)
+        st.t1 = time.monotonic()
+        return st
+
+    def collect_recover(self, st: _StagedBatch):
+        import time
+
+        addrs, ok = st.out
+        jax.block_until_ready(ok)
+        t2 = time.monotonic()
+        out = (np.asarray(addrs)[:st.n],
+               np.asarray(ok)[:st.n].astype(bool))
+        t3 = time.monotonic()
+        self._parent._record_batch(st.op, st.n, st.b, st.cached, st.t0,
+                                   st.t1, t2, t3)
         return out
 
 

@@ -103,6 +103,62 @@ class NativeBatchVerifier:
         return ok & (addrs == want).all(axis=1)
 
 
+class _StagedHost:
+    """One window in flight through :class:`PipelinedNativeVerifier`:
+    the staged input copies (the H2D analogue) plus the worker future
+    the commit phase submitted."""
+
+    __slots__ = ("sigs", "hashes", "future")
+
+
+class PipelinedNativeVerifier(NativeBatchVerifier):
+    """A host verifier exposing the split-phase ``stage_recover`` /
+    ``commit_recover`` / ``collect_recover`` trio, so the scheduler's
+    double-buffered lane pipeline is testable (and benchable) without
+    JAX: stage copies the arrays (the H2D analogue), commit hands the
+    recover to a single background worker (the device analogue — one
+    computation in flight, FIFO), collect blocks on its future.
+    Results are bit-identical to :class:`NativeBatchVerifier`; only
+    the overlap differs.  NOT the sim default — the chaos harness's
+    byte-determinism rides the inline path."""
+
+    def __init__(self):
+        super().__init__()
+        self._pool = None
+
+    def _ensure_pool(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="native-pipeline")
+        return self._pool
+
+    def stage_recover(self, sigs, hashes) -> _StagedHost:
+        # the failure hook fires inside the worker's recover_addresses
+        # (exactly once per window), surfacing at collect_recover — the
+        # same place a real device error would
+        st = _StagedHost()
+        st.sigs = np.array(sigs, np.uint8, copy=True)
+        st.hashes = np.array(hashes, np.uint8, copy=True)
+        st.future = None
+        return st
+
+    def commit_recover(self, st: _StagedHost) -> _StagedHost:
+        st.future = self._ensure_pool().submit(
+            NativeBatchVerifier.recover_addresses, self,
+            st.sigs, st.hashes)
+        return st
+
+    def collect_recover(self, st: _StagedHost):
+        return st.future.result()
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+
 class NativeMeshVerifier(NativeBatchVerifier):
     """An N-lane *virtual mesh* of host verifiers — the JAX-free
     analogue of :class:`~eges_tpu.crypto.verifier.MeshBatchVerifier`.

@@ -104,19 +104,11 @@ class NodeService:
         verifier = None
         if mode == "jax":
             # share compiled verifier graphs across node processes and
-            # restarts (the recover graph is the expensive compile)
-            import jax
-            try:
-                jax.config.update(
-                    "jax_compilation_cache_dir",
-                    os.path.join(os.path.dirname(os.path.dirname(
-                        os.path.dirname(os.path.abspath(__file__)))),
-                        ".jax_cache"))
-                jax.config.update(
-                    "jax_persistent_cache_min_compile_time_secs", 2.0)
-            # analysis: allow-swallow(older jax lacks these cache knobs)
-            except Exception:
-                pass
+            # restarts (the recover graph is the expensive compile);
+            # hardened per BENCH_r02: a broken cache logs + counts
+            # verifier.compile_cache_errors and the node runs uncached
+            from eges_tpu.crypto.aotstore import enable_persistent_cache
+            enable_persistent_cache()
             from eges_tpu.crypto.verifier import default_verifier
             verifier = default_verifier()
         elif mode == "native":
@@ -290,17 +282,37 @@ class NodeService:
             # warm the smallest recover graph NOW: the first jit compile
             # can take minutes on a small host, and letting it happen
             # lazily inside a consensus message handler wedges the event
-            # loop mid-election (diagnosed via the SIGUSR1 dump); the
-            # persistent cache makes later runs instant.  The next few
-            # buckets compile on a background thread — off the critical
-            # path, so the first non-trivial block doesn't stall either.
+            # loop mid-election (diagnosed via the SIGUSR1 dump).  The
+            # warm goes through the AOT artifact store: a node restarted
+            # on a machine that compiled before deserializes the stored
+            # executable in milliseconds instead of recompiling (and a
+            # first-ever compile leaves an artifact behind for the next
+            # process).  The next few buckets warm on a background
+            # thread — off the critical path, so the first non-trivial
+            # block doesn't stall either.
             import time as _t
 
+            from eges_tpu.crypto.aotstore import default_store
+            from eges_tpu.utils.metrics import DEFAULT as metrics
+
+            store = default_store()
             t0 = _t.monotonic()
-            self._raw_verifier.prewarm(buckets=(16,), background=False)
-            self.log.geec("verifier warmup",
-                          dt=round(_t.monotonic() - t0, 1))
-            self._raw_verifier.prewarm(buckets=(32, 64, 128))
+            info = self._raw_verifier.aot_prewarm(buckets=(16,),
+                                                  store=store)
+            cold = round(_t.monotonic() - t0, 3)
+            metrics.gauge("verifier.cold_start_seconds").set(cold)
+            self.log.geec("verifier warmup", dt=cold,
+                          aot_loads=info["aot_loads"],
+                          aot_compiles=info["aot_compiles"])
+            self.node.journal.record(
+                "verifier_aot_load", buckets=info["buckets"],
+                aot_loads=info["aot_loads"],
+                aot_compiles=info["aot_compiles"],
+                load_s=round(info["load_s"], 3),
+                compile_s=round(info["compile_s"], 3),
+                cold_start_s=cold, device_kind=info["device_kind"])
+            self._raw_verifier.aot_prewarm(buckets=(32, 64, 128),
+                                           store=store, background=True)
         await self.direct.start()
         await self.gossip.start()
         if self.discovery is not None:

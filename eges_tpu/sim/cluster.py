@@ -176,6 +176,29 @@ class SimCluster:
                                        node.on_gossip, node.on_direct)
         sn.node = node
         sn.crashed = False
+        # AOT prewarm before serving: a jax-backed verifier reloads its
+        # serialized (op, bucket) executables from the artifact store —
+        # seconds of deserialize instead of minutes of recompile — and
+        # the rejoin cost lands in the journal for the observatory and
+        # the chaos rejoin bound.  Native verifiers have no aot_prewarm;
+        # the no-op keeps chaos runs byte-deterministic.
+        backing = self.verifier
+        if backing is not None:
+            backing = getattr(backing, "_verifier", backing)
+        warm = getattr(backing, "aot_prewarm", None)
+        if callable(warm):
+            import time as _time
+            t0 = _time.monotonic()
+            info = warm(buckets=(16,))
+            cold = round(_time.monotonic() - t0, 3)
+            node.journal.record(
+                "verifier_aot_load", buckets=info["buckets"],
+                aot_loads=info["aot_loads"],
+                aot_compiles=info["aot_compiles"],
+                load_s=round(info["load_s"], 3),
+                compile_s=round(info["compile_s"], 3),
+                cold_start_s=cold, device_kind=info["device_kind"],
+                restart=True)
         node.start()
 
     def live_nodes(self) -> list[SimNode]:

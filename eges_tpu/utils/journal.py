@@ -71,6 +71,10 @@ EVENT_TYPES = frozenset({
     # verifier circuit breaker (crypto/scheduler.py): device declared
     # dead / half-open re-probe / recovered
     "fault_breaker",
+    # AOT prewarm (node/service.py + sim/cluster.py restart): one
+    # prewarm pass over the artifact store with load-vs-compile split
+    # timing so the observatory can report cold-start time
+    "verifier_aot_load",
 })
 
 # The registered ``_breakdown`` phase vocabulary (consensus/node.py);

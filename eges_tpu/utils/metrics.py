@@ -63,6 +63,17 @@ METRIC_FAMILIES = frozenset({
     "verifier.mesh_devices", "verifier.mesh_occupancy",
     "verifier.mesh_queue_depth", "verifier.mesh_rows",
     "verifier.mesh_straggler_diverts", "verifier.mesh_window_splits",
+    # crypto/aotstore.py + crypto/verifier.py — AOT-serialized
+    # executables: artifact save/load/export accounting, persistent
+    # compile-cache hardening, and service cold-start time
+    "verifier.aot_compiles", "verifier.aot_export_seconds",
+    "verifier.aot_load_errors", "verifier.aot_load_seconds",
+    "verifier.aot_loads", "verifier.aot_saves",
+    "verifier.cold_start_seconds", "verifier.compile_cache_errors",
+    # crypto/scheduler.py — double-buffered window pipeline: fraction
+    # of lane windows whose H2D staging overlapped the previous
+    # window's compute/D2H
+    "verifier.pipeline_overlap_ratio",
 })
 
 

@@ -57,6 +57,9 @@ VOLATILE_KEYS = {
     # modulo device index")
     "verifier_mesh_dispatch": ("queue_wait_ms", "device", "occupancy",
                                "rows", "diverted"),
+    # real load/compile durations of the AOT artifact prewarm — how
+    # long the warm took is wall-clock, WHAT was warmed is protocol
+    "verifier_aot_load": ("load_s", "compile_s", "cold_start_s"),
 }
 
 
@@ -187,10 +190,39 @@ def _scn_rolling_restarts(seed: int, fast: bool) -> dict:
     cluster.run(last + 2.0 - cluster.clock.now())
     cluster.run(60.0, stop_condition=lambda: not any(
         sn.crashed for sn in cluster.nodes))
-    return _finish("rolling_restarts", seed, cluster,
-                   extra_blocks=3 if fast else 4, bound_s=240.0,
-                   checks={"all_restarted": not any(
-                       sn.crashed for sn in cluster.nodes)})
+    res = _finish("rolling_restarts", seed, cluster,
+                  extra_blocks=3 if fast else 4, bound_s=240.0,
+                  checks={"all_restarted": not any(
+                      sn.crashed for sn in cluster.nodes)})
+    # rejoin-to-first-verified-window per restarted node: virtual time
+    # from the fault_restart to that node's next committed block, which
+    # must be bounded by the AOT artifact load (the cold_start_s its
+    # rebuilt verifier journaled), not by a recompile stall.  The 120 s
+    # slack is the consensus catch-up allowance (block cadence +
+    # elections), identical with or without an artifact store.
+    journals = res["journals"]
+    restarts = [(ev.get("target"), ev["ts"])
+                for ev in journals.get("faults", [])
+                if ev.get("type") == "fault_restart"]
+    rejoin = {}
+    bounded = True
+    for target, t_restart in restarts:
+        evs = journals.get(target, [])
+        commit = next((ev["ts"] for ev in evs
+                       if ev.get("type") == "block_committed"
+                       and ev["ts"] >= t_restart), None)
+        load_s = sum(ev.get("cold_start_s", 0.0) for ev in evs
+                     if ev.get("type") == "verifier_aot_load"
+                     and ev["ts"] >= t_restart)
+        dt = None if commit is None else round(commit - t_restart, 6)
+        rejoin[target] = {"rejoin_s": dt,
+                          "aot_load_s": round(load_s, 3)}
+        if dt is None or dt > 120.0 + load_s:
+            bounded = False
+    res["rejoin"] = rejoin
+    res["checks"]["rejoin_bounded_by_artifact_load"] = bounded
+    res["ok"] = bool(res["ok"] and bounded)
+    return res
 
 
 def _scn_loss_jitter(seed: int, fast: bool) -> dict:

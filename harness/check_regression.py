@@ -9,6 +9,10 @@ perf regressions that unit tests can't see.  The verifier bench's
 ``secp256k1_ecrecover_verifies_per_sec_per_chip`` and the mesh stage's
 aggregate ``mesh_sharded_rows_per_s`` gate independently: a mesh
 dispatch regression cannot hide behind a healthy single-chip number.
+Metrics in ``LOWER_IS_BETTER`` (``cold_start_seconds`` — the AOT
+artifact store's deliverable) gate in the opposite direction: a RISE
+past the threshold fails, so a broken artifact store cannot hide
+behind a healthy steady-state throughput number.
 
 Exit codes: 0 ok (or fewer than two comparable entries per metric),
 1 regression, 2 unreadable history.
@@ -27,6 +31,10 @@ import sys
 
 _DEFAULT_HISTORY = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "bench_history.jsonl")
+
+# metrics where smaller is the win (durations): the gate fails on a
+# RISE past the threshold instead of a drop
+LOWER_IS_BETTER = frozenset({"cold_start_seconds"})
 
 
 def load_history(path: str) -> list[dict]:
@@ -72,6 +80,19 @@ def check(entries: list[dict], threshold: float = 0.20) -> tuple[int, str]:
         if pv <= 0:
             lines.append("ok [%s]: previous value %.1f is not a usable "
                          "baseline" % (name, pv))
+            continue
+        if name in LOWER_IS_BETTER:
+            rise = (lv - pv) / pv
+            detail = "%.3f -> %.3f %s (%+.1f%%, lower is better)" % (
+                pv, lv, last.get("unit", ""), rise * 100.0)
+            if rise > threshold:
+                code = 1
+                lines.append("REGRESSION [%s]: %s exceeds the %.0f%% "
+                             "threshold" % (name, detail,
+                                            threshold * 100.0))
+            else:
+                lines.append("ok [%s]: %s within the %.0f%% threshold"
+                             % (name, detail, threshold * 100.0))
             continue
         drop = (pv - lv) / pv
         detail = "%.1f -> %.1f %s (%+.1f%%)" % (

@@ -49,7 +49,8 @@ CONSUMED = ("election_started", "election_won", "election_lost",
             "block_confirmed",
             "fault_crash", "fault_restart", "fault_partition",
             "fault_heal", "fault_link", "fault_net", "fault_skew",
-            "fault_trigger", "fault_breaker", "verifier_mesh_dispatch")
+            "fault_trigger", "fault_breaker", "verifier_mesh_dispatch",
+            "verifier_aot_load")
 
 _TIMELINE = ("election_started", "election_won", "election_lost",
              "version_bump")
@@ -108,11 +109,26 @@ def summarize(by_node: dict[str, list[dict]],
     # per-device window lanes); occupancy is deterministic (rows vs
     # bucket), queue wait is wall-clock and deliberately excluded
     mesh: dict[int, dict] = {}
+    # node -> AOT prewarm accounting (service start + sim restarts):
+    # how much of each node's cold start was artifact load vs compile
+    aot: dict[str, dict] = {}
 
     for name in sorted(by_node):
         for ev in by_node[name]:
             typ = ev.get("type")
             blk = ev.get("blk")
+            if typ == "verifier_aot_load":
+                d = aot.setdefault(name, {
+                    "events": 0, "aot_loads": 0, "aot_compiles": 0,
+                    "load_s": 0.0, "compile_s": 0.0,
+                    "cold_start_s": 0.0})
+                d["events"] += 1
+                d["aot_loads"] += int(ev.get("aot_loads", 0))
+                d["aot_compiles"] += int(ev.get("aot_compiles", 0))
+                d["load_s"] += float(ev.get("load_s", 0.0))
+                d["compile_s"] += float(ev.get("compile_s", 0.0))
+                d["cold_start_s"] += float(ev.get("cold_start_s", 0.0))
+                continue
             if typ == "verifier_mesh_dispatch":
                 d = mesh.setdefault(int(ev.get("device", -1)), {
                     "windows": 0, "rows": 0, "diverted": 0, "_occ": 0.0})
@@ -207,6 +223,13 @@ def summarize(by_node: dict[str, list[dict]],
                   "diverted": d["diverted"],
                   "mean_occupancy": round(d["_occ"] / d["windows"], 4)}
             for dev, d in sorted(mesh.items())},
+        "verifier_aot": {
+            name: {"events": d["events"], "aot_loads": d["aot_loads"],
+                   "aot_compiles": d["aot_compiles"],
+                   "load_s": round(d["load_s"], 3),
+                   "compile_s": round(d["compile_s"], 3),
+                   "cold_start_s": round(d["cold_start_s"], 3)}
+            for name, d in sorted(aot.items())},
     }
 
 
@@ -298,6 +321,15 @@ def render(summary: dict, net: dict | None = None) -> str:
                 "occupancy %.4f  diverted %d" % (
                     dev, d["windows"], d["rows"],
                     d["mean_occupancy"], d["diverted"]))
+    if summary.get("verifier_aot"):
+        out.append("  verifier AOT prewarm (per node):")
+        for name, d in summary["verifier_aot"].items():
+            out.append(
+                "    %-8s %d prewarm(s)  loads %d (%.3f s)  "
+                "compiles %d (%.3f s)  cold start %.3f s" % (
+                    name, d["events"], d["aot_loads"], d["load_s"],
+                    d["aot_compiles"], d["compile_s"],
+                    d["cold_start_s"]))
     return "\n".join(out)
 
 
