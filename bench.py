@@ -292,8 +292,7 @@ def _child(deadline: float, max_batch: int) -> None:
             try:
                 exported = exp_mod.export(jax.jit(ecrecover_batch))(js, jh)
                 store.save("recover", batch, kind, exported.serialize())
-            # analysis: allow-swallow(artifact banking is best-effort; the
-            # measurement already emitted)
+            # analysis: allow-swallow(artifact banking is best-effort; the measurement already emitted)
             except Exception:
                 pass
 
@@ -363,6 +362,7 @@ def _watcher_capture() -> dict | None:
     try:
         with open(os.path.join(_REPO, "BENCH_tpu_capture.json")) as f:
             cap = json.load(f)
+    # analysis: allow-swallow(capture context is optional; None omits it)
     except Exception:
         return None
     keep = ("value", "unit", "vs_baseline", "batch", "device",
@@ -390,6 +390,7 @@ def _cpu_baseline() -> float | None:
         for h, s in zip(hashes, sigs):
             native.ec_recover(h, s)
         return n / (time.perf_counter() - t0)
+    # analysis: allow-swallow(optional probe; a failed leg reports null)
     except Exception:
         return None
 
@@ -440,7 +441,8 @@ def _coalesced_stage() -> dict | None:
                     if f.result(60) is None:
                         failures.append(k)
 
-        threads = [threading.Thread(target=submitter, args=(k,))
+        threads = [threading.Thread(target=submitter, args=(k,),
+                                    daemon=True)
                    for k in range(n_threads)]
         for t in threads:
             t.start()
@@ -464,6 +466,7 @@ def _coalesced_stage() -> dict | None:
             "verify_failures": len(failures),
             "elapsed_s": round(dt, 2),
         }
+    # analysis: allow-swallow(optional bench stage; a failed leg reports null)
     except Exception:
         return None
 
@@ -515,6 +518,7 @@ def _pipeline_stage() -> dict | None:
             "verify_failures": bad,
             "elapsed_s": round(dt, 2),
         }
+    # analysis: allow-swallow(optional bench stage; a failed leg reports null)
     except Exception:
         return None
 
@@ -811,6 +815,21 @@ def main() -> None:
         line.update(_provenance())
         print(json.dumps(line), flush=True)
         _append_history(line)
+
+    # trend the static-analysis counts alongside the perf series: one
+    # findings_by_rule/unsuppressed_by_rule line per bench round, the
+    # history harness/check_regression.py --analysis gates on
+    analysis_history = os.environ.get(
+        "ANALYSIS_HISTORY", os.path.join(_REPO, "harness",
+                                         "analysis_history.jsonl"))
+    try:
+        subprocess.run(
+            [sys.executable, "-m", "harness.analysis",
+             "--summary", analysis_history],
+            cwd=_REPO, capture_output=True, timeout=120)
+    # analysis: allow-swallow(trend bookkeeping must not fail the bench)
+    except Exception:
+        pass
 
 
 if __name__ == "__main__":

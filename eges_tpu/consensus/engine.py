@@ -149,11 +149,18 @@ class PowEngine(Engine):
     MIN_DIFFICULTY = 1
 
     def __init__(self, sweep_batch: int = 4096, use_device: bool = True,
-                 max_sweeps: int = 1 << 16):
+                 max_sweeps: int = 1 << 16, clock=None):
         self.sweep_batch = sweep_batch
         self.use_device = use_device
         self.max_sweeps = max_sweeps  # gives up (re-prepare with new time)
         self._jit_sweep = None
+        # injectable wall-clock for the future-drift bound: sims hand in
+        # their virtual clock so a chaos run's accept/reject decisions
+        # replay byte-identically regardless of host time
+        if clock is None:
+            import time as _time
+            clock = _time.time
+        self.clock = clock
 
     # -- difficulty ----------------------------------------------------
 
@@ -197,11 +204,9 @@ class PowEngine(Engine):
     #                              floor and seals for free)
 
     def verify_header(self, chain, header: Header) -> None:
-        import time as _time
-
         if header.number == 0:
             return
-        if header.time > _time.time() + self.FUTURE_DRIFT_S:
+        if header.time > self.clock() + self.FUTURE_DRIFT_S:
             raise EngineError("pow timestamp too far in the future")
         parent = chain.get_block_by_number(header.number - 1)
         if parent is not None:  # behind-sync callers may lack the parent

@@ -682,6 +682,7 @@ class BlockChain:
 
         from eges_tpu.utils.metrics import DEFAULT as metrics
 
+        # analysis: allow-determinism(insert dt is metrics/volatile-only)
         t0 = time.monotonic()
         self._verify_header(block.header)
         self._verify_body(block)
@@ -697,6 +698,7 @@ class BlockChain:
         self.bloom_index.add(block.number, block.header.bloom)
         from eges_tpu.utils import tracing
 
+        # analysis: allow-determinism(insert dt is metrics/volatile-only)
         dt = time.monotonic() - t0
         metrics.timer("chain.insert").update(dt)
         metrics.histogram("chain.insert_seconds").observe(dt)

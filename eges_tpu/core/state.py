@@ -357,7 +357,9 @@ class StateDB:
         incremental — only accounts dirtied since the last call rehash."""
         if self._root_cache is None:
             t = self._trie
-            for addr in self._dirty:
+            # sorted: the rehash order must not depend on set hash order
+            # (byte-identical trie node churn under the chaos contract)
+            for addr in sorted(self._dirty):
                 a = self.account(addr)
                 if a == Account():
                     t = t.delete(addr)

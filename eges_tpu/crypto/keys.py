@@ -34,6 +34,7 @@ def generate_keypair(seed: bytes | None = None) -> KeyPair:
     """Generate a keypair; with ``seed`` the key is deterministic (used by the
     test harness to give each simulated node a stable identity)."""
     while True:
+        # analysis: allow-determinism(entropy only on the seedless path; sims always seed)
         raw = keccak256(seed) if seed is not None else os.urandom(32)
         d = int.from_bytes(raw, "big")
         if 1 <= d < N:

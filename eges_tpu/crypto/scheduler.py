@@ -273,6 +273,7 @@ class VerifierScheduler:
                     row[0].append(fut)
                     self._stats["coalesced_rows"] += 1
                 else:
+                    # analysis: allow-determinism(coalescing deadline is real-time by contract; chaos pins batching via max_batch kicks)
                     self._pending[key] = [[fut], time.monotonic()]
                     self._ensure_thread()
                 if len(self._pending) >= self.max_batch:
@@ -530,6 +531,7 @@ class VerifierScheduler:
                         and not self._kick and not self._closed
                         and self._pending):
                     oldest = next(iter(self._pending.values()))[1]
+                    # analysis: allow-determinism(window-expiry wait is the real-time contract; chaos batch membership is pinned by max_batch kicks)
                     left = self._window_s - (time.monotonic() - oldest)
                     if left <= 0:
                         break
@@ -586,6 +588,11 @@ class VerifierScheduler:
             n_chunks = min(n_chunks, max(1, rows // self.min_split))
         size = -(-rows // n_chunks)
         chunks = [batch[i:i + size] for i in range(0, rows, size)]
+        # queue depths are captured under the lock and emitted after it:
+        # the metrics registry takes its own lock, and nesting it inside
+        # the scheduler condition would order-couple the two on every
+        # window placement (fail-under-lock)
+        depth_updates: list[tuple[int, int]] = []
         with self._lock:
             order = sorted(
                 self._lanes,
@@ -594,17 +601,19 @@ class VerifierScheduler:
             self._rr = (self._rr + 1) % len(self._lanes)
             if len(chunks) > 1:
                 self._stats["window_splits"] += 1
-                metrics.counter("verifier.mesh_window_splits").inc()
             for chunk, lane in zip(chunks, order):
                 lane.queue.append((chunk, reason))
                 lane.queued_rows += len(chunk)
                 lane.max_queue_depth = max(lane.max_queue_depth,
                                            len(lane.queue))
-                metrics.gauge(
-                    f"verifier.mesh_queue_depth;device={lane.index}") \
-                    .set(len(lane.queue))
+                depth_updates.append((lane.index, len(lane.queue)))
                 self._ensure_lane_thread(lane)
             self._lock.notify_all()
+        if len(chunks) > 1:
+            metrics.counter("verifier.mesh_window_splits").inc()
+        for index, depth in depth_updates:
+            metrics.gauge(
+                f"verifier.mesh_queue_depth;device={index}").set(depth)
 
     def _lane_loop(self, lane: _DeviceLane) -> None:
         """One device lane's worker: drain the lane queue FIFO; on an
@@ -633,13 +642,18 @@ class VerifierScheduler:
                         return  # closed, admission drained, queue empty
                     nxt = None
                     reason = ""
+                    depth = None
                     if lane.queue:
                         nxt, reason = lane.queue.popleft()
                         lane.queued_rows -= len(nxt)
                         lane.inflight_rows += len(nxt)
-                        metrics.gauge(
-                            f"verifier.mesh_queue_depth;device={lane.index}") \
-                            .set(len(lane.queue))
+                        depth = len(lane.queue)
+                if depth is not None:
+                    # emitted after release: the gauge takes the metrics
+                    # registry lock (fail-under-lock)
+                    metrics.gauge(
+                        f"verifier.mesh_queue_depth;device={lane.index}") \
+                        .set(depth)
                 nxt_p: _PendingWindow | None = None
                 if nxt is not None:
                     if pipelined:
@@ -791,6 +805,7 @@ class VerifierScheduler:
         p.computed = False
         p.failure = None
         p.finished = False
+        # analysis: allow-determinism(batch latency instrumentation; dt/waited_ms are volatile-stripped)
         p.t0 = time.monotonic()
         try:
             if p.rows == 1:
@@ -916,6 +931,7 @@ class VerifierScheduler:
         from eges_tpu.utils.metrics import DEFAULT as metrics
 
         batch, keys, rows = p.batch, p.keys, p.rows
+        # analysis: allow-determinism(batch latency instrumentation; dt/waited_ms are volatile-stripped)
         dt = time.monotonic() - p.t0
         pad = getattr(lane.target, "_pad", None) \
             or getattr(self._verifier, "_pad", None) or bucket_round

@@ -35,10 +35,12 @@ _UNSET = object()
 
 
 def _new_trace_id() -> str:
+    # analysis: allow-determinism(trace ids are observability-only, never journaled)
     return os.urandom(16).hex()
 
 
 def _new_span_id() -> str:
+    # analysis: allow-determinism(span ids are observability-only, never journaled)
     return os.urandom(8).hex()
 
 

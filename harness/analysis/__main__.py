@@ -11,16 +11,33 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from harness.analysis import core
 
 
+def _changed_files(root: str, base: str) -> set[str] | None:
+    """Repo-relative paths changed since ``base`` (committed AND
+    worktree), or None when git can't resolve the rev."""
+    try:
+        proc = subprocess.run(
+            ["git", "diff", "--name-only", base],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    return {line.strip().replace(os.sep, "/")
+            for line in proc.stdout.splitlines() if line.strip()}
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m harness.analysis",
-        description="AST static analysis: lock-discipline, jit-purity, "
-                    "vocabulary, robustness-hygiene.")
+        description="AST static analysis: lock-discipline, lock-order/"
+                    "fail-under-lock, future-lifecycle, determinism, "
+                    "jit-purity, vocabulary, robustness-hygiene.")
     ap.add_argument("paths", nargs="*", default=list(core.DEFAULT_PATHS),
                     help="directories/files to scan (default: eges_tpu "
                          "harness)")
@@ -32,6 +49,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="emit findings as JSON instead of text")
     ap.add_argument("--summary", metavar="FILE", default=None,
                     help="append a findings_by_rule JSON summary line")
+    ap.add_argument("--diff", metavar="BASE", default=None,
+                    help="gate only findings in files changed since this "
+                         "git rev (the whole tree is still analyzed — "
+                         "cross-file rules need it — but untouched files "
+                         "can't fail the run)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore the checked-in baseline")
     ap.add_argument("--update-baseline", action="store_true",
@@ -49,6 +71,16 @@ def main(argv: list[str] | None = None) -> int:
     except core.BaselineError as e:
         print(f"baseline error: {e}", file=sys.stderr)
         return 2
+
+    if args.diff is not None:
+        changed = _changed_files(root, args.diff)
+        if changed is None:
+            print(f"cannot resolve --diff base {args.diff!r}",
+                  file=sys.stderr)
+            return 2
+        report.findings = [f for f in report.findings if f.path in changed]
+        # scoping is a reporting filter only: stale-baseline entries are
+        # still judged against the full-tree findings above
 
     if args.update_baseline:
         core.save_baseline(core.DEFAULT_BASELINE, report.unsuppressed)

@@ -27,9 +27,12 @@ import os
 import re
 import time
 
-# rule ids, grouped by the four checkers that own them
+# rule ids, grouped by the seven checkers that own them
 RULES = (
     "lock-discipline",                                   # lock_discipline
+    "lock-order", "fail-under-lock",                     # lock_order
+    "future-lifecycle",                                  # future_lifecycle
+    "determinism",                                       # determinism
     "jit-purity",                                        # jit_purity
     "vocabulary",                                        # vocabulary
     "swallow", "thread-join", "socket-timeout",          # robustness
@@ -214,7 +217,7 @@ def save_baseline(path: str, findings: list[Finding]) -> None:
 
 # -- runner -------------------------------------------------------------
 
-DEFAULT_PATHS = ("eges_tpu", "harness")
+DEFAULT_PATHS = ("eges_tpu", "harness", "bench.py")
 DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "baseline.json")
 
@@ -239,6 +242,12 @@ class Report:
             out[f.rule] = out.get(f.rule, 0) + 1
         return out
 
+    def unsuppressed_by_rule(self) -> dict[str, int]:
+        out = {r: 0 for r in RULES}
+        for f in self.unsuppressed:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
     def summary_json(self) -> dict:
         return {
             "files": self.files,
@@ -249,6 +258,7 @@ class Report:
             "baselined": sum(1 for f in self.findings if f.baselined),
             "stale_baseline": len(self.stale_baseline),
             "findings_by_rule": self.findings_by_rule(),
+            "unsuppressed_by_rule": self.unsuppressed_by_rule(),
         }
 
 
@@ -256,13 +266,15 @@ def run(root: str, paths: tuple[str, ...] = DEFAULT_PATHS,
         rules: tuple[str, ...] | None = None,
         baseline_path: str | None = DEFAULT_BASELINE) -> Report:
     from harness.analysis import (
-        jit_purity, lock_discipline, robustness, vocabulary,
+        determinism, future_lifecycle, jit_purity, lock_discipline,
+        lock_order, robustness, vocabulary,
     )
 
     t0 = time.monotonic()
     project = Project(root, paths)
     findings: list[Finding] = []
-    for checker in (lock_discipline, jit_purity, vocabulary, robustness):
+    for checker in (lock_discipline, lock_order, future_lifecycle,
+                    determinism, jit_purity, vocabulary, robustness):
         findings.extend(checker.check(project))
     if rules is not None:
         findings = [f for f in findings if f.rule in rules]
@@ -280,6 +292,16 @@ def run(root: str, paths: tuple[str, ...] = DEFAULT_PATHS,
     stale: list[dict] = []
     if baseline_path:
         entries = load_baseline(baseline_path)
+        for e in entries:
+            # a baseline row for a deleted file is a config error, not a
+            # clean pass: the suppression it carried may now be hiding a
+            # reintroduction elsewhere, and silently ignoring it rots
+            # the baseline — delete the entry (exit 2 until then)
+            if not os.path.exists(os.path.join(root, e["path"])):
+                raise BaselineError(
+                    f"baseline entry {e['symbol']!r} points at "
+                    f"{e['path']!r}, which no longer exists — remove "
+                    f"the entry")
         budget: dict[tuple, int] = {}
         for e in entries:
             key = (e["rule"], e["path"], e["symbol"], e["message"])

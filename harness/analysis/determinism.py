@@ -1,0 +1,266 @@
+"""determinism: wall-clock, ambient randomness, and hash-order
+iteration in chaos-reachable code.
+
+The chaos harness (PR 5) stakes a byte-determinism guarantee on the
+simulation closure: same seed, same journal, byte for byte.  That
+guarantee dies the moment anything reachable from :class:`SimCluster`
+reads the wall clock, the process RNG, or iterates a set in hash
+order.  This checker:
+
+1. seeds the reachable set with every file under a ``sim/`` directory,
+   every ``chaos.py``, and every file that defines a class named
+   ``SimCluster``;
+2. expands it over the static import graph (module-level AND lazy
+   in-function imports, absolute and relative) restricted to files in
+   the scanned project — a lazy ``from eges_tpu.crypto.scheduler
+   import ...`` inside a method still pulls the module in;
+3. inside the closure, flags calls (not bare references — passing
+   ``time.monotonic`` as a default for an injectable clock is exactly
+   the approved plumbing):
+
+   * ``time.time()`` / ``time.monotonic()`` / ``time.perf_counter()``
+     (and ``_ns`` variants), through any import alias;
+   * module-level ``random.*()`` — the shared process RNG.
+     Constructing a seeded ``random.Random(seed)`` instance and calling
+     its methods is the approved pattern and stays quiet;
+   * ``os.urandom()``;
+   * ``for``-loop or comprehension iteration directly over a variable
+     the same file assigns a set — element order is hash-order;
+     iterate ``sorted(...)`` instead.
+
+Every finding here is a hole in the chaos contract: fix it with the
+injectable clock / seeded-RNG plumbing, or waive it with a reason that
+explains why the nondeterminism never reaches a journal byte (e.g. the
+value is stripped by ``VOLATILE_KEYS``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from harness.analysis.core import Finding, Project, SourceFile
+
+WALL_CLOCK = frozenset({"time", "monotonic", "perf_counter", "time_ns",
+                        "monotonic_ns", "perf_counter_ns"})
+
+
+def _module_name(path: str) -> str:
+    """'eges_tpu/sim/cluster.py' -> 'eges_tpu.sim.cluster' (packages
+    map to their __init__)."""
+    parts = path[:-3].split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _imports(src: SourceFile) -> set[str]:
+    """Dotted module names this file may load, lazily or not."""
+    pkg_parts = _module_name(src.path).split(".")[:-1]
+    out: set[str] = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out.add(alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = ".".join(pkg_parts[:len(pkg_parts)
+                                          - (node.level - 1)])
+                if node.module:
+                    base = f"{base}.{node.module}" if base else node.module
+            else:
+                base = node.module or ""
+            if base:
+                out.add(base)
+                for alias in node.names:  # `from pkg import submodule`
+                    out.add(f"{base}.{alias.name}")
+    return out
+
+
+def _closure(project: Project) -> list[SourceFile]:
+    mod2file = {_module_name(f.path): f for f in project.files}
+    seeds = []
+    for f in project.files:
+        base = os.path.basename(f.path)
+        in_sim = "/sim/" in f"/{f.path}"
+        defines_cluster = any(
+            isinstance(n, ast.ClassDef) and n.name == "SimCluster"
+            for n in ast.walk(f.tree))
+        if in_sim or base == "chaos.py" or defines_cluster:
+            seeds.append(f)
+    seen: set[str] = set()
+    work = [f.path for f in seeds]
+    ordered: list[SourceFile] = []
+    while work:
+        path = work.pop()
+        if path in seen:
+            continue
+        seen.add(path)
+        src = project.file(path)
+        if src is None:
+            continue
+        ordered.append(src)
+        for mod in sorted(_imports(src)):
+            target = mod2file.get(mod)
+            if target is not None:
+                work.append(target.path)
+            # importing pkg.sub executes every ancestor __init__
+            parts = mod.split(".")
+            for i in range(1, len(parts)):
+                anc = mod2file.get(".".join(parts[:i]))
+                if anc is not None:
+                    work.append(anc.path)
+    return sorted(ordered, key=lambda f: f.path)
+
+
+class _FileScan(ast.NodeVisitor):
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.findings: list[Finding] = []
+        self.scope: list[str] = []
+        # import aliases, including in-function `import time as _time`
+        self.time_aliases: set[str] = set()
+        self.random_aliases: set[str] = set()
+        self.os_aliases: set[str] = set()
+        self.from_time: set[str] = set()    # local names of time.* fns
+        self.from_random: set[str] = set()  # local names of random.* fns
+        self.from_os_urandom: set[str] = set()
+        self.set_vars: set[str] = set()     # names/self-attrs assigned sets
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    if alias.name == "time":
+                        self.time_aliases.add(local)
+                    elif alias.name == "random":
+                        self.random_aliases.add(local)
+                    elif alias.name == "os":
+                        self.os_aliases.add(local)
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    if node.module == "time" and alias.name in WALL_CLOCK:
+                        self.from_time.add(local)
+                    elif node.module == "random" and alias.name != "Random":
+                        self.from_random.add(local)
+                    elif node.module == "os" and alias.name == "urandom":
+                        self.from_os_urandom.add(local)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = getattr(node, "value", None)
+                if not self._is_set_expr(value):
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    name = self._iter_name(t)
+                    if name:
+                        self.set_vars.add(name)
+
+    @staticmethod
+    def _is_set_expr(value: ast.expr | None) -> bool:
+        if isinstance(value, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(value, ast.Call) and isinstance(value.func, ast.Name):
+            return value.func.id in ("set", "frozenset")
+        return False
+
+    @staticmethod
+    def _iter_name(expr: ast.expr) -> str | None:
+        """'x' for Name x, 'self.x' for self-attribute, else None."""
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            return f"self.{expr.attr}"
+        return None
+
+    def _where(self) -> str:
+        return ".".join(self.scope) if self.scope else "<module>"
+
+    def _flag(self, line: int, message: str) -> None:
+        self.findings.append(Finding(
+            rule="determinism", path=self.src.path, line=line,
+            symbol=self._where(), message=message))
+
+    # -- visitors --------------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            recv, attr = fn.value.id, fn.attr
+            if recv in self.time_aliases and attr in WALL_CLOCK:
+                self._flag(node.lineno,
+                           f"{recv}.{attr}() reads the wall clock in "
+                           f"chaos-reachable code — inject a clock "
+                           f"(SimClock / a `clock=` parameter) so "
+                           f"journals stay byte-deterministic")
+            elif recv in self.random_aliases and attr != "Random":
+                self._flag(node.lineno,
+                           f"{recv}.{attr}() uses the shared process RNG "
+                           f"— use a seeded random.Random(seed) instance")
+            elif recv in self.os_aliases and attr == "urandom":
+                self._flag(node.lineno,
+                           f"{recv}.urandom() is nondeterministic — "
+                           f"derive bytes from the run seed")
+        elif isinstance(fn, ast.Name):
+            if fn.id in self.from_time:
+                self._flag(node.lineno,
+                           f"{fn.id}() reads the wall clock in "
+                           f"chaos-reachable code — inject a clock "
+                           f"(SimClock / a `clock=` parameter) so "
+                           f"journals stay byte-deterministic")
+            elif fn.id in self.from_random:
+                self._flag(node.lineno,
+                           f"{fn.id}() uses the shared process RNG — "
+                           f"use a seeded random.Random(seed) instance")
+            elif fn.id in self.from_os_urandom:
+                self._flag(node.lineno,
+                           f"{fn.id}() is nondeterministic — derive "
+                           f"bytes from the run seed")
+        self.generic_visit(node)
+
+    def _check_iter(self, iter_expr: ast.expr) -> None:
+        name = self._iter_name(iter_expr)
+        if name in self.set_vars:
+            self._flag(iter_expr.lineno,
+                       f"iteration over set {name!r} visits elements in "
+                       f"hash order — iterate sorted({name}) so chaos "
+                       f"journals stay byte-deterministic")
+
+    def visit_For(self, node: ast.For) -> None:
+        self._check_iter(node.iter)
+        self.generic_visit(node)
+
+    visit_AsyncFor = visit_For
+
+    def _visit_comp(self, node) -> None:
+        for gen in node.generators:
+            self._check_iter(gen.iter)
+        self.generic_visit(node)
+
+    visit_ListComp = _visit_comp
+    visit_SetComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+    visit_DictComp = _visit_comp
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in _closure(project):
+        scan = _FileScan(src)
+        scan.visit(src.tree)
+        findings.extend(scan.findings)
+    return findings

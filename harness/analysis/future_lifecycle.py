@@ -1,0 +1,257 @@
+"""future-lifecycle: every path out of a future-creating function must
+resolve the future or hand it off.
+
+The scheduler's contract (PR 5: "futures must never hang") is that a
+``Future()`` created for a caller reaches one of, on EVERY path — the
+happy path, ``except``/``finally``, breaker-open, lane-death, and
+``close()``-drain branches alike:
+
+* ``fut.set_result(...)`` / ``fut.set_exception(...)`` / ``fut.cancel()``;
+* an explicit hand-off: returned (alone or inside a tuple/list/dict),
+  stored into a container/attribute/subscript, passed as a call
+  argument, or captured by a nested function/lambda.
+
+This checker runs a path-sensitive abstract interpretation over each
+function that constructs a ``Future()`` (or receives a parameter
+annotated ``Future``): branch on ``if``/``try``/loops, and report any
+``return``/``raise``/fall-off-the-end exit where a tracked future is
+still pending.  It is deliberately leak-biased: aliasing is tracked
+(``g = fut`` resolves through either name), but a future that escapes
+into any call or container is assumed handed off — the rule hunts the
+"early return leaks a pending future" shape, not double-resolution.
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+
+from harness.analysis.core import Finding, Project, SourceFile
+
+RESOLVERS = frozenset({"set_result", "set_exception", "cancel"})
+_MAX_STATES = 64  # per-merge cap; beyond it states are deduped anyway
+
+
+def _is_future_call(value: ast.expr) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    fn = value.func
+    name = (fn.id if isinstance(fn, ast.Name)
+            else fn.attr if isinstance(fn, ast.Attribute) else "")
+    return name == "Future"
+
+
+def _is_future_annotation(ann: ast.expr | None) -> bool:
+    if ann is None:
+        return False
+    for node in ast.walk(ann):
+        if isinstance(node, ast.Name) and node.id == "Future":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "Future":
+            return True
+    return False
+
+
+class _State:
+    """One abstract path: alias map + per-future status."""
+
+    __slots__ = ("vars", "objs")
+
+    def __init__(self, vars_: dict[str, str], objs: dict[str, str]):
+        self.vars = vars_    # name -> future key
+        self.objs = objs     # key  -> 'pending' | 'done'
+
+    def copy(self) -> "_State":
+        return _State(dict(self.vars), dict(self.objs))
+
+    def sig(self) -> tuple:
+        return (tuple(sorted(self.vars.items())),
+                tuple(sorted(self.objs.items())))
+
+    def pending(self) -> list[str]:
+        return sorted(k for k, st in self.objs.items() if st == "pending")
+
+
+def _dedupe(states: list[_State]) -> list[_State]:
+    seen, out = set(), []
+    for st in states:
+        sig = st.sig()
+        if sig not in seen:
+            seen.add(sig)
+            out.append(st)
+    return out[:_MAX_STATES]
+
+
+class _FuncCheck:
+    def __init__(self, src: SourceFile, qualname: str):
+        self.src = src
+        self.qualname = qualname
+        self.findings: list[Finding] = []
+        self._reported: set[tuple[str, int]] = set()
+
+    # -- expression-level consumption -----------------------------------
+
+    def _tracked_names(self, expr: ast.expr, st: _State) -> set[str]:
+        return {n.id for n in ast.walk(expr)
+                if isinstance(n, ast.Name) and n.id in st.vars}
+
+    def _consume(self, expr: ast.expr | None, st: _State) -> None:
+        """Mark futures done when the expression hands them off: passed
+        to any call, stored via a nested def/lambda capture, resolved by
+        a .set_result()/.set_exception()/.cancel() method call."""
+        if expr is None:
+            return
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if (isinstance(fn, ast.Attribute) and fn.attr in RESOLVERS
+                        and isinstance(fn.value, ast.Name)
+                        and fn.value.id in st.vars):
+                    st.objs[st.vars[fn.value.id]] = "done"
+                for arg in itertools.chain(
+                        node.args, (kw.value for kw in node.keywords)):
+                    for name in self._tracked_names(arg, st):
+                        st.objs[st.vars[name]] = "done"
+            elif isinstance(node, (ast.Lambda, ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                for name in self._tracked_names(node, st):  # closure capture
+                    st.objs[st.vars[name]] = "done"
+
+    def _leak_check(self, st: _State, line: int, how: str) -> None:
+        for key in st.pending():
+            st.objs[key] = "done"  # one report per leak site, not per path
+            if (key, line) in self._reported:
+                continue
+            self._reported.add((key, line))
+            self.findings.append(Finding(
+                rule="future-lifecycle", path=self.src.path, line=line,
+                symbol=f"{self.qualname}.{key.split('@')[0]}",
+                message=(f"future {key.split('@')[0]!r} (created at line "
+                         f"{key.split('@')[1]}) is still pending when "
+                         f"this path {how} — every exit must set_result/"
+                         f"set_exception or hand the future off")))
+
+    # -- statement interpretation ---------------------------------------
+
+    def _exec(self, stmts: list[ast.stmt],
+              states: list[_State]) -> list[_State]:
+        for stmt in stmts:
+            states = _dedupe(list(itertools.chain.from_iterable(
+                self._step(stmt, st) for st in states)))
+            if not states:
+                break
+        return states
+
+    def _step(self, stmt: ast.stmt, st: _State) -> list[_State]:
+        if isinstance(stmt, ast.Assign):
+            return self._assign(stmt.targets, stmt.value, st)
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            return self._assign([stmt.target], stmt.value, st)
+        if isinstance(stmt, ast.AugAssign):
+            self._consume(stmt.value, st)
+            return [st]
+        if isinstance(stmt, ast.Expr):
+            self._consume(stmt.value, st)
+            return [st]
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                for name in self._tracked_names(stmt.value, st):
+                    st.objs[st.vars[name]] = "done"  # returned = handed off
+                self._consume(stmt.value, st)
+            self._leak_check(st, stmt.lineno, "returns")
+            return []
+        if isinstance(stmt, ast.Raise):
+            self._consume(stmt.exc, st)
+            self._leak_check(st, stmt.lineno, "raises")
+            return []
+        if isinstance(stmt, ast.If):
+            self._consume(stmt.test, st)
+            return (self._exec(stmt.body, [st.copy()])
+                    + self._exec(stmt.orelse, [st]))
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            if isinstance(stmt, ast.While):
+                self._consume(stmt.test, st)
+            else:
+                self._consume(stmt.iter, st)
+            after = self._exec(stmt.body, [st.copy()])
+            return self._exec(stmt.orelse, _dedupe([st] + after))
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._consume(item.context_expr, st)
+            return self._exec(stmt.body, [st])
+        if isinstance(stmt, ast.Try):
+            pre = st.copy()  # the body may fail before its first resolve
+            fallthrough = self._exec(stmt.body, [st])
+            fallthrough = self._exec(stmt.orelse, fallthrough)
+            for handler in stmt.handlers:
+                fallthrough += self._exec(handler.body, [pre.copy()])
+            return self._exec(stmt.finalbody, _dedupe(fallthrough))
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return []  # rejoins at the loop merge, handled above
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._consume_def(stmt, st)
+            return [st]
+        if isinstance(stmt, (ast.Assert, ast.Delete, ast.Global,
+                             ast.Nonlocal, ast.Pass, ast.Import,
+                             ast.ImportFrom, ast.ClassDef)):
+            return [st]
+        return [st]
+
+    def _consume_def(self, stmt: ast.stmt, st: _State) -> None:
+        for name in self._tracked_names(stmt, st):
+            st.objs[st.vars[name]] = "done"
+
+    def _assign(self, targets: list[ast.expr], value: ast.expr,
+                st: _State) -> list[_State]:
+        if (_is_future_call(value) and len(targets) == 1
+                and isinstance(targets[0], ast.Name)):
+            key = f"{targets[0].id}@{value.lineno}"
+            st.vars[targets[0].id] = key
+            st.objs[key] = "pending"
+            return [st]
+        self._consume(value, st)
+        if isinstance(value, ast.Name) and value.id in st.vars:
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    st.vars[t.id] = st.vars[value.id]  # alias
+                else:  # stored into attribute/subscript: handed off
+                    st.objs[st.vars[value.id]] = "done"
+            return [st]
+        for t in targets:  # rebinding a tracked name drops the alias
+            if isinstance(t, ast.Name):
+                st.vars.pop(t.id, None)
+        return [st]
+
+    def run(self, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        init = _State({}, {})
+        a = fn.args
+        for arg in itertools.chain(a.posonlyargs, a.args, a.kwonlyargs):
+            if _is_future_annotation(arg.annotation):
+                key = f"{arg.arg}@{fn.lineno}"
+                init.vars[arg.arg] = key
+                init.objs[key] = "pending"
+        creates = any(_is_future_call(n) for n in ast.walk(fn)
+                      if isinstance(n, ast.Call))
+        if not creates and not init.objs:
+            return
+        end = fn.body[-1].lineno if fn.body else fn.lineno
+        for st in self._exec(fn.body, [init]):
+            self._leak_check(st, end, "falls off the end")
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in project.files:
+        stack: list[tuple[ast.AST, str]] = [(src.tree, "")]
+        while stack:
+            node, prefix = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    stack.append((child, f"{prefix}{child.name}."))
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    fc = _FuncCheck(src, f"{prefix}{child.name}")
+                    fc.run(child)
+                    findings.extend(fc.findings)
+                    stack.append((child, f"{prefix}{child.name}."))
+    return findings

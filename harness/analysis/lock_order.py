@@ -1,0 +1,369 @@
+"""lock-order / fail-under-lock: deadlock-shaped lock usage.
+
+**lock-order** builds the whole-program lock-acquisition graph.  A lock
+is any ``self.X = threading.Lock()/RLock()/Condition()/Semaphore()``
+attribute (identity ``Class.X``) or module-level ``NAME = Lock()``
+(identity ``module.NAME``).  Edges come from two sources:
+
+* lexical nesting — ``with self.A:`` containing ``with self.B:`` (or a
+  ``B.acquire()`` call) adds the edge ``A -> B``;
+* one-level call resolution — a call made while ``A`` is held, to a
+  method that acquires ``B`` anywhere in its body, adds ``A -> B``.
+  ``self.m()`` resolves within the class; other ``recv.m()`` calls
+  resolve only when exactly one class in the project defines ``m``
+  (ambiguous names are skipped, not guessed).
+
+Any cycle between *distinct* locks is reported once per strongly
+connected component, with the source site of every edge in the cycle so
+the report reads as a deadlock trace.  Same-lock re-acquisition is
+lock-discipline's territory and is not reported here.
+
+**fail-under-lock** flags calls made while a lock is held that can run
+foreign code:
+
+* callback-shaped callees (``*hook*``, ``*callback*``, ``cb``/``*_cb``,
+  ``on_*``) under ANY lock — injected code must never run inside a
+  critical section;
+* ``fut.set_result()`` / ``fut.set_exception()`` under ANY lock —
+  resolving a future wakes waiters and runs done-callbacks inline;
+* ``journal.record(...)`` / ``metrics.counter|gauge|histogram|timer|
+  meter(...)`` under a NON-reentrant lock (``Lock``/``Condition``/
+  ``Semaphore``) — the observability layer takes its own internal
+  locks, so emitting from inside a plain critical section nests lock
+  acquisitions on every hot-path event.  RLock monitor classes
+  (e.g. GeecNode) are exempt: re-entry cannot self-deadlock there, and
+  holding the monitor across emits is their documented design.
+
+The observability modules themselves (``utils/metrics.py``,
+``utils/journal.py``) are exempt from the emit sub-rule — they ARE the
+layer the rule protects.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from harness.analysis.core import Finding, Project, SourceFile
+from harness.analysis.lock_discipline import LOCK_FACTORIES
+
+REENTRANT = frozenset({"RLock"})
+FUTURE_RESOLVERS = frozenset({"set_result", "set_exception"})
+METRIC_FAMILIES = frozenset({"counter", "gauge", "histogram", "timer",
+                             "meter"})
+EMIT_EXEMPT_SUFFIXES = ("utils/metrics.py", "utils/journal.py")
+
+
+def _callbackish(name: str) -> bool:
+    return (name == "cb" or name.endswith("_cb") or "callback" in name
+            or "hook" in name or name.startswith("on_"))
+
+
+class _Lock:
+    """One lock object: stable identity plus reentrancy kind."""
+
+    __slots__ = ("id", "kind")
+
+    def __init__(self, ident: str, kind: str):
+        self.id = ident
+        self.kind = kind
+
+
+def _lock_factory_name(value: ast.expr) -> str:
+    """'Lock'/'RLock'/... when value is a lock-factory call, else ''."""
+    fn = value.func if isinstance(value, ast.Call) else None
+    name = (fn.attr if isinstance(fn, ast.Attribute)
+            else fn.id if isinstance(fn, ast.Name) else "")
+    return name if name in LOCK_FACTORIES else ""
+
+
+class _FuncScan:
+    """Per-function walk tracking the held-lock stack lexically."""
+
+    def __init__(self, src: SourceFile, owner: str,
+                 self_locks: dict[str, str], mod_locks: dict[str, _Lock],
+                 global_locks: dict[tuple[str, str], _Lock]):
+        self.src = src
+        self.owner = owner            # "Class.method" or module function
+        self.self_locks = self_locks  # attr -> factory kind
+        self.mod_locks = mod_locks    # NAME -> _Lock (this module)
+        self.global_locks = global_locks  # (module stem, NAME) -> _Lock
+        self.cls_name = owner.rsplit(".", 1)[0] if "." in owner else ""
+        self.acquired: set[str] = set()   # every lock id taken in body
+        self.edges: list[tuple[str, str, int]] = []
+        # call sites made under >=1 held lock, for one-level resolution:
+        # (callee name, receiver-is-self, held lock ids, line)
+        self.calls: list[tuple[str, bool, tuple[str, ...], int]] = []
+        self.fails: list[Finding] = []
+
+    def _lock_of(self, expr: ast.expr) -> _Lock | None:
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and expr.attr in self.self_locks):
+            return _Lock(f"{self.cls_name}.{expr.attr}",
+                         self.self_locks[expr.attr])
+        if isinstance(expr, ast.Name) and expr.id in self.mod_locks:
+            return self.mod_locks[expr.id]
+        # other_module.LOCK — resolved by the imported module's stem
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)):
+            return self.global_locks.get((expr.value.id, expr.attr))
+        return None
+
+    def scan(self, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        for stmt in fn.body:
+            self._walk(stmt, ())
+
+    def _walk(self, node: ast.AST, held: tuple[_Lock, ...]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            taken = list(held)
+            for item in node.items:
+                lk = self._lock_of(item.context_expr)
+                if lk is None:
+                    self._walk(item.context_expr, tuple(taken))
+                    continue
+                self._note_acquire(lk, tuple(taken), item.context_expr.lineno)
+                taken.append(lk)
+            for stmt in node.body:
+                self._walk(stmt, tuple(taken))
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested defs run later, outside this lock scope
+        if isinstance(node, ast.Call):
+            self._handle_call(node, held)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held)
+
+    def _note_acquire(self, lk: _Lock, held: tuple[_Lock, ...],
+                      line: int) -> None:
+        self.acquired.add(lk.id)
+        for h in held:
+            if h.id != lk.id:
+                self.edges.append((h.id, lk.id, line))
+
+    def _handle_call(self, node: ast.Call, held: tuple[_Lock, ...]) -> None:
+        func = node.func
+        # explicit B.acquire() while A is held: same edge as `with B:`
+        if isinstance(func, ast.Attribute) and func.attr == "acquire":
+            lk = self._lock_of(func.value)
+            if lk is not None:
+                self._note_acquire(lk, held, node.lineno)
+                return
+        if not held:
+            return
+        if isinstance(func, ast.Attribute):
+            name = func.attr
+            recv = func.value
+            is_self = isinstance(recv, ast.Name) and recv.id == "self"
+        elif isinstance(func, ast.Name):
+            name, recv, is_self = func.id, None, False
+        else:
+            return
+        self.calls.append((name, is_self,
+                           tuple(h.id for h in held), node.lineno))
+        self._check_fail(node, name, recv, held)
+
+    def _check_fail(self, node: ast.Call, name: str, recv: ast.expr | None,
+                    held: tuple[_Lock, ...]) -> None:
+        holder = " + ".join(h.id for h in held)
+        if name in FUTURE_RESOLVERS:
+            self.fails.append(self._fail(
+                node.lineno,
+                f"{ast.unparse(node.func)}() resolves a future while "
+                f"{holder} is held — waiter wakeups and done-callbacks "
+                f"run inline; resolve after releasing the lock"))
+            return
+        if _callbackish(name):
+            self.fails.append(self._fail(
+                node.lineno,
+                f"callback {ast.unparse(node.func)}() invoked while "
+                f"{holder} is held — injected code must not run inside "
+                f"a critical section"))
+            return
+        if self.src.path.endswith(EMIT_EXEMPT_SUFFIXES):
+            return
+        if not any(h.kind not in REENTRANT for h in held):
+            return  # pure-RLock monitor: emits under it are by design
+        recv_name = (recv.id if isinstance(recv, ast.Name)
+                     else recv.attr if isinstance(recv, ast.Attribute)
+                     else "")
+        if (name == "record" and recv_name == "journal") or \
+                (name in METRIC_FAMILIES and recv_name == "metrics"):
+            self.fails.append(self._fail(
+                node.lineno,
+                f"{ast.unparse(node.func)}(...) emits telemetry while "
+                f"non-reentrant {holder} is held — copy state under the "
+                f"lock, emit after releasing it"))
+
+    def _fail(self, line: int, message: str) -> Finding:
+        return Finding(rule="fail-under-lock", path=self.src.path,
+                       line=line, symbol=self.owner, message=message)
+
+
+def _module_locks(src: SourceFile) -> dict[str, _Lock]:
+    mod = src.path.rsplit("/", 1)[-1][:-3]
+    out: dict[str, _Lock] = {}
+    for node in src.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        kind = _lock_factory_name(node.value)
+        if not kind:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = _Lock(f"{mod}.{t.id}", kind)
+    return out
+
+
+def _class_locks(cls: ast.ClassDef) -> dict[str, str]:
+    """self.X = threading.Lock() assignments anywhere in the class."""
+    out: dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        kind = _lock_factory_name(node.value)
+        if not kind:
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                out[t.attr] = kind
+    return out
+
+
+def _cycle_findings(edges: dict[tuple[str, str], tuple[str, int]],
+                    ) -> list[Finding]:
+    """One finding per strongly connected component of >= 2 locks."""
+    adj: dict[str, set[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set())
+
+    # Tarjan SCC, iterative for deep graphs
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(root: str) -> None:
+        work = [(root, iter(sorted(adj[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj[w]))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+
+    findings = []
+    for comp in sorted(sccs):
+        members = set(comp)
+        trace = []
+        for (a, b), (path, line) in sorted(edges.items(),
+                                           key=lambda kv: kv[1]):
+            if a in members and b in members:
+                trace.append(f"{a} -> {b} ({path}:{line})")
+        path, line = min((site for (a, b), site in edges.items()
+                          if a in members and b in members))
+        findings.append(Finding(
+            rule="lock-order", path=path, line=line,
+            symbol=" <-> ".join(comp),
+            message=(f"lock-order cycle between {', '.join(comp)}: "
+                     f"{'; '.join(trace)} — two threads taking these "
+                     f"locks in opposite orders deadlock")))
+    return findings
+
+
+def check(project: Project) -> list[Finding]:
+    scans: list[_FuncScan] = []
+    # lock set acquired per method, for one-level call resolution
+    method_locks: dict[tuple[str, str], set[str]] = {}
+    by_name: dict[str, list[set[str]]] = {}
+
+    per_file_mod_locks = {src.path: _module_locks(src)
+                          for src in project.files}
+    global_locks: dict[tuple[str, str], _Lock] = {}
+    for path, locks in per_file_mod_locks.items():
+        stem = path.rsplit("/", 1)[-1][:-3]
+        for name, lk in locks.items():
+            global_locks[(stem, name)] = lk
+
+    for src in project.files:
+        mod_locks = per_file_mod_locks[src.path]
+        for cls in [n for n in ast.walk(src.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            self_locks = _class_locks(cls)
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                scan = _FuncScan(src, f"{cls.name}.{meth.name}",
+                                 self_locks, mod_locks, global_locks)
+                scan.scan(meth)
+                scans.append(scan)
+                method_locks[(cls.name, meth.name)] = scan.acquired
+                by_name.setdefault(meth.name, []).append(scan.acquired)
+        for fn in src.tree.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan = _FuncScan(src, fn.name, {}, mod_locks, global_locks)
+                scan.scan(fn)
+                scans.append(scan)
+
+    # edge set: first site wins, keyed (from, to)
+    edges: dict[tuple[str, str], tuple[str, int]] = {}
+    findings: list[Finding] = []
+    for scan in scans:
+        findings.extend(scan.fails)
+        for a, b, line in scan.edges:
+            edges.setdefault((a, b), (scan.src.path, line))
+        for name, is_self, held, line in scan.calls:
+            if is_self and scan.cls_name:
+                target = method_locks.get((scan.cls_name, name))
+            else:
+                cands = by_name.get(name, [])
+                target = cands[0] if len(cands) == 1 else None
+            if not target:
+                continue
+            for h in held:
+                for lock_id in target:
+                    if lock_id != h:
+                        edges.setdefault((h, lock_id),
+                                         (scan.src.path, line))
+
+    findings.extend(_cycle_findings(edges))
+    return findings

@@ -1,0 +1,39 @@
+"""Seeded determinism fixtures: a SimCluster whose closure reads the
+wall clock, the process RNG, entropy, and hash-ordered sets — next to
+the approved injectable plumbing that must stay quiet."""
+
+import os
+import random
+import time
+from time import monotonic as mono
+
+from simtree import engine  # pulled into the closure by this import
+
+
+class SimCluster:
+    def __init__(self, seed=0, clock=None):
+        self.members = {"n2", "n0", "n1"}
+        # a bare reference is the approved plumbing, not a finding
+        self.clock = clock or time.monotonic
+        self.rng = random.Random(seed)
+
+    def bad_stamp(self):
+        return time.time()             # seeded: direct wall clock
+
+    def bad_delay(self):
+        return mono() + random.random()  # seeded: from-import + module RNG
+
+    def bad_token(self):
+        return os.urandom(8)           # seeded: ambient entropy
+
+    def bad_order(self):
+        return [m for m in self.members]   # seeded: hash-order iteration
+
+    def good_stamp(self):
+        return self.clock()            # injected clock: quiet
+
+    def good_delay(self):
+        return self.rng.random()       # seeded instance RNG: quiet
+
+    def good_order(self):
+        return sorted(self.members)    # sorted iteration: quiet

@@ -1,0 +1,13 @@
+"""Reached from SimCluster via the import graph — findings here prove
+the closure expands past the seed file."""
+
+import time
+
+
+def lazy_clock():
+    return time.perf_counter()     # seeded: wall clock one import deep
+
+
+def outside_plumbing(clock=time.monotonic):
+    # default-argument reference, never called here: quiet
+    return clock

@@ -1,0 +1,8 @@
+"""NOT imported by the SimCluster closure: wall-clock reads here must
+stay invisible to the determinism rule."""
+
+import time
+
+
+def free_running():
+    return time.time()

@@ -1,0 +1,65 @@
+"""Seeded future-lifecycle fixtures: pending futures escaping through
+an early return, an exception path, and a fall-off-the-end — next to
+clean twins exercising every hand-off form."""
+
+from concurrent.futures import Future
+
+
+def early_return_leak(closed):
+    fut = Future()
+    if closed:
+        return None            # seeded: fut still pending
+    fut.set_result(1)
+    return fut
+
+
+def except_path_leak(work):
+    fut = Future()
+    try:
+        fut.set_result(work())
+    except ValueError:
+        return None            # seeded: the failure path never resolves
+    return fut
+
+
+def fall_off_leak(flag):
+    fut = Future()
+    if flag:
+        fut.set_result(1)      # seeded: the else path falls off pending
+
+
+def param_leak(fut: Future, ok):
+    if not ok:
+        return                 # seeded: received future abandoned
+    fut.set_result(ok)
+
+
+def clean_all_paths(closed, work):
+    fut = Future()
+    if closed:
+        fut.set_exception(RuntimeError("closed"))
+        return fut
+    try:
+        fut.set_result(work())
+    except ValueError as e:
+        fut.set_exception(e)
+    return fut
+
+
+def clean_handoffs(queue, registry, cb):
+    a = Future()
+    queue.append((b"key", a))      # container hand-off
+    b = Future()
+    registry["k"] = b              # subscript hand-off
+    c = Future()
+    cb(c)                          # call-argument hand-off
+    d = Future()
+    alias = d
+    alias.cancel()                 # resolution through an alias
+    e = Future()
+    return [e]                     # returned inside a container
+
+
+def clean_closure_capture(schedule):
+    fut = Future()
+    schedule(lambda: fut.set_result(1))  # captured: resolved elsewhere

@@ -1,0 +1,12 @@
+"""Module-level locks: the other half of the cross-file cycle."""
+
+import threading
+
+LOCK_X = threading.Lock()
+LOCK_Y = threading.Lock()
+
+
+def yx():
+    with LOCK_Y:
+        with LOCK_X:
+            return 4

@@ -1,0 +1,114 @@
+"""Seeded lock-order fixtures: an AB/BA cycle, a callback fired under a
+lock, a future resolved under a lock, telemetry emitted under a plain
+lock — plus clean twins that must stay quiet."""
+
+import threading
+
+from pkg import peer
+
+metrics = None
+journal = None
+
+
+class Deadlocky:
+    """Acquires its two locks in opposite orders: the seeded cycle."""
+
+    def __init__(self):
+        self._front = threading.Lock()
+        self._staging = threading.Lock()
+
+    def ab(self):
+        with self._front:
+            with self._staging:
+                return 1
+
+    def ba(self):
+        with self._staging:
+            with self._front:
+                return 2
+
+
+class CrossCall:
+    """The BA half of a cycle hides one call level deep: ``reverse``
+    holds ``_b`` and calls a method that acquires ``_a``."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def reverse(self):
+        with self._b:
+            self._take_a()
+
+    def _take_a(self):
+        with self._a:
+            return 2
+
+
+class FailsUnderLock:
+    def __init__(self, on_done):
+        self._lock = threading.Lock()
+        self.on_done = on_done
+        self.failure_hook = None
+
+    def resolve_locked(self, fut):
+        with self._lock:
+            fut.set_result(1)          # seeded: resolution under lock
+
+    def callback_locked(self):
+        with self._lock:
+            self.on_done("x")          # seeded: callback under lock
+
+    def emit_locked(self):
+        with self._lock:
+            metrics.counter("pkg.n").inc()   # seeded: emit under Lock
+            journal.record("locked_event")   # seeded: emit under Lock
+
+
+class Ordered:
+    """Clean twin: both paths take the locks in the same order, and all
+    foreign code runs after release."""
+
+    def __init__(self, on_done):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.on_done = on_done
+
+    def one(self):
+        with self._a:
+            with self._b:
+                n = 1
+        self.on_done(n)
+        return n
+
+    def two(self, fut):
+        with self._a:
+            with self._b:
+                n = 2
+        fut.set_result(n)
+        return n
+
+
+class Monitor:
+    """Clean twin: an RLock monitor may emit telemetry while held —
+    that is its documented design, re-entry cannot self-deadlock."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def tick(self):
+        with self._lock:
+            journal.record("monitor_event")
+            metrics.counter("pkg.ticks").inc()
+
+
+def cross_file_cycle():
+    """Module-lock half of a cross-file cycle with pkg.peer."""
+    with peer.LOCK_X:
+        with peer.LOCK_Y:
+            return 3

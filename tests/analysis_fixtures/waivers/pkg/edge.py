@@ -1,0 +1,39 @@
+"""Waiver-grammar edge cases: stacked tokens on one comment, and the
+annotation-above form attaching to the wrong statement when another
+line sits between the comment and the code."""
+
+import queue
+
+
+def trailing_stacked():
+    try:
+        return open("/nonexistent")
+    except Exception:  # analysis: allow-swallow(probe is optional) allow-unbounded-queue(unused token)
+        return None
+
+
+def standalone_stacked():
+    # analysis: allow-thread-join(unused token) allow-unbounded-queue(test rig buffer)
+    q = queue.Queue()
+    return q
+
+
+def wrong_line_comment_between():
+    # analysis: allow-unbounded-queue(meant for the queue below)
+    # ...but a waiver alone on a line covers ONLY the next line, and the
+    # next line here is this comment — the queue stays unsuppressed.
+    q = queue.Queue()
+    return q
+
+
+def wrong_line_blank_between():
+    # analysis: allow-unbounded-queue(also meant for the queue below)
+
+    q = queue.Queue()
+    return q
+
+
+def correct_line_above():
+    # analysis: allow-unbounded-queue(directly above: covered)
+    q = queue.Queue()
+    return q

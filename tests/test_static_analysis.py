@@ -95,6 +95,89 @@ def test_robustness_tp_tn_and_waiver_per_subrule():
     assert lines["no-print"].path.endswith("lib.py")  # __main__ exempt
 
 
+# -- lock-order -----------------------------------------------------------
+
+def test_lock_order_reports_each_seeded_cycle():
+    rep = _run_fixture("lockorder", paths=("pkg",), rules=("lock-order",))
+    syms = {f.symbol for f in rep.unsuppressed}
+    assert syms == {
+        "Deadlocky._front <-> Deadlocky._staging",   # lexical AB/BA
+        "CrossCall._a <-> CrossCall._b",             # BA via a call
+        "peer.LOCK_X <-> peer.LOCK_Y",               # cross-file module locks
+    }, [f.render() for f in rep.unsuppressed]
+    # the consistently-ordered twin never appears
+    assert not any("Ordered" in f.symbol for f in rep.findings)
+    msgs = [f.message for f in rep.unsuppressed]
+    # each cycle report carries the acquisition paths as evidence
+    assert all("opposite orders deadlock" in m for m in msgs)
+
+
+def test_fail_under_lock_flags_and_exemptions():
+    rep = _run_fixture("lockorder", paths=("pkg",),
+                       rules=("fail-under-lock",))
+    by_line = {f.line: f.message for f in rep.unsuppressed}
+    assert len(by_line) == 4, [f.render() for f in rep.unsuppressed]
+    assert "resolves a future" in by_line[61]
+    assert "callback" in by_line[65]
+    assert "emits telemetry" in by_line[69]          # metrics under Lock
+    assert "emits telemetry" in by_line[70]          # journal under Lock
+    # the RLock monitor and the emit-after-release twin stay quiet
+    syms = {f.symbol for f in rep.findings}
+    assert not any(s.startswith(("Monitor.", "Ordered.")) for s in syms)
+
+
+# -- future-lifecycle -----------------------------------------------------
+
+def test_future_lifecycle_catches_each_leak_shape():
+    rep = _run_fixture("future", paths=("pkg",),
+                       rules=("future-lifecycle",))
+    syms = {f.symbol for f in rep.unsuppressed}
+    assert syms == {"early_return_leak.fut", "except_path_leak.fut",
+                    "fall_off_leak.fut", "param_leak.fut"}, [
+        f.render() for f in rep.unsuppressed]
+    # every hand-off form (return, container, attr store, call arg,
+    # alias-cancel, closure capture) keeps the clean twins quiet
+    assert not any(f.symbol.startswith("clean_") for f in rep.findings)
+
+
+# -- determinism ----------------------------------------------------------
+
+def test_determinism_closure_and_approved_plumbing():
+    rep = _run_fixture("determinism", paths=("simtree",),
+                       rules=("determinism",))
+    un = rep.unsuppressed
+    assert len(un) == 6, [f.render() for f in un]
+    msgs = "\n".join(f.message for f in un)
+    assert "reads the wall clock" in msgs
+    assert "shared process RNG" in msgs
+    assert "urandom" in msgs
+    assert "hash order" in msgs
+    # the closure expands one import deep from the SimCluster seed...
+    assert any(f.path.endswith("engine.py") for f in un)
+    # ...but never into files outside the import graph
+    assert not any(f.path.endswith("unreachable.py") for f in rep.findings)
+    # clock= / random.Random(seed) / sorted() plumbing is the approved
+    # fix, so the good_* methods produce nothing
+    assert all(".bad_" in f.symbol or f.symbol == "lazy_clock"
+               for f in un)
+
+
+# -- waiver grammar edge cases --------------------------------------------
+
+def test_waiver_stacked_tokens_and_wrong_line_attachment():
+    rep = _run_fixture("waivers", paths=("pkg",))
+    # stacked allow- tokens in one comment each take effect, trailing
+    # (line 11) and standalone-above (line 17) alike
+    waived = {(f.rule, f.line) for f in rep.findings if f.waived}
+    assert ("swallow", 11) in waived
+    assert ("unbounded-queue", 17) in waived
+    assert ("unbounded-queue", 38) in waived  # directly above: covered
+    # a standalone waiver covers ONLY the next line: a comment or blank
+    # line in between orphans it and the code stays unsuppressed
+    un = {f.line for f in rep.unsuppressed}
+    assert un == {25, 32}, [f.render() for f in rep.unsuppressed]
+
+
 # -- baseline layer -------------------------------------------------------
 
 def test_baseline_budget_staleness_and_justification(tmp_path):
@@ -111,16 +194,25 @@ def test_baseline_budget_staleness_and_justification(tmp_path):
     entries = json.load(open(bl))
     for e in entries:
         e["justification"] = "fixture: intentional drop"
-    extra = dict(entries[0], path="pkg/gone.py",
-                 justification="stale on purpose")
+    extra = dict(entries[0], justification="stale on purpose")
     json.dump(entries + [extra], open(bl, "w"))
 
     rep2 = run(root, paths=("pkg",), rules=("swallow",), baseline_path=bl)
     assert rep2.unsuppressed == []
     assert sum(1 for f in rep2.findings if f.baselined) == 1
-    # the unmatched entry is reported stale, and the budget is per
-    # occurrence: one entry cannot hide two findings
-    assert [e["path"] for e in rep2.stale_baseline] == ["pkg/gone.py"]
+    # the unmatched duplicate is reported stale: the budget is per
+    # occurrence, one finding cannot consume two entries
+    assert len(rep2.stale_baseline) == 1
+    assert rep2.stale_baseline[0]["rule"] == entries[0]["rule"]
+
+    # an entry whose file no longer exists is a config error (exit 2),
+    # not a silent pass — the suppression it carried may be hiding a
+    # reintroduction elsewhere
+    gone = dict(entries[0], path="pkg/gone.py",
+                justification="points at a deleted file")
+    json.dump(entries + [gone], open(bl, "w"))
+    with pytest.raises(BaselineError, match="no longer exists"):
+        run(root, paths=("pkg",), rules=("swallow",), baseline_path=bl)
 
 
 # -- the CI gate over the real tree --------------------------------------
@@ -155,3 +247,96 @@ def test_cli_gate_exit_codes_and_summary(tmp_path):
          os.path.join(FIXTURES, "robust"), "--no-baseline", "pkg"],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 1, proc.stdout + proc.stderr
+
+
+@pytest.mark.parametrize("tree,paths", [
+    ("lockorder", "pkg"),      # seeded AB/BA deadlock cycle
+    ("future", "pkg"),         # seeded pending-future leak
+    ("determinism", "simtree"),  # seeded wall clock in chaos-reachable code
+])
+def test_cli_exits_nonzero_on_each_seeded_concurrency_bug(tree, paths):
+    proc = subprocess.run(
+        [sys.executable, "-m", "harness.analysis", "--root",
+         os.path.join(FIXTURES, tree), "--no-baseline", paths],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+
+
+# -- --diff scoping -------------------------------------------------------
+
+def _git(root, *argv):
+    subprocess.run(["git", "-c", "user.name=t", "-c", "user.email=t@t",
+                    *argv], cwd=root, check=True, capture_output=True)
+
+
+def test_cli_diff_scopes_findings_to_changed_files(tmp_path):
+    import shutil
+    root = str(tmp_path / "tree")
+    shutil.copytree(os.path.join(FIXTURES, "robust"), root)
+    _git(root, "init", "-q")
+    _git(root, "add", ".")
+    _git(root, "commit", "-qm", "seed")
+
+    def cli(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "harness.analysis", "--root", root,
+             "--no-baseline", *extra, "pkg", "eges_tpu"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+
+    # without --diff the seeded findings fail the gate...
+    assert cli().returncode == 1
+    # ...but nothing changed since HEAD, so the scoped run passes
+    proc = cli("--diff", "HEAD")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # touch one dirty file: only its findings come back in scope
+    hygiene = os.path.join(root, "pkg", "hygiene.py")
+    with open(hygiene, "a") as fh:
+        fh.write("\n# touched\n")
+    proc = cli("--diff", "HEAD")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "pkg/hygiene.py" in proc.stdout
+    assert "eges_tpu/lib.py" not in proc.stdout
+
+    # an unresolvable base rev is a usage error, not a silent pass
+    proc = cli("--diff", "no-such-rev")
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+
+
+# -- the analysis trend gate (check_regression --analysis) ----------------
+
+def test_check_regression_analysis_gate(tmp_path):
+    from harness.check_regression import main as gate
+
+    hist = str(tmp_path / "analysis_history.jsonl")
+
+    def write(*counts):
+        with open(hist, "w") as fh:
+            for c in counts:
+                fh.write(json.dumps({"unsuppressed_by_rule": c}) + "\n")
+
+    # one line: nothing to compare yet
+    write({"lock-order": 0})
+    assert gate([hist, "--analysis"]) == 0
+
+    # flat or falling counts pass
+    write({"lock-order": 1, "swallow": 2}, {"lock-order": 1, "swallow": 0})
+    assert gate([hist, "--analysis"]) == 0
+
+    # ANY per-rule rise fails, even when the total falls
+    write({"lock-order": 0, "swallow": 9}, {"lock-order": 1, "swallow": 0})
+    assert gate([hist, "--analysis"]) == 1
+
+    # a rule absent from the previous line counts as zero, so a freshly
+    # added checker gates from its first unsuppressed finding
+    write({"swallow": 0}, {"swallow": 0, "determinism": 1})
+    assert gate([hist, "--analysis"]) == 1
+
+    # torn/non-summary lines are skipped, like the bench history loader
+    with open(hist, "w") as fh:
+        fh.write('{"metric": "rows", "value": 3}\n{torn\n')
+        fh.write(json.dumps({"unsuppressed_by_rule": {"swallow": 0}}) + "\n")
+        fh.write(json.dumps({"unsuppressed_by_rule": {"swallow": 0}}) + "\n")
+    assert gate([hist, "--analysis"]) == 0
+
+    assert gate([str(tmp_path / "missing.jsonl"), "--analysis"]) == 2
