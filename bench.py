@@ -30,13 +30,18 @@ baseline and the 16 k/s reference-class figure (BASELINE.md: the
 libsecp256k1 cgo path is ~12-20 k verifies/s/core), so the ratio is
 conservative even though our schoolbook C++ recover is slower.
 
-Two further independently-gated series ride every round:
+Further independently-gated series ride every round:
 ``cold_start_seconds`` (child entry to first verified batch — the
 number the ``crypto/aotstore.py`` artifact store shrinks by
 deserializing stored executables instead of recompiling; gated
-lower-is-better) and ``pipeline_overlap_ratio`` (the scheduler's
+lower-is-better), ``pipeline_overlap_ratio`` (the scheduler's
 double-buffered lane pipeline measured host-side over
-``PipelinedNativeVerifier`` — overlapped windows / pipelined windows).
+``PipelinedNativeVerifier`` — overlapped windows / pipelined windows),
+and ``slo_compliance_ratio`` / ``slo_false_positive_alerts`` (a calm
+sim cluster through the live telemetry collector + burn-rate SLO
+engine, ``harness/collector.py`` / ``harness/slo.py`` — any alert
+firing on a healthy cluster is a false positive, gated at exactly
+zero).
 
 ``bench.py mesh`` is a separate stage: it regenerates MESH_SCALING.json
 through ``harness/mesh_scaling.run`` (psum/ring A/B, recorded collective
@@ -523,6 +528,46 @@ def _pipeline_stage() -> dict | None:
         return None
 
 
+def _slo_stage() -> dict | None:
+    """Telemetry-plane stage: a small calm (fault-free) sim cluster
+    runs with the live collector + burn-rate SLO engine attached
+    (``harness/collector.py`` / ``harness/slo.py``) and reports the
+    engine's compliance ratio and how many alerts fired.  On a healthy
+    cluster ANY firing alert is a false positive, so the history series
+    ``slo_false_positive_alerts`` is gated at exactly zero and
+    ``slo_compliance_ratio`` is gated lower-is-worse by
+    ``harness/check_regression.py``.
+
+    Runs in the PARENT like ``_coalesced_stage``: the sim imports no
+    JAX and the burn-rate mechanics are backend-independent."""
+    try:
+        from eges_tpu.sim.cluster import SimCluster
+        from harness.collector import ClusterCollector
+
+        t0 = time.monotonic()
+        col = ClusterCollector()
+        cluster = SimCluster(4, seed=0, txn_per_block=5, txpool=True)
+        cluster.enable_telemetry(sink=col.ingest, interval_s=0.5)
+        cluster.start()
+        cluster.run(600.0,
+                    stop_condition=lambda: cluster.min_height() >= 4)
+        for sn in cluster.nodes:
+            sn.node.stop()
+        cluster.flush_telemetry()
+        col.finalize()
+        return {
+            "compliance_ratio": round(col.slo.compliance_ratio, 6),
+            "false_positive_alerts": col.slo.fired_total,
+            "eval_ticks": col.slo.eval_ticks,
+            "envelopes": col.envelopes,
+            "heights": cluster.heights(),
+            "elapsed_s": round(time.monotonic() - t0, 2),
+        }
+    # analysis: allow-swallow(optional bench stage; a failed leg reports null)
+    except Exception:
+        return None
+
+
 def _spawn(kind: str, deadline: float, max_batch: int) -> subprocess.Popen:
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -602,6 +647,7 @@ def main() -> None:
     # parent so they ride every later line (including the fail line)
     coalesced = _coalesced_stage()
     pipeline = _pipeline_stage()
+    slo = _slo_stage()
 
     best: dict = {}      # kind -> best stage result for that backend
     # kind -> {batch(str): {p50_ms, p99_ms}} — every stage's tails, not
@@ -815,6 +861,21 @@ def main() -> None:
         line.update(_provenance())
         print(json.dumps(line), flush=True)
         _append_history(line)
+    if slo:
+        # parent-side stage: a calm sim through the live SLO engine —
+        # slo_false_positive_alerts is zero-tolerance-gated, the
+        # compliance ratio trends lower-is-worse
+        for metric, value, unit in (
+                ("slo_compliance_ratio",
+                 slo["compliance_ratio"], "ratio"),
+                ("slo_false_positive_alerts",
+                 slo["false_positive_alerts"], "count")):
+            line = {"metric": metric, "value": value, "unit": unit,
+                    "eval_ticks": slo["eval_ticks"],
+                    "envelopes": slo["envelopes"]}
+            line.update(_provenance())
+            print(json.dumps(line), flush=True)
+            _append_history(line)
 
     # trend the static-analysis counts alongside the perf series: one
     # findings_by_rule/unsuppressed_by_rule line per bench round, the

@@ -142,7 +142,7 @@ class _PendingWindow:
 
     __slots__ = ("batch", "keys", "reason", "t0", "rows", "results",
                  "staged", "probing", "diverted", "computed", "failure",
-                 "finished")
+                 "finished", "t_dispatch", "t_collect")
 
 
 class VerifierScheduler:
@@ -228,6 +228,14 @@ class VerifierScheduler:
         # optional consensus event journal (utils/journal.py), attached
         # by the first owning node; flush decisions land in its stream
         self.journal = None
+        # window flight recorder: every computed window's
+        # submit->place->stage->compute->collect->resolve lifecycle with
+        # lane/device attribution, in a bounded ring behind the
+        # thw_flight RPC and the observatory waterfall.  Wall-clock by
+        # nature (it measures real phase durations) and never journaled,
+        # so it stays outside the determinism contract.
+        self._flights: deque = deque(maxlen=256)
+        self._flight_seq = 0
         if len(self._lanes) > 1:
             from eges_tpu.utils.metrics import DEFAULT as metrics
             metrics.gauge("verifier.mesh_devices").set(len(self._lanes))
@@ -441,7 +449,20 @@ class VerifierScheduler:
                     if lane.stats["pipeline_windows"] else 0.0)
                 devices.append(d)
             out["devices"] = devices
+            out["flight_windows"] = self._flight_seq
         return out
+
+    def flights(self, limit: int = 0) -> list[dict]:
+        """Flight-recorder entries, oldest first (the ring keeps the
+        newest 256 windows); ``limit`` keeps only the newest N.  Each
+        entry is one window's lifecycle: phase timestamps
+        (``t_submit``/``t_begin``/``t_dispatch``/``t_collect``/
+        ``t_done``), phase durations, and lane/device attribution."""
+        with self._lock:
+            evs = list(self._flights)
+        if limit and limit > 0:
+            evs = evs[-limit:]
+        return [dict(f) for f in evs]
 
     # -- internals --------------------------------------------------------
 
@@ -805,6 +826,8 @@ class VerifierScheduler:
         p.computed = False
         p.failure = None
         p.finished = False
+        p.t_dispatch = None
+        p.t_collect = None
         # analysis: allow-determinism(batch latency instrumentation; dt/waited_ms are volatile-stripped)
         p.t0 = time.monotonic()
         try:
@@ -870,6 +893,11 @@ class VerifierScheduler:
                 p.computed = True
         except BaseException as exc:
             p.failure = exc
+        if p.t_dispatch is None:
+            # flight-recorder stamp: dispatch phase done (device call
+            # issued, inline compute complete, or host divert served)
+            # analysis: allow-determinism(flight recorder timestamps are wall-clock by design and never journaled)
+            p.t_dispatch = time.monotonic()
         return p
 
     def _finish_batch(self, lane: _DeviceLane, p: _PendingWindow) -> None:
@@ -897,6 +925,8 @@ class VerifierScheduler:
                     p.results = [self._host_recover(k) for k in keys]
                     p.diverted = True
                 p.computed = True
+                # analysis: allow-determinism(flight recorder timestamps are wall-clock by design and never journaled)
+                p.t_collect = time.monotonic()
             if p.failure is None and p.computed:
                 self._record_window(lane, p, mesh)
         except BaseException as exc:
@@ -932,11 +962,30 @@ class VerifierScheduler:
 
         batch, keys, rows = p.batch, p.keys, p.rows
         # analysis: allow-determinism(batch latency instrumentation; dt/waited_ms are volatile-stripped)
-        dt = time.monotonic() - p.t0
+        done = time.monotonic()
+        dt = done - p.t0
         pad = getattr(lane.target, "_pad", None) \
             or getattr(self._verifier, "_pad", None) or bucket_round
         bucket = pad(rows) if rows > 1 else 1  # diverted rows pad nothing
-        waited = p.t0 - min(t for _, (_, t) in batch)
+        oldest = min(t for _, (_, t) in batch)
+        waited = p.t0 - oldest
+        # one flight-recorder entry per computed window: lifecycle phase
+        # boundaries + lane attribution (the thw_flight RPC surface)
+        t_dispatch = p.t_dispatch if p.t_dispatch is not None else done
+        t_collect = p.t_collect if p.t_collect is not None else t_dispatch
+        flight = {
+            "device": lane.index, "rows": rows, "bucket": bucket,
+            "reason": p.reason, "diverted": bool(p.diverted),
+            "probing": bool(p.probing),
+            "pipelined": p.staged is not None,
+            "t_submit": round(oldest, 6), "t_begin": round(p.t0, 6),
+            "t_dispatch": round(t_dispatch, 6),
+            "t_collect": round(t_collect, 6), "t_done": round(done, 6),
+            "wait_ms": round(waited * 1e3, 3),
+            "stage_ms": round((t_dispatch - p.t0) * 1e3, 3),
+            "compute_ms": round((t_collect - t_dispatch) * 1e3, 3),
+            "total_ms": round((done - oldest) * 1e3, 3),
+        }
         with self._lock:
             for k, r in zip(keys, p.results):
                 self._cache_put(k, r)
@@ -951,6 +1000,10 @@ class VerifierScheduler:
                 lane.stats["straggler_diverts"] += 1
             windows = self._stats["pipeline_windows"]
             overlapped = self._stats["pipeline_overlapped"]
+            flight["window"] = self._flight_seq
+            self._flight_seq += 1
+            self._flights.append(flight)
+        metrics.counter("verifier.flight_windows").inc()
         for _, (_, t_sub) in batch:
             metrics.histogram("verifier.sched_queue_wait_seconds") \
                 .observe(p.t0 - t_sub)

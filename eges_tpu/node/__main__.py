@@ -71,6 +71,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(default), native C++ batches, or none")
     p.add_argument("--rpcPort", type=int, default=0,
                    help="JSON-RPC HTTP port (0 = disabled)")
+    p.add_argument("--collector", default="",
+                   help="host:port of a telemetry collector "
+                        "(harness/collector.py CollectorServer); the "
+                        "node pushes sampled metric deltas + its "
+                        "journal tail there every "
+                        "--telemetryInterval seconds")
+    p.add_argument("--telemetryInterval", type=float, default=5.0,
+                   help="seconds between telemetry pushes")
     p.add_argument("--netSecret", default="",
                    help="hex gossip-plane auth secret (default: derived "
                         "from the genesis hash)")
@@ -118,7 +126,9 @@ def main(argv=None) -> None:
                                if a),
         bootnodes=parse_peers(args.bootnodes),
         nat=args.nat,
-        verifier_mode=args.verifier)
+        verifier_mode=args.verifier,
+        collector_addr=args.collector,
+        telemetry_interval_s=args.telemetryInterval)
 
     loop = asyncio.new_event_loop()
     asyncio.set_event_loop(loop)

@@ -70,6 +70,15 @@ class ServiceConfig:
     #                                    discovery announces: none /
     #                                    auto / extip:<ip> (ref:
     #                                    p2p/nat/nat.go Parse)
+    collector_addr: str = ""           # host:port of a telemetry
+    #                                    collector (harness/collector.py
+    #                                    CollectorServer); enables the
+    #                                    push plane: journal tail +
+    #                                    periodic telemetry_sample
+    #                                    envelopes over TCP, replacing
+    #                                    per-node /metrics polling for
+    #                                    cluster views
+    telemetry_interval_s: float = 5.0  # push cadence when enabled
 
 
 def load_genesis_config(path: str) -> tuple[ChainGeecConfig, dict]:
@@ -79,6 +88,105 @@ def load_genesis_config(path: str) -> tuple[ChainGeecConfig, dict]:
         doc = json.load(f)
     thw = doc.get("config", {}).get("thw", {})
     return ChainGeecConfig.from_json(thw), doc
+
+
+class _TelemetryPusher:
+    """Push plane for a real node: samples the process metrics registry
+    on the wall clock, tails the consensus journal through its
+    ``on_record`` tap, and ships newline-JSON envelopes to a
+    ``harness/collector.py`` CollectorServer.  A node-local
+    :class:`harness.slo.SLOEngine` rides along (attached as
+    ``node.slo_engine``) so the ``thw_health`` RPC surfaces live alert
+    states without a collector round-trip.
+
+    Delivery is best-effort telemetry, not a durability channel: when
+    the collector is unreachable the envelope for that tick is dropped
+    and the connection is retried on the next one.
+    """
+
+    def __init__(self, node, addr: tuple[str, int], *,
+                 interval_s: float = 5.0, log=None):
+        import time as _t
+        from collections import deque
+
+        from eges_tpu.utils.metrics import DEFAULT as registry
+        from eges_tpu.utils.timeseries import RegistrySampler
+        self.node = node
+        self.addr = addr
+        self.interval_s = interval_s
+        self.log = log
+        self.sampler = RegistrySampler(registry, clock=_t.time)
+        # journal tail: the tap enqueues every event as it is recorded,
+        # so a drain (journal.dump) between ticks cannot lose envelopes
+        self._pending = deque(maxlen=8192)
+        self._prev_tap = node.journal.on_record
+        node.journal.on_record = self._tap
+        self._sock = None
+        self.engine = None
+        try:
+            from harness.slo import SLOEngine
+            self.engine = SLOEngine()
+            node.slo_engine = self.engine
+        except ImportError:
+            self.engine = None  # deployed without the harness package
+
+    def _tap(self, ev: dict) -> None:
+        self._pending.append(ev)
+        prev = self._prev_tap
+        if prev is not None:
+            prev(ev)
+
+    def tick(self) -> None:
+        """Sample, journal the sample, evaluate the local SLO engine,
+        and push the journal tail as one envelope."""
+        payload = self.sampler.sample()
+        sample = self.node.journal.record(
+            "telemetry_sample", step=self.sampler.steps, metrics=payload)
+        if sample is None:
+            return  # journal disabled (restart replay)
+        evs = []
+        while self._pending:
+            evs.append(self._pending.popleft())
+        if self.engine is not None:
+            for ev in evs:
+                self.engine.ingest(ev)
+            self.engine.evaluate(float(sample.get("ts", 0.0)))
+        self._send({"node": str(sample.get("node", "?")),
+                    "ts": sample.get("ts", 0.0), "events": evs})
+
+    def _send(self, envelope: dict) -> None:
+        import socket as _socket
+        data = json.dumps(envelope).encode() + b"\n"
+        try:
+            if self._sock is None:
+                self._sock = _socket.create_connection(
+                    self.addr, timeout=2.0)
+                self._sock.settimeout(2.0)
+            self._sock.sendall(data)
+        except OSError:
+            # collector down/unreachable: drop this tick's envelope and
+            # reconnect on the next one
+            sock, self._sock = self._sock, None
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass  # already torn down
+            if self.log is not None:
+                self.log.geec("telemetry push failed",
+                              addr=f"{self.addr[0]}:{self.addr[1]}")
+
+    def close(self) -> None:
+        # one final push so the collector sees the tail, then restore
+        # the tap chain and tear the socket down
+        self.tick()
+        self.node.journal.on_record = self._prev_tap
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass  # already closed
 
 
 class NodeService:
@@ -267,6 +375,13 @@ class NodeService:
                                  txpool=self.txpool,
                                  bind_ip=cfg.gossip_ip, port=cfg.rpc_port)
 
+        self._telemetry = None
+        if cfg.collector_addr:
+            host, _, port = cfg.collector_addr.rpartition(":")
+            self._telemetry = _TelemetryPusher(
+                self.node, (host or "127.0.0.1", int(port)),
+                interval_s=cfg.telemetry_interval_s, log=self.log)
+
         self._height_task = None
 
     def _node_log(self, kind: str, **kw) -> None:
@@ -334,6 +449,7 @@ class NodeService:
     async def _height_loop(self) -> None:
         last = -1
         last_metrics = 0.0
+        last_push = 0.0
         while True:
             h = self.chain.height()
             if h != last:
@@ -344,6 +460,11 @@ class NodeService:
                               fake_txns=len(blk.fake_txns))
                 last = h
             import time as _time
+            if self._telemetry is not None and \
+                    _time.monotonic() - last_push > \
+                    self._telemetry.interval_s:
+                last_push = _time.monotonic()
+                self._telemetry.tick()
             if _time.monotonic() - last_metrics > 30.0:
                 last_metrics = _time.monotonic()
                 from eges_tpu.utils.metrics import DEFAULT as metrics
@@ -377,6 +498,8 @@ class NodeService:
     def close(self) -> None:
         if self._height_task is not None:
             self._height_task.cancel()
+        if self._telemetry is not None:
+            self._telemetry.close()
         from eges_tpu.utils import tracing
         try:
             tracing.DEFAULT.dump(

@@ -47,9 +47,9 @@ RPC_METHODS = frozenset({
     "eth_getTransactionCount", "eth_getTransactionReceipt",
     "eth_newBlockFilter", "eth_newFilter", "eth_sendRawTransaction",
     "eth_subscribe", "eth_uninstallFilter", "eth_unsubscribe",
-    "net_version", "thw_health", "thw_journal", "thw_membership",
-    "thw_metrics", "thw_pendingGeecTxns", "thw_register", "thw_status",
-    "thw_traces", "web3_clientVersion",
+    "net_version", "thw_flight", "thw_health", "thw_journal",
+    "thw_membership", "thw_metrics", "thw_pendingGeecTxns",
+    "thw_register", "thw_status", "thw_traces", "web3_clientVersion",
 })
 
 
@@ -329,8 +329,13 @@ class RpcServer:
         if method == "thw_health":
             return self._health()
         if method == "thw_journal":
-            # consensus event journal, chronological; params:
-            # [] | [limit] | [{"limit": n, "since": seq}]
+            # consensus event journal, chronological, with the same
+            # bounded pagination thw_traces has; params: [] | [limit] |
+            # [{"limit": n, "since_seq": seq}].  ``limit`` is clamped to
+            # [1, 4096] (matching thw_traces) so a long-running node can
+            # never ship its whole ring in one reply; ``since_seq`` is
+            # the cursor for incremental polling (events with
+            # seq >= since_seq).  ``since`` stays as a legacy alias.
             if self.node is None:
                 raise RpcError(-32000, "no consensus node")
             limit, since = 1024, 0
@@ -338,11 +343,31 @@ class RpcServer:
                 p = params[0]
                 if isinstance(p, dict):
                     limit = int(p.get("limit", limit))
-                    since = int(p.get("since", since))
+                    since = int(p.get("since_seq", p.get("since", since)))
                 else:
                     limit = int(p)
-            limit = max(1, min(limit, 8192))
+            limit = max(1, min(limit, 4096))
             return self.node.journal.events(limit=limit, since=since)
+        if method == "thw_flight":
+            # verifier window flight recorder (crypto/scheduler.py),
+            # NEWEST FIRST like thw_traces; params: [] | [limit] |
+            # [{"limit": n}].  Empty when the chain has no scheduler
+            # (host-fallback verifier) or no window flew yet.
+            limit = 256
+            if params:
+                p = params[0]
+                if isinstance(p, dict):
+                    limit = int(p.get("limit", limit))
+                else:
+                    limit = int(p)
+            limit = max(1, min(limit, 4096))
+            recorder = getattr(self.chain, "verifier", None)
+            flights = getattr(recorder, "flights", None)
+            if not callable(flights):
+                return []
+            out = flights(limit=limit)
+            out.reverse()
+            return out
         if method.startswith("debug_"):
             return self._debug(method, params)
         raise RpcError(-32601, f"method {method} not found")
@@ -392,6 +417,13 @@ class RpcServer:
             "lastCommitAge": round(last_commit_age, 6),
             "stalled": last_commit_age > 3 * node.cfg.block_timeout_s,
             "journal": node.journal.stats(),
+            # latest SLO alert state per objective from the node-local
+            # burn-rate engine (harness/slo.py), attached by the service
+            # when telemetry push is enabled; {} when not running
+            "sloAlerts": (engine.alert_states()
+                          if (engine := getattr(node, "slo_engine",
+                                                None)) is not None
+                          else {}),
         }
 
     # -- read-only EVM execution (ref: internal/ethapi/api.go Call) -------

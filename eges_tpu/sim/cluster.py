@@ -90,6 +90,16 @@ class SimCluster:
         # chaos harness attaches its fault-injector journal here; it
         # rides journals() under the synthetic "faults" node name
         self.fault_journal = None
+        # telemetry plane (enable_telemetry): the sampler's journal
+        # rides journals() as "telemetry"; a harness-side SLO engine's
+        # alert journal attaches to slo_journal and rides as "slo", so
+        # chaos canonical dumps byte-compare the alert stream too
+        self.telemetry_journal = None
+        self.slo_journal = None
+        self._telemetry_sampler = None
+        self._telemetry_sink = None
+        self._telemetry_interval = 0.0
+        self._telemetry_cursor: dict[str, int] = {}
         for i in range(n_nodes):
             name = f"node{i}"
             ncfg = NodeConfig(
@@ -220,6 +230,66 @@ class SimCluster:
         corrupted/duplicated/reordered) for the cluster report."""
         return dict(self.net.stats)
 
+    # -- telemetry push channel (utils/timeseries.py) -------------------
+
+    def enable_telemetry(self, *, sink=None, interval_s: float = 5.0,
+                         capacity: int = 512):
+        """Turn on the periodic registry sampler and (optionally) the
+        push channel to a collector.
+
+        Every ``interval_s`` of VIRTUAL time one registry sample lands
+        as a ``telemetry_sample`` event in the cluster's "telemetry"
+        journal (the process-wide registry is shared by every sim node,
+        so the cluster samples once — the per-process analogue of a real
+        node's sampler), and ``sink`` — typically
+        ``harness.collector.ClusterCollector.ingest`` — receives one
+        envelope per journal stream carrying the events recorded since
+        the previous tick.  Delivery runs synchronously on the sim
+        clock: the deterministic stand-in for the socket push channel
+        real nodes use (``node/service.py``).
+
+        Returns the telemetry journal.
+        """
+        from eges_tpu.utils.journal import Journal
+        from eges_tpu.utils.metrics import DEFAULT
+        from eges_tpu.utils.timeseries import RegistrySampler
+
+        self.telemetry_journal = Journal("telemetry", clock=self.clock.now)
+        self._telemetry_sampler = RegistrySampler(
+            DEFAULT, clock=self.clock.now, capacity=capacity)
+        self._telemetry_sink = sink
+        self._telemetry_interval = interval_s
+        self.clock.call_later(interval_s, self._telemetry_tick)
+        return self.telemetry_journal
+
+    def _telemetry_tick(self, reschedule: bool = True) -> None:
+        now = self.clock.now()
+        payload = self._telemetry_sampler.sample()
+        self.telemetry_journal.record(
+            "telemetry_sample", step=self._telemetry_sampler.steps,
+            metrics=payload)
+        sink = self._telemetry_sink
+        if sink is not None:
+            streams = self.journals()
+            streams.pop("slo", None)  # the collector's own output
+            for name in sorted(streams):
+                evs = streams[name]
+                cursor = self._telemetry_cursor.get(name, 0)
+                fresh = evs[cursor:]
+                if fresh:
+                    sink({"node": name, "ts": now, "events": fresh})
+                self._telemetry_cursor[name] = len(evs)
+        if reschedule:
+            self.clock.call_later(self._telemetry_interval,
+                                  self._telemetry_tick)
+
+    def flush_telemetry(self) -> None:
+        """One final sample + push outside the periodic schedule, so a
+        collector holds every event the journals hold (the round-trip
+        test's precondition).  No-op when telemetry is off."""
+        if self._telemetry_sampler is not None:
+            self._telemetry_tick(reschedule=False)
+
     def journals(self) -> dict[str, list[dict]]:
         """Per-node consensus event journals, keyed by sim node name —
         the live-poll source ``harness/observatory.py`` merges (the
@@ -233,4 +303,8 @@ class SimCluster:
                             + sn.node.journal.events())
         if self.fault_journal is not None:
             out["faults"] = self.fault_journal.events()
+        if self.telemetry_journal is not None:
+            out["telemetry"] = self.telemetry_journal.events()
+        if self.slo_journal is not None:
+            out["slo"] = self.slo_journal.events()
         return out

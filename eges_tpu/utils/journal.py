@@ -75,6 +75,14 @@ EVENT_TYPES = frozenset({
     # prewarm pass over the artifact store with load-vs-compile split
     # timing so the observatory can report cold-start time
     "verifier_aot_load",
+    # telemetry plane (utils/timeseries.py + harness/collector.py): one
+    # periodic registry sample — counters as deltas, gauges/percentiles
+    # as points — riding the push channel to the cluster collector
+    "telemetry_sample",
+    # SLO burn-rate engine (harness/slo.py): alert state-machine
+    # transitions, journaled so chaos scenarios assert on them and
+    # --check-determinism byte-compares the alert stream
+    "slo_pending", "slo_firing", "slo_resolved",
 })
 
 # The registered ``_breakdown`` phase vocabulary (consensus/node.py);

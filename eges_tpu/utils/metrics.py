@@ -74,7 +74,95 @@ METRIC_FAMILIES = frozenset({
     # of lane windows whose H2D staging overlapped the previous
     # window's compute/D2H
     "verifier.pipeline_overlap_ratio",
+    # crypto/scheduler.py — window flight recorder (bounded lifecycle
+    # ring behind the thw_flight RPC)
+    "verifier.flight_windows",
+    # utils/timeseries.py + harness/collector.py — telemetry plane
+    "telemetry.envelopes", "telemetry.samples",
+    # harness/slo.py — burn-rate SLO engine
+    "slo.alerts_firing", "slo.transitions",
 })
+
+# One-line help string per registered family, emitted as ``# HELP``
+# lines by ``prometheus_text`` and kept exhaustive by the vocabulary
+# checker (``python -m harness.analysis``): a family registered above
+# without a help entry here fails the gate.
+METRIC_HELP = {
+    "chain.bad_blocks": "Blocks rejected by validation on insert.",
+    "chain.blocks": "Canonical blocks inserted into the chain.",
+    "chain.fastsync_adoptions": "Fast-sync snapshot adoptions.",
+    "chain.geec_txns": "Geec control-plane transactions inserted.",
+    "chain.height": "Current canonical chain height.",
+    "chain.insert": "Block insert attempts.",
+    "chain.insert_seconds": "Block insert latency in seconds.",
+    "chain.txns": "Payload transactions inserted with blocks.",
+    "consensus.deferred_depth": "Events parked on the deferred queue.",
+    "consensus.elected": "Elections won by this node.",
+    "consensus.forced_empties": "Empty blocks forced by round timeout.",
+    "consensus.phase_seconds": "Consensus phase duration in seconds.",
+    "consensus.sealed": "Blocks sealed by this node.",
+    "membership.min_ttl": "Minimum TTL across registered members.",
+    "membership.size": "Registered committee members.",
+    "net.dead_letters": "Messages dropped with no deliverable peer.",
+    "net.direct_bytes": "Bytes sent over the direct (point-to-point) plane.",
+    "net.direct_msgs": "Messages sent over the direct plane.",
+    "net.gossip_bytes": "Bytes sent over the gossip plane.",
+    "net.gossip_msgs": "Messages sent over the gossip plane.",
+    "net.peer_count": "Currently connected peers.",
+    "sim.faults_injected": "Scripted faults injected by the chaos harness.",
+    "txpool.pending": "Transactions pending in the pool.",
+    "verifier.batches": "Signature verification batches dispatched.",
+    "verifier.compile_cache_hits": "Verifier JIT compile-cache hits.",
+    "verifier.compile_cache_misses": "Verifier JIT compile-cache misses.",
+    "verifier.d2h_seconds": "Device-to-host transfer seconds.",
+    "verifier.device": "Accelerator devices visible to the verifier.",
+    "verifier.device_name": "Accelerator device platform/name label.",
+    "verifier.device_seconds": "On-device compute seconds per batch.",
+    "verifier.h2d_seconds": "Host-to-device transfer seconds.",
+    "verifier.host_rows": "Rows verified on the host fallback path.",
+    "verifier.native": "Whether the native host verifier is loaded.",
+    "verifier.native_batches": "Batches served by the native host verifier.",
+    "verifier.native_rows": "Rows served by the native host verifier.",
+    "verifier.pad_waste": "Rows of padding added to reach bucket sizes.",
+    "verifier.padded_rows": "Total rows after bucket padding.",
+    "verifier.rows": "Signature rows submitted for verification.",
+    "verifier.cache_hits": "Sender-recovery cache hits.",
+    "verifier.cache_misses": "Sender-recovery cache misses.",
+    "verifier.prewarmed_buckets": "Buckets compiled ahead of traffic.",
+    "verifier.sched_batch_rows": "Rows per coalesced scheduler window.",
+    "verifier.sched_occupancy": "Dispatched rows over padded bucket rows.",
+    "verifier.sched_queue_wait_seconds":
+        "Seconds a submission waited in the coalescing window.",
+    "verifier.singleton_batches": "Single-row windows diverted to the host.",
+    "verifier.breaker_probes": "Half-open circuit-breaker probe dispatches.",
+    "verifier.breaker_state": "Circuit breaker state (0 closed, 1 open).",
+    "verifier.breaker_trips": "Circuit breaker open transitions.",
+    "verifier.device_errors": "Device dispatch failures.",
+    "verifier.mesh_devices": "Device lanes in the mesh dispatcher.",
+    "verifier.mesh_occupancy": "Per-device window occupancy.",
+    "verifier.mesh_queue_depth": "Windows queued per device lane.",
+    "verifier.mesh_rows": "Rows served per device lane.",
+    "verifier.mesh_straggler_diverts":
+        "Lane windows rescued to the host by the straggler policy.",
+    "verifier.mesh_window_splits": "Windows split across device lanes.",
+    "verifier.aot_compiles": "AOT executables compiled (cache miss).",
+    "verifier.aot_export_seconds": "AOT artifact export seconds.",
+    "verifier.aot_load_errors": "AOT artifact load failures.",
+    "verifier.aot_load_seconds": "AOT artifact deserialize seconds.",
+    "verifier.aot_loads": "AOT executables loaded from the artifact store.",
+    "verifier.aot_saves": "AOT executables serialized to the artifact store.",
+    "verifier.cold_start_seconds":
+        "Service cold start: verifier ready after process start.",
+    "verifier.compile_cache_errors": "Persistent compile-cache failures.",
+    "verifier.pipeline_overlap_ratio":
+        "Lane windows whose staging overlapped the previous compute.",
+    "verifier.flight_windows":
+        "Windows recorded by the lifecycle flight recorder.",
+    "telemetry.envelopes": "Telemetry envelopes ingested by the collector.",
+    "telemetry.samples": "Registry samples taken by the telemetry sampler.",
+    "slo.alerts_firing": "SLO objectives currently in the firing state.",
+    "slo.transitions": "SLO alert state-machine transitions journaled.",
+}
 
 
 def percentile(sorted_vals, q: float) -> float:
@@ -355,7 +443,17 @@ def prometheus_text(registry: "Registry | None" = None) -> str:
     for fam in sorted(families):
         members = families[fam]
         kind = type(members[0][2])
+        # ``# HELP`` text keyed by the ORIGINAL (dotted) family name of
+        # the first member; escaping per exposition format 0.0.4
+        help_text = METRIC_HELP.get(_split_labels(members[0][0])[0], "")
+        help_text = help_text.replace("\\", "\\\\").replace("\n", "\\n")
+
+        def _help(suffix: str = "") -> None:
+            if help_text:
+                lines.append(f"# HELP {fam}{suffix} {help_text}")
+
         if kind is Counter:
+            _help()
             lines.append(f"# TYPE {fam} counter")
             for _, labels, m in members:
                 lines.append(f"{fam}{_fmt_labels(labels)} "
@@ -366,31 +464,37 @@ def prometheus_text(registry: "Registry | None" = None) -> str:
             info = [(lb, m) for _, lb, m in members
                     if not isinstance(m.value, (int, float))]
             if numeric:
+                _help()
                 lines.append(f"# TYPE {fam} gauge")
                 for labels, m in numeric:
                     lines.append(f"{fam}{_fmt_labels(labels)} "
                                  f"{_fmt_value(m.value)}")
             if info:
+                _help("_info")
                 lines.append(f"# TYPE {fam}_info gauge")
                 for labels, m in info:
                     lb = dict(labels)
                     lb["value"] = str(m.value)
                     lines.append(f"{fam}_info{_fmt_labels(lb)} 1")
         elif kind is Meter:
+            _help("_total")
             lines.append(f"# TYPE {fam}_total counter")
             for _, labels, m in members:
                 lines.append(f"{fam}_total{_fmt_labels(labels)} {m.count}")
+            _help("_rate_1m")
             lines.append(f"# TYPE {fam}_rate_1m gauge")
             for _, labels, m in members:
                 lines.append(f"{fam}_rate_1m{_fmt_labels(labels)} "
                              f"{_fmt_value(m.rate_1m)}")
         elif kind is Timer:
+            _help()
             lines.append(f"# TYPE {fam} summary")
             for _, labels, m in members:
                 lb = _fmt_labels(labels)
                 lines.append(f"{fam}_count{lb} {m.count}")
                 lines.append(f"{fam}_sum{lb} {_fmt_value(m.total)}")
         elif kind is Histogram:
+            _help()
             lines.append(f"# TYPE {fam} summary")
             for _, labels, m in members:
                 ps = m.percentiles()

@@ -13,8 +13,11 @@ truth:
   (including the leading constant of f-string names and both arms of
   conditional names; the family is the part before the ``;`` label
   separator) must be registered, each family must be used with exactly
-  one metric kind, and registered families that no emit site uses are
-  flagged as stale;
+  one metric kind, registered families that no emit site uses are
+  flagged as stale, and every registered family must carry operator
+  help text in ``METRIC_HELP`` (the ``# HELP`` source for
+  ``prometheus_text()``) — entries for unregistered families are
+  flagged too;
 * RPC methods — ``RPC_METHODS`` in ``eges_tpu/rpc/server.py``; every
   ``method == "<lit>"`` / ``method in (...)`` dispatch comparison must
   be registered and every registered method must have a dispatch site
@@ -53,6 +56,26 @@ def _str_consts(node: ast.expr) -> list[str]:
 
 def _family(name: str) -> str:
     return name.split(";", 1)[0]
+
+
+def _dict_literal_keys(project: Project, relpath: str,
+                       name: str) -> frozenset | None:
+    """Key set of a module-level ``NAME = {...}`` dict-literal
+    assignment, evaluated without importing the module (the dict
+    counterpart of ``Project.frozenset_literal``)."""
+    f = project.file(relpath)
+    if f is None:
+        return None
+    for node in f.tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == name
+                        for t in node.targets)
+                and isinstance(node.value, ast.Dict)):
+            try:
+                return frozenset(ast.literal_eval(node.value))
+            except ValueError:
+                return None
+    return None
 
 
 def _recv_is_metrics(node: ast.expr) -> bool:
@@ -167,6 +190,33 @@ def check(project: Project) -> list[Finding]:
                 rule="vocabulary", path=METRICS_PATH, line=1, symbol=fam,
                 message=f'metric family "{fam}" is registered in '
                         "METRIC_FAMILIES but never emitted"))
+
+    # every registered family carries operator help text — the # HELP
+    # source prometheus_text() renders; entries for unregistered
+    # families are drift the other way
+    help_keys = _dict_literal_keys(project, METRICS_PATH, "METRIC_HELP")
+    if families is not None:
+        if help_keys is None:
+            if project.file(METRICS_PATH) is not None:
+                findings.append(Finding(
+                    rule="vocabulary", path=METRICS_PATH, line=1,
+                    symbol="METRIC_HELP",
+                    message="METRIC_HELP dict literal not found — every "
+                            "metric family needs # HELP text"))
+        else:
+            for fam in sorted(families - help_keys):
+                findings.append(Finding(
+                    rule="vocabulary", path=METRICS_PATH, line=1,
+                    symbol=fam,
+                    message=f'metric family "{fam}" has no METRIC_HELP '
+                            "entry — prometheus_text() would emit an "
+                            "empty # HELP line"))
+            for fam in sorted(help_keys - families):
+                findings.append(Finding(
+                    rule="vocabulary", path=METRICS_PATH, line=1,
+                    symbol=fam,
+                    message=f'METRIC_HELP entry "{fam}" is not a '
+                            "registered metric family"))
     if rpc_methods is not None:
         for meth in sorted(rpc_methods - set(dispatch_methods)):
             findings.append(Finding(

@@ -60,6 +60,11 @@ VOLATILE_KEYS = {
     # real load/compile durations of the AOT artifact prewarm — how
     # long the warm took is wall-clock, WHAT was warmed is protocol
     "verifier_aot_load": ("load_s", "compile_s", "cold_start_s"),
+    # the sampled registry payload mixes virtual-time counters with
+    # wall-clock histograms (timer means, percentile points) — the
+    # sample's EXISTENCE and step number are protocol, its values are
+    # measurements
+    "telemetry_sample": ("metrics",),
 }
 
 
@@ -142,6 +147,38 @@ def _finish(name: str, seed: int, cluster, extra_blocks: int,
 
 def _names(cluster) -> list[str]:
     return [sn.name for sn in cluster.nodes]
+
+
+def _enable_slo(cluster, interval_s: float = 5.0):
+    """Wire the live telemetry plane into a scenario: the cluster pushes
+    journal-tail envelopes on the virtual clock into a
+    :class:`~harness.collector.ClusterCollector`, whose burn-rate SLO
+    engine journals alert transitions.  The engine's journal is attached
+    as the cluster's ``slo`` stream so alerts land in the merged dump
+    (and therefore in the ``--check-determinism`` byte comparison)."""
+    from harness.collector import ClusterCollector
+    col = ClusterCollector()
+    cluster.enable_telemetry(sink=col.ingest, interval_s=interval_s)
+    cluster.slo_journal = col.slo.journal
+    return col
+
+
+def _slo_checks(res: dict, cluster, col, checks_fn) -> dict:
+    """Shared tail for SLO-enabled scenarios: flush the last telemetry
+    tick, finalize the collector, re-collect journals (so the flush's
+    sample + any final transitions are in the dump), and merge the
+    scenario's alert checks.  ``checks_fn`` is a thunk so the checks
+    read collector state AFTER the flush."""
+    cluster.flush_telemetry()
+    col.finalize()
+    checks = checks_fn()
+    res["journals"] = cluster.journals()
+    res["slo"] = {"alert_states": col.slo.alert_states(),
+                  "alerts_fired": col.slo.fired_total,
+                  "compliance_ratio": round(col.slo.compliance_ratio, 6)}
+    res["checks"].update(checks)
+    res["ok"] = bool(res["ok"] and all(checks.values()))
+    return res
 
 
 # -- scenarios ------------------------------------------------------------
@@ -316,11 +353,27 @@ def _scn_verifier_blackout(seed: int, fast: bool) -> dict:
 
     sched.failure_hook = _dead_device
     inj = FaultInjector(cluster)     # journals the (empty) fault plan
+    col = _enable_slo(cluster)
     cluster.start()
     blocks = 4 if fast else 6
     cluster.run(600.0,
                 stop_condition=lambda: cluster.min_height() >= blocks)
+    # snapshot BEFORE the heal: the blackout-phase invariants
+    # (breaker open throughout, every window diverted) are judged here
     stats = sched.stats()
+    # heal the device: the next half-open probe succeeds, closes the
+    # breaker, and the breaker_open SLO must burn down and resolve
+    sched.failure_hook = None
+
+    def _slo_cycled() -> bool:
+        evs = col.slo.journal.events()
+        return (any(e["type"] == "slo_firing"
+                    and e["objective"] == "breaker_open" for e in evs)
+                and any(e["type"] == "slo_resolved"
+                        and e["objective"] == "breaker_open"
+                        for e in evs))
+
+    cluster.run(600.0, stop_condition=_slo_cycled)
     res = _finish("verifier_blackout", seed, cluster,
                   extra_blocks=2, bound_s=240.0,
                   checks={"breaker_tripped": stats["breaker_trips"] >= 1,
@@ -329,6 +382,14 @@ def _scn_verifier_blackout(seed: int, fast: bool) -> dict:
                           "windows_host_diverted":
                               stats["breaker_diverted"] > 0
                               or stats["host_diverted"] > 0})
+    res = _slo_checks(res, cluster, col, lambda: {
+        "slo_breaker_fired": any(
+            e["type"] == "slo_firing" and e["objective"] == "breaker_open"
+            for e in col.slo.alerts()),
+        "slo_breaker_resolved": any(
+            e["type"] == "slo_resolved"
+            and e["objective"] == "breaker_open"
+            for e in col.slo.alerts())})
     sched.close()
     res["verifier"] = sched.stats()
     return res
@@ -365,6 +426,11 @@ def _scn_mesh_device_blackout(seed: int, fast: bool) -> dict:
     devs = stats["devices"]
     dead = devs[victim]
     healthy = [d for d in devs if d["device"] != victim]
+    # the window flight recorder must attribute the straggling to the
+    # victim lane: its breaker-diverted windows mark it (the thw_flight
+    # waterfall renders the same attribution)
+    flights = sched.flights()
+    stragglers = observatory.flight_straggler_lanes(flights)
     res = _finish("mesh_device_blackout", seed, cluster,
                   extra_blocks=2, bound_s=240.0,
                   checks={
@@ -378,9 +444,36 @@ def _scn_mesh_device_blackout(seed: int, fast: bool) -> dict:
                           and d["breaker"] == "closed" for d in healthy),
                       "healthy_lanes_served": any(
                           d["rows"] > 0 for d in healthy),
+                      "flight_straggler_attributed":
+                          victim in stragglers,
                   })
     sched.close()
     res["verifier"] = sched.stats()
+    res["flight_stragglers"] = stragglers
+    return res
+
+
+def _scn_calm_baseline(seed: int, fast: bool) -> dict:
+    """No faults at all: a healthy cluster with the live telemetry plane
+    enabled must fire ZERO SLO alerts — the false-positive guard for the
+    burn-rate thresholds (and the ``slo_false_positive_alerts`` bench
+    metric's scenario twin)."""
+    cluster = SimCluster(4, seed=seed)
+    inj = FaultInjector(cluster)     # journals the (empty) fault plan
+    # sub-second cadence: healthy sims commit fast in virtual time, and
+    # the false-positive guard needs many evaluation ticks, not one
+    col = _enable_slo(cluster, interval_s=0.5)
+    cluster.start()
+    blocks = 4 if fast else 8
+    cluster.run(600.0,
+                stop_condition=lambda: cluster.min_height() >= blocks)
+    res = _finish("calm_baseline", seed, cluster,
+                  extra_blocks=2, bound_s=240.0, checks={})
+    res = _slo_checks(res, cluster, col, lambda: {
+        "zero_alerts_fired": col.slo.fired_total == 0,
+        "no_transitions_journaled": not col.slo.alerts(),
+        "fully_compliant": col.slo.compliance_ratio == 1.0,
+        "samples_flowed": col.envelopes > 0})
     return res
 
 
@@ -419,6 +512,7 @@ SCENARIOS = {
     "corruption_flood": _scn_corruption_flood,
     "verifier_blackout": _scn_verifier_blackout,
     "mesh_device_blackout": _scn_mesh_device_blackout,
+    "calm_baseline": _scn_calm_baseline,
     "combo": _scn_combo,
 }
 
@@ -465,6 +559,16 @@ def render_result(res: dict) -> str:
                        vs["breaker"], vs["breaker_trips"],
                        vs["breaker_probes"], vs["breaker_diverted"],
                        vs["host_diverted"], vs["batches"]))
+    if "slo" in res:
+        s = res["slo"]
+        out.append("  slo: fired=%d compliance=%.4f  %s" % (
+            s["alerts_fired"], s["compliance_ratio"],
+            "  ".join("%s=%s" % (k, v)
+                      for k, v in sorted(s["alert_states"].items()))))
+    if "flight_stragglers" in res:
+        out.append("  flight stragglers: %s" % (
+            ", ".join(str(d) for d in res["flight_stragglers"])
+            or "-"))
     return "\n".join(out)
 
 

@@ -12,7 +12,12 @@ dispatch regression cannot hide behind a healthy single-chip number.
 Metrics in ``LOWER_IS_BETTER`` (``cold_start_seconds`` — the AOT
 artifact store's deliverable) gate in the opposite direction: a RISE
 past the threshold fails, so a broken artifact store cannot hide
-behind a healthy steady-state throughput number.
+behind a healthy steady-state throughput number.  Metrics in
+``ZERO_TOLERANCE`` (``slo_false_positive_alerts`` — alerts fired by
+the burn-rate SLO engine on a calm, fault-free sim) gate on the
+newest value alone: it must be exactly 0, even with a single history
+entry — one false page on a healthy cluster means the thresholds or
+the engine regressed.
 
 ``--analysis [analysis_history.jsonl]`` gates the static-analysis
 trend instead: the newest ``unsuppressed_by_rule`` line (appended by
@@ -45,6 +50,10 @@ _DEFAULT_HISTORY = os.path.join(
 # metrics where smaller is the win (durations): the gate fails on a
 # RISE past the threshold instead of a drop
 LOWER_IS_BETTER = frozenset({"cold_start_seconds"})
+
+# metrics whose newest value must be EXACTLY zero — no threshold, no
+# previous-entry requirement: any count at all is a failure
+ZERO_TOLERANCE = frozenset({"slo_false_positive_alerts"})
 
 
 def load_history(path: str) -> list[dict]:
@@ -80,6 +89,17 @@ def check(entries: list[dict], threshold: float = 0.20) -> tuple[int, str]:
     lines, code = [], 0
     for name in sorted(groups):
         series = groups[name]
+        if name in ZERO_TOLERANCE:
+            lv = float(series[-1]["value"])
+            if lv != 0.0:
+                code = 1
+                lines.append("REGRESSION [%s]: newest value %g must be "
+                             "exactly 0 (zero-tolerance metric)"
+                             % (name, lv))
+            else:
+                lines.append("ok [%s]: newest value 0 (zero-tolerance "
+                             "metric)" % name)
+            continue
         if len(series) < 2:
             lines.append("ok [%s]: %d comparable entr%s — nothing to "
                          "compare" % (name, len(series),

@@ -50,7 +50,10 @@ CONSUMED = ("election_started", "election_won", "election_lost",
             "fault_crash", "fault_restart", "fault_partition",
             "fault_heal", "fault_link", "fault_net", "fault_skew",
             "fault_trigger", "fault_breaker", "verifier_mesh_dispatch",
-            "verifier_aot_load")
+            "verifier_aot_load", "telemetry_sample",
+            "slo_pending", "slo_firing", "slo_resolved")
+
+_SLO = ("slo_pending", "slo_firing", "slo_resolved")
 
 _TIMELINE = ("election_started", "election_won", "election_lost",
              "version_bump")
@@ -112,11 +115,26 @@ def summarize(by_node: dict[str, list[dict]],
     # node -> AOT prewarm accounting (service start + sim restarts):
     # how much of each node's cold start was artifact load vs compile
     aot: dict[str, dict] = {}
+    # SLO alert transitions (harness/slo.py state machine output) and
+    # telemetry sampler heartbeats, merged across streams
+    slo_alerts: list[tuple] = []
+    telemetry_samples: dict[str, int] = {}
 
     for name in sorted(by_node):
         for ev in by_node[name]:
             typ = ev.get("type")
             blk = ev.get("blk")
+            if typ == "telemetry_sample":
+                telemetry_samples[name] = telemetry_samples.get(name, 0) + 1
+                continue
+            if typ in _SLO:
+                slo_alerts.append((
+                    round(float(ev.get("ts", 0.0)), 6),
+                    int(ev.get("seq", 0)), name, typ,
+                    str(ev.get("objective", "?")),
+                    float(ev.get("burn_fast", 0.0)),
+                    float(ev.get("burn_slow", 0.0))))
+                continue
             if typ == "verifier_aot_load":
                 d = aot.setdefault(name, {
                     "events": 0, "aot_loads": 0, "aot_compiles": 0,
@@ -230,7 +248,86 @@ def summarize(by_node: dict[str, list[dict]],
                    "compile_s": round(d["compile_s"], 3),
                    "cold_start_s": round(d["cold_start_s"], 3)}
             for name, d in sorted(aot.items())},
+        "slo_alerts": [
+            {"ts": ts, "node": name, "type": typ, "objective": obj,
+             "burn_fast": fast, "burn_slow": slow}
+            for ts, _seq, name, typ, obj, fast, slow
+            in sorted(slo_alerts)],
+        "telemetry_samples": {
+            name: telemetry_samples[name]
+            for name in sorted(telemetry_samples)},
     }
+
+
+# -- verifier flight recorder ---------------------------------------------
+
+def flight_straggler_lanes(flights: list[dict],
+                           outlier_factor: float = 3.0) -> list[int]:
+    """Attribute stragglers from flight-recorder entries (the
+    ``thw_flight`` RPC payload / ``VerifierScheduler.flights()``).
+
+    A lane is a straggler when the recorder shows breaker-diverted
+    windows on it (its device path was down and rows were rescued
+    host-side — the blackout victim), or when its median window total
+    is an ``outlier_factor`` outlier against the all-lane median (a
+    slow-but-alive device)."""
+    lanes: set = set()
+    totals: dict = {}
+    all_totals: list[float] = []
+    for f in flights:
+        if not isinstance(f, dict):
+            continue
+        dev = f.get("device")
+        total = float(f.get("total_ms", 0.0))
+        if f.get("diverted"):
+            lanes.add(dev)
+        totals.setdefault(dev, []).append(total)
+        all_totals.append(total)
+    if all_totals:
+        med = percentile(sorted(all_totals), 50.0)
+        if med > 0.0:
+            for dev in totals:
+                lane_med = percentile(sorted(totals[dev]), 50.0)
+                if lane_med > outlier_factor * med:
+                    lanes.add(dev)
+    return sorted(lanes, key=repr)
+
+
+def render_flights(flights: list[dict], width: int = 40) -> str:
+    """Text waterfall of verifier window lifecycles: one bar per
+    window (``.`` wait, ``=`` stage/dispatch, ``#`` compute/collect)
+    scaled against the slowest window, with lane attribution and a
+    straggler verdict line."""
+    rows = [f for f in flights if isinstance(f, dict)]
+    out = ["verifier flight recorder — %d window(s)" % len(rows)]
+    if not rows:
+        out.append("  (no windows recorded)")
+        return "\n".join(out)
+    rows = sorted(rows, key=lambda f: (int(f.get("window", 0)),
+                                       repr(f.get("device"))))
+    scale = max(float(f.get("total_ms", 0.0)) for f in rows) or 1.0
+    out.append("  %5s %4s %5s %-9s %-*s %9s" % (
+        "win", "dev", "rows", "reason", width + 2, "waterfall",
+        "total"))
+    for f in rows:
+        wait = max(0.0, float(f.get("wait_ms", 0.0)))
+        stage = max(0.0, float(f.get("stage_ms", 0.0)))
+        compute = max(0.0, float(f.get("compute_ms", 0.0)))
+        total = float(f.get("total_ms", 0.0))
+        n_wait = int(round(wait / scale * width))
+        n_stage = int(round(stage / scale * width))
+        n_comp = max(1, int(round(compute / scale * width)))
+        bar = "." * n_wait + "=" * n_stage + "#" * n_comp
+        flags = "*" if f.get("diverted") else \
+            ("?" if f.get("probing") else "")
+        out.append("  %5s %4s %5s %-9s [%-*s] %7.3fms %s" % (
+            f.get("window", "?"), f.get("device", "?"),
+            f.get("rows", "?"), str(f.get("reason", "?"))[:9],
+            width, bar[:width], total, flags))
+    stragglers = flight_straggler_lanes(rows)
+    out.append("  stragglers: %s   (* diverted, ? breaker probe)" % (
+        ", ".join(str(d) for d in stragglers) if stragglers else "-"))
+    return "\n".join(out)
 
 
 # -- collection -----------------------------------------------------------
@@ -283,6 +380,11 @@ def run_sim(nodes: int = 4, blocks: int = 6, seconds: float = 600.0,
 # -- rendering ------------------------------------------------------------
 
 def render(summary: dict, net: dict | None = None) -> str:
+    def _ms(v) -> str:
+        # empty event series produce None percentiles; render a dash
+        # instead of "None ms"
+        return "-" if v is None else str(v)
+
     out = []
     out.append("consensus observatory — %d node(s), %d block(s)" % (
         len(summary["nodes"]), summary["blocks"]))
@@ -291,19 +393,22 @@ def render(summary: dict, net: dict | None = None) -> str:
             "%s %d" % (k, net[k]) for k in sorted(net)))
     e, a = summary["election"], summary["ack_quorum"]
     out.append("  elections   : %4d  p50 %s ms  p99 %s ms" % (
-        e["count"], e["p50_ms"], e["p99_ms"]))
+        e["count"], _ms(e["p50_ms"]), _ms(e["p99_ms"])))
     out.append("  ack quorums : %4d  p50 %s ms  p99 %s ms" % (
-        a["count"], a["p50_ms"], a["p99_ms"]))
+        a["count"], _ms(a["p50_ms"]), _ms(a["p99_ms"])))
     out.append("  version bumps: %d (%.4f per block)" % (
         summary["version_bumps"], summary["version_bump_rate"]))
     out.append("  max commit gap: %.3f s; stalls(> threshold): %d" % (
         summary["max_commit_gap_s"], len(summary["stalls"])))
     for s in summary["stalls"]:
         out.append("    STALL before blk %d: %.3f s" % (s["blk"], s["gap_s"]))
-    out.append("  commit lag behind cluster-first:")
-    for name, lag in summary["commit_lag"].items():
-        out.append("    %-8s mean %8.6f s  max %8.6f s" % (
-            name, lag["mean_s"], lag["max_s"]))
+    if summary["commit_lag"]:
+        out.append("  commit lag behind cluster-first:")
+        for name, lag in summary["commit_lag"].items():
+            out.append("    %-8s mean %8.6f s  max %8.6f s" % (
+                name, lag["mean_s"], lag["max_s"]))
+    else:
+        out.append("  commit lag behind cluster-first: - (no commits)")
     out.append("  election timeline:")
     for blk, rows in summary["election_timeline"].items():
         out.append("    blk %s:" % blk)
@@ -330,6 +435,17 @@ def render(summary: dict, net: dict | None = None) -> str:
                     name, d["events"], d["aot_loads"], d["load_s"],
                     d["aot_compiles"], d["compile_s"],
                     d["cold_start_s"]))
+    if summary.get("telemetry_samples"):
+        out.append("  telemetry samples: " + "  ".join(
+            "%s %d" % (name, n)
+            for name, n in summary["telemetry_samples"].items()))
+    if summary.get("slo_alerts"):
+        out.append("  SLO alert timeline:")
+        for r in summary["slo_alerts"]:
+            out.append(
+                "      %12.6f  %s %s  burn fast %.2f / slow %.2f" % (
+                    r["ts"], r["type"].removeprefix("slo_"),
+                    r["objective"], r["burn_fast"], r["burn_slow"]))
     return "\n".join(out)
 
 

@@ -125,7 +125,8 @@ def test_observatory_replay_summary_identical_to_live(tmp_path):
 
 HEALTH_KEYS = {"height", "headHash", "lag", "role", "electionsWon",
                "electionsLost", "txpoolPending", "deferredDepth",
-               "members", "minTtl", "lastCommitAge", "stalled", "journal"}
+               "members", "minTtl", "lastCommitAge", "stalled", "journal",
+               "sloAlerts"}
 
 
 def test_thw_health_complete_on_every_node_and_over_http():

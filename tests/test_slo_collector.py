@@ -1,0 +1,319 @@
+"""Live telemetry plane tests for tier-1.
+
+Covers: the registry sampler's delta/ring semantics
+(``utils/timeseries.py``), the burn-rate SLO state machine
+(``harness/slo.py``), the headline collector round-trip — a live
+4-node sim push stream reconstructs BYTE-IDENTICAL to an offline
+journal replay, with zero alerts on a calm cluster — the socket ingest
+endpoint, the verifier window flight recorder + ``thw_flight`` RPC,
+``thw_journal`` cursor pagination, ``# HELP`` lines in the Prometheus
+exposition, and the observatory's empty-series hardening + SLO/flight
+rendering.
+"""
+
+import json
+import os
+import socket
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if os.path.join(REPO, "harness") not in sys.path:
+    sys.path.insert(0, os.path.join(REPO, "harness"))
+
+import observatory
+
+from eges_tpu.utils.metrics import (METRIC_FAMILIES, METRIC_HELP,
+                                    Registry, prometheus_text)
+from eges_tpu.utils.timeseries import RegistrySampler, SeriesStore, \
+    fold_payload
+
+
+# -- sampler: deltas, baselining, bounded ring ----------------------------
+
+def test_sampler_emits_deltas_and_baselines_at_construction():
+    reg = Registry()
+    reg.counter("net.dead_letters").inc(7)   # pre-existing lifetime count
+    t = [100.0]
+    s = RegistrySampler(reg, clock=lambda: t[0], capacity=4)
+
+    # step 1: the pre-construction count must NOT leak into the delta
+    p1 = s.sample()
+    assert "net.dead_letters" not in p1
+    assert p1["telemetry.samples"] == 1    # the sampler's own heartbeat
+
+    # step 2: only the inter-step increment appears
+    t[0] = 105.0
+    reg.counter("net.dead_letters").inc(3)
+    reg.gauge("txpool.pending").set(2)
+    p2 = s.sample()
+    assert p2["net.dead_letters"] == 3
+    assert p2["txpool.pending"] == 2
+
+    # step 3: zero delta => key absent (absent IS zero)
+    t[0] = 110.0
+    p3 = s.sample()
+    assert "net.dead_letters" not in p3
+    assert p3["txpool.pending"] == 2       # gauges are points, not deltas
+    assert s.steps == 3
+
+    # the store retains (ts, value) points per family, ring-bounded
+    pts = s.store.series("telemetry.samples").points()
+    assert pts == [(100.0, 1), (105.0, 1), (110.0, 1)]
+    for i in range(10):
+        t[0] = 120.0 + i
+        s.sample()
+    assert len(s.store.series("telemetry.samples")) == 4  # capacity
+
+    # fold_payload mirrors the sampler's folding collector-side
+    store = SeriesStore()
+    fold_payload(store, 105.0, p2)
+    assert store.series("net.dead_letters").points() == [(105.0, 3.0)]
+
+
+# -- SLO engine: burn-rate state machine ----------------------------------
+
+def test_slo_breaker_pending_firing_resolved_cycle():
+    from harness.slo import SLOEngine
+
+    eng = SLOEngine()
+    eng.ingest({"type": "fault_breaker", "ts": 0.0, "state": "open",
+                "device": 0})
+    # open breaker observed every 5s: pending after the first breach
+    # tick, firing once the breach sustains past pending_for_s
+    for k in range(1, 8):
+        eng.evaluate(5.0 * k)
+    states = eng.alert_states()
+    assert states["breaker_open"] == "firing"
+    assert eng.fired_total == 1
+    kinds = [e["type"] for e in eng.alerts()]
+    assert kinds[0] == "slo_pending" and "slo_firing" in kinds
+    assert all(e["objective"] == "breaker_open" for e in eng.alerts())
+    assert eng.compliance_ratio < 1.0
+
+    # heal: the fast window drains, then resolve_after_s of sustained
+    # recovery journals slo_resolved and the state returns to ok
+    eng.ingest({"type": "fault_breaker", "ts": 36.0, "state": "closed",
+                "device": 0})
+    tick = 40.0
+    while eng.alert_states()["breaker_open"] != "ok" and tick < 500.0:
+        eng.evaluate(tick)
+        tick += 5.0
+    assert eng.alert_states()["breaker_open"] == "ok"
+    assert [e["type"] for e in eng.alerts()][-1] == "slo_resolved"
+
+    # the alert journal is clock-free: stamped with evaluate()'s time
+    resolved = eng.alerts()[-1]
+    assert resolved["ts"] <= tick and resolved["burn_fast"] >= 0.0
+
+
+def test_slo_calm_observations_never_transition():
+    from harness.slo import SLOEngine
+
+    eng = SLOEngine()
+    for k in range(1, 40):
+        ts = 2.0 * k
+        eng.ingest({"type": "verifier_flush", "ts": ts, "occupancy": 0.5,
+                    "waited_ms": 1.0})
+        eng.ingest({"type": "block_committed", "ts": ts, "blk": k})
+        eng.evaluate(ts)
+    assert eng.alerts() == []
+    assert eng.fired_total == 0
+    assert eng.compliance_ratio == 1.0
+    assert set(eng.alert_states().values()) == {"ok"}
+
+
+# -- the headline round-trip: live push == journal replay -----------------
+
+def test_collector_live_report_byte_identical_to_replay():
+    from harness.collector import ClusterCollector
+    from eges_tpu.sim.cluster import SimCluster
+
+    col = ClusterCollector()
+    cluster = SimCluster(4, seed=0, txn_per_block=5, txpool=True)
+    # sub-100ms cadence: healthy sims commit in well under a virtual
+    # second, and the byte-match needs several sample barriers
+    cluster.enable_telemetry(sink=col.ingest, interval_s=0.05)
+    cluster.start()
+    cluster.run(600.0, stop_condition=lambda: cluster.min_height() >= 4)
+    assert cluster.min_height() >= 4, cluster.heights()
+    for sn in cluster.nodes:
+        sn.node.stop()
+    cluster.flush_telemetry()
+    col.finalize()
+
+    # multiple sampling steps flowed, nothing alerted on a calm run
+    assert col.envelopes > 4
+    samples = [e for e in cluster.journals()["telemetry"]
+               if e["type"] == "telemetry_sample"]
+    assert len(samples) >= 2
+    assert col.slo.fired_total == 0 and col.alerts() == []
+    assert col.report()["compliance_ratio"] == 1.0
+
+    # offline reconstruction from the very journals the nodes hold is
+    # byte-identical to the live push ingestion
+    replay = ClusterCollector.replay(cluster.journals())
+    assert col.report_json() == replay.report_json()
+
+    # the report carries per-node series: the heartbeat family exists
+    series = col.report()["series"]
+    assert "telemetry.samples" in series
+    assert len(series["telemetry.samples"]) == len(samples)
+
+
+def test_collector_server_socket_ingest():
+    from harness.collector import ClusterCollector, CollectorServer
+
+    col = ClusterCollector()
+    srv = CollectorServer(col)
+    try:
+        with socket.create_connection(srv.address, timeout=5.0) as s:
+            env = {"node": "n0", "ts": 1.0,
+                   "events": [{"type": "telemetry_sample", "ts": 1.0,
+                               "node": "n0", "seq": 0,
+                               "metrics": {"telemetry.samples": 1}}]}
+            # two envelopes in one stream, newline-delimited, plus a
+            # torn junk line the server must skip
+            s.sendall((json.dumps(env) + "\n{torn").encode())
+            s.sendall(b"\n" + json.dumps(
+                {"node": "n1", "ts": 2.0, "events": []}).encode() + b"\n")
+            deadline = time.monotonic() + 10.0
+            while col.envelopes < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+    finally:
+        srv.close()
+    assert col.envelopes == 2
+    rep = col.report()
+    assert rep["nodes"] == ["n0", "n1"]
+    assert "telemetry.samples" in rep["series"]
+
+
+# -- flight recorder + thw_flight RPC -------------------------------------
+
+def test_flight_recorder_and_thw_flight_rpc():
+    from eges_tpu.crypto.verify_host import NativeBatchVerifier
+    from eges_tpu.rpc.server import RpcServer
+    from eges_tpu.sim.cluster import SimCluster
+
+    c = SimCluster(4, txn_per_block=2, seed=3, signed=True,
+                   verifier=NativeBatchVerifier())
+    c.start()
+    c.run(120, stop_condition=lambda: c.min_height() >= 3)
+    assert c.min_height() >= 3, c.heights()
+    for sn in c.nodes:
+        sn.node.stop()
+
+    flights = c.verifier.flights()
+    assert flights, "no windows recorded"
+    assert c.verifier.stats()["flight_windows"] == len(flights) or \
+        c.verifier.stats()["flight_windows"] >= 256
+    f = flights[0]
+    # lifecycle phases are ordered and attributed to a lane
+    assert f["t_submit"] <= f["t_begin"] <= f["t_dispatch"] \
+        <= f["t_collect"] <= f["t_done"]
+    assert f["wait_ms"] >= 0 and f["total_ms"] >= 0
+    assert isinstance(f["device"], int) and f["rows"] >= 1
+    assert f["reason"] in {"full", "deadline", "kick", "close"}
+    windows = [x["window"] for x in flights]
+    assert windows == sorted(windows)
+
+    rpc = RpcServer(c.nodes[0].chain, node=c.nodes[0].node)
+    out = rpc.dispatch("thw_flight", [])
+    assert out and out[0]["window"] == windows[-1]  # newest first
+    assert rpc.dispatch("thw_flight", [2]) == out[:2]
+    # limit clamps into [1, 4096]
+    assert len(rpc.dispatch("thw_flight", [0])) == 1
+    assert len(rpc.dispatch("thw_flight", [10**6])) == len(flights)
+    # the waterfall renderer consumes the RPC payload directly
+    text = observatory.render_flights(out)
+    assert "verifier flight recorder" in text and "stragglers:" in text
+    c.verifier.close()
+
+
+def test_thw_journal_since_seq_pagination():
+    from eges_tpu.rpc.server import RpcServer
+    from eges_tpu.sim.cluster import SimCluster
+
+    c = SimCluster(3, seed=1)
+    c.start()
+    c.run(120, stop_condition=lambda: c.min_height() >= 3)
+    for sn in c.nodes:
+        sn.node.stop()
+    rpc = RpcServer(c.nodes[0].chain, node=c.nodes[0].node)
+
+    full = rpc.dispatch("thw_journal", [])
+    assert full
+    cut = full[len(full) // 2]["seq"]
+    page = rpc.dispatch("thw_journal", [{"since_seq": cut}])
+    assert page == [e for e in full if e["seq"] >= cut]
+    # cursor + limit compose; limit clamps into [1, 4096]
+    assert rpc.dispatch("thw_journal",
+                        [{"since_seq": cut, "limit": 2}]) == page[-2:]
+    assert len(rpc.dispatch("thw_journal", [{"limit": 0}])) == 1
+    assert len(rpc.dispatch("thw_journal", [10**9])) == len(full)
+
+
+# -- prometheus # HELP lines ----------------------------------------------
+
+def test_prometheus_help_precedes_type_with_vocabulary_text():
+    reg = Registry()
+    reg.counter("net.dead_letters").inc(2)
+    reg.gauge("txpool.pending").set(1)
+    reg.gauge("verifier.device_name").set("cpu")   # _info family
+    reg.histogram("verifier.mesh_occupancy").observe(0.5)
+    text = prometheus_text(reg)
+    lines = text.splitlines()
+    for fam in ("net.dead_letters", "txpool.pending",
+                "verifier.mesh_occupancy"):
+        flat = fam.replace(".", "_").replace("-", "_")
+        help_idx = [i for i, ln in enumerate(lines)
+                    if ln.startswith("# HELP %s" % flat)]
+        assert help_idx, "missing # HELP for %s" % fam
+        assert METRIC_HELP[fam] in lines[help_idx[0]]
+        assert lines[help_idx[0] + 1].startswith("# TYPE %s" % flat)
+    assert any(ln.startswith("# HELP verifier_device_name_info")
+               for ln in lines)
+    # the vocabulary ships help for every registered family, exactly
+    assert set(METRIC_HELP) == set(METRIC_FAMILIES)
+
+
+# -- observatory hardening + SLO rendering --------------------------------
+
+def test_observatory_empty_series_and_slo_sections():
+    # a node that journaled nothing must render, with dashes not None
+    empty = observatory.summarize({"n0": []})
+    text = observatory.render(empty)
+    assert "p50 - ms" in text and "None" not in text
+    assert empty["election"]["p50_ms"] is None
+    assert empty["commit_lag"] == {} and empty["stalls"] == []
+
+    # SLO transitions and telemetry heartbeats land in the summary
+    evs = [
+        {"type": "telemetry_sample", "ts": 5.0, "node": "telemetry",
+         "seq": 0, "step": 1, "metrics": {}},
+        {"type": "slo_pending", "ts": 10.0, "node": "slo", "seq": 0,
+         "objective": "breaker_open", "burn_fast": 10.0,
+         "burn_slow": 10.0},
+        {"type": "slo_firing", "ts": 20.0, "node": "slo", "seq": 1,
+         "objective": "breaker_open", "burn_fast": 10.0,
+         "burn_slow": 10.0},
+        {"type": "slo_resolved", "ts": 90.0, "node": "slo", "seq": 2,
+         "objective": "breaker_open", "burn_fast": 0.0,
+         "burn_slow": 0.4},
+    ]
+    s = observatory.summarize({"telemetry": evs[:1], "slo": evs[1:]})
+    assert [a["type"] for a in s["slo_alerts"]] == [
+        "slo_pending", "slo_firing", "slo_resolved"]
+    assert s["telemetry_samples"] == {"telemetry": 1}
+    out = observatory.render(s)
+    assert "SLO alert timeline:" in out
+    assert "firing breaker_open" in out
+    assert "telemetry samples: telemetry 1" in out
+
+    # straggler attribution: breaker-diverted lanes and timing outliers
+    flights = (
+        [{"device": 0, "diverted": False, "total_ms": 1.0}] * 6
+        + [{"device": 1, "diverted": False, "total_ms": 40.0}] * 3
+        + [{"device": 2, "diverted": True, "total_ms": 1.0}])
+    assert observatory.flight_straggler_lanes(flights) == [1, 2]
+    assert observatory.flight_straggler_lanes([]) == []
