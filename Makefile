@@ -1,0 +1,14 @@
+# Developer gate: device-hygiene static analysis scoped to the branch
+# diff (falls back to the whole tree when origin/main is absent, e.g.
+# a fresh clone with no remote), then the fast test suite.
+BASE := $(shell git rev-parse --verify -q origin/main || echo HEAD)
+
+.PHONY: check analyze test
+
+check: analyze test
+
+analyze:
+	python -m harness.analysis --github --diff $(BASE)
+
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'

@@ -879,7 +879,11 @@ def main() -> None:
 
     # trend the static-analysis counts alongside the perf series: one
     # findings_by_rule/unsuppressed_by_rule line per bench round, the
-    # history harness/check_regression.py --analysis gates on
+    # history harness/check_regression.py --analysis gates on — any
+    # rise in a rule fails, and rules absent from the previous line
+    # count as zero, so the device-hygiene rules (host-sync,
+    # recompile-hazard, transfer-hygiene, dtype-promotion) gate from
+    # their first recorded line onward
     analysis_history = os.environ.get(
         "ANALYSIS_HISTORY", os.path.join(_REPO, "harness",
                                          "analysis_history.jsonl"))

@@ -81,13 +81,19 @@ def runtime_versions() -> dict:
         import jax
 
         jaxlib = getattr(jax, "lib", None)
+        # the x64 flag is an ABI dimension too: an artifact exported
+        # under jax_enable_x64 has 64-bit dtypes baked into its
+        # signature, and loading it into a 32-bit process (or vice
+        # versa) would dtype-mismatch at call time — key it so the
+        # load path degrades to a recompile instead
         return {"jax": getattr(jax, "__version__", "none"),
                 "jaxlib": getattr(jaxlib, "version", None)
-                and jaxlib.version.__version__ or "none"}
+                and jaxlib.version.__version__ or "none",
+                "x64": "1" if jax.config.jax_enable_x64 else "0"}
     # analysis: allow-swallow(no jax in this process: version-less
     # headers simply never match, the load path degrades to recompile)
     except Exception:
-        return {"jax": "none", "jaxlib": "none"}
+        return {"jax": "none", "jaxlib": "none", "x64": "none"}
 
 
 def _safe(part: str) -> str:
@@ -122,6 +128,7 @@ class AotStore:
                 "code_rev": self.fingerprint,
                 "jax": self.versions.get("jax", "none"),
                 "jaxlib": self.versions.get("jaxlib", "none"),
+                "x64": self.versions.get("x64", "none"),
                 "payload_len": len(payload),
                 "sha256": hashlib.sha256(payload).hexdigest()}
 
@@ -171,7 +178,7 @@ class AotStore:
             header = json.loads(blob[12:12 + hlen])
             payload = blob[12 + hlen:]
             for key in ("format", "op", "bucket", "device_kind",
-                        "code_rev", "jax", "jaxlib"):
+                        "code_rev", "jax", "jaxlib", "x64"):
                 if header.get(key) != want[key]:
                     raise ValueError(
                         f"{key} mismatch: artifact has "

@@ -242,7 +242,7 @@ class VerifierScheduler:
 
     # -- public async API -------------------------------------------------
 
-    def submit(self, sighash: bytes, sig: bytes) -> Future:  # thread-entry
+    def submit(self, sighash: bytes, sig: bytes) -> Future:  # thread-entry hot-path-entry
         """Queue one ``(sighash32, sig65)`` recovery; the future resolves
         to the 20-byte signer address, or ``None`` for an invalid
         signature.  Cache hits resolve immediately; misses ride the next
@@ -295,7 +295,7 @@ class VerifierScheduler:
         metrics.counter("verifier.cache_misses").inc()
         return fut
 
-    def kick(self) -> None:  # thread-entry
+    def kick(self) -> None:  # thread-entry hot-path-entry
         """Flush the current micro-window immediately: synchronous
         callers (quorum tallies under the virtual-time sim clock) must
         not sleep out the real-time deadline."""
@@ -535,7 +535,7 @@ class VerifierScheduler:
                 self._admission_done = True
                 self._lock.notify_all()
 
-    def _dispatch_forever(self) -> None:
+    def _dispatch_forever(self) -> None:  # hot-path-entry
         """Background flush loop: wait for work, coalesce inside the
         micro-window, place/dispatch ONE window, repeat.  Exits only
         once closed AND drained."""
@@ -636,7 +636,7 @@ class VerifierScheduler:
             metrics.gauge(
                 f"verifier.mesh_queue_depth;device={index}").set(depth)
 
-    def _lane_loop(self, lane: _DeviceLane) -> None:
+    def _lane_loop(self, lane: _DeviceLane) -> None:  # hot-path-entry
         """One device lane's worker: drain the lane queue FIFO; on an
         unexpected loop death fail THIS lane's queued futures — other
         lanes keep serving (straggler isolation).

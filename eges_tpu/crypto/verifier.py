@@ -198,7 +198,7 @@ class BatchVerifier:
         # tail-memset instead of a fresh np.zeros per call.  The lock
         # covers fill -> device consumption, so two callers can never
         # interleave writes into one buffer mid-upload.
-        self._stage_bufs: dict[int, dict[str, np.ndarray]] = {}
+        self._stage_bufs: dict[int, list[dict[str, np.ndarray]]] = {}
         self._staging_lock = threading.Lock()
         # AOT executable registry: (op, bucket) -> callable built from a
         # serialized artifact (or a fresh export).  Shared across every
@@ -260,16 +260,42 @@ class BatchVerifier:
             self._collective_fns[name] = fn
         return fn(ds, dh)
 
-    def _staging(self, b: int, with_pubs: bool = False) -> dict:
-        # caller holds self._staging_lock
-        st = self._stage_bufs.get(b)
+    def _stage_acquire(self, b: int, with_pubs: bool = False) -> dict:
+        """Check a host staging buffer set out of the per-bucket pool.
+
+        The lock covers only the pop — filling, uploading and the
+        device round-trip all happen with the buffers held exclusively,
+        so concurrent submitters overlap instead of serializing behind
+        one device fence.  The pool grows to the real concurrency
+        high-water mark and is reused forever after."""
+        with self._staging_lock:
+            pool = self._stage_bufs.setdefault(b, [])
+            st = pool.pop() if pool else None
         if st is None:
             st = {"sigs": np.zeros((b, 65), np.uint8),
                   "hashes": np.zeros((b, 32), np.uint8)}
-            self._stage_bufs[b] = st
         if with_pubs and "pubs" not in st:
             st["pubs"] = np.zeros((b, 64), np.uint8)
         return st
+
+    def _stage_release(self, b: int, st: dict) -> None:
+        # only after the compute fence: the upload has been consumed,
+        # so the host buffers are safe to hand to the next window
+        with self._staging_lock:
+            self._stage_bufs.setdefault(b, []).append(st)
+
+    def _to_device(self, *bufs):
+        """Commit staged host buffers to their compute home: row-
+        sharded across the mesh when one is configured (the collective
+        graphs then consume pre-placed shards instead of paying a
+        default-device commit plus a GSPMD reshard — ``_pad`` keeps
+        every bucket a device multiple, so rows split evenly), plain
+        default-device commit on the single-device facade."""
+        if self._mesh is not None:
+            sharding = jax.sharding.NamedSharding(
+                self._mesh, jax.sharding.PartitionSpec(self._axis))
+            return tuple(jax.device_put(m, sharding) for m in bufs)
+        return tuple(jnp.asarray(m) for m in bufs)
 
     def prewarm(self, buckets=(16, 32, 64), background: bool = True):
         """Compile the small power-of-two recover graphs off the
@@ -518,15 +544,17 @@ class BatchVerifier:
         # before the lock, the registry is only mutated under it
         fn = (self._aot_execs.get(("recover", b))
               if self._sharded is None else None)
-        with self._staging_lock:
-            st = self._staging(b)
+        # pool checkout instead of a lock around the whole round trip:
+        # the device wait below must never serialize other submitters
+        st = self._stage_acquire(b)
+        try:
             ps, ph = st["sigs"], st["hashes"]
             ps[:n] = sigs
             ps[n:] = 0
             ph[:n] = hashes
             ph[n:] = 0
             t0 = time.monotonic()
-            ds, dh = jnp.asarray(ps), jnp.asarray(ph)
+            ds, dh = self._to_device(ps, ph)
             if self.debug_timing:
                 jax.block_until_ready((ds, dh))
             t1 = time.monotonic()
@@ -541,6 +569,10 @@ class BatchVerifier:
             out = (np.asarray(addrs)[:n], np.asarray(pubs)[:n],
                    np.asarray(ok)[:n].astype(bool))
             t3 = time.monotonic()
+        finally:
+            # the fence above consumed the upload; the host buffers are
+            # free for the next window
+            self._stage_release(b, st)
         self._record_batch("ecrecover", n, b, cached, t0, t1, t2, t3)
         return out
 
@@ -563,8 +595,8 @@ class BatchVerifier:
         self._verify_buckets.add(b)
         fn = (self._aot_execs.get(("verify", b))
               if self._sharded is None else None)
-        with self._staging_lock:
-            st = self._staging(b, with_pubs=True)
+        st = self._stage_acquire(b, with_pubs=True)
+        try:
             ps, ph, pq = st["sigs"], st["hashes"], st["pubs"]
             ps[:n] = sigs[:, :65] if sigs.shape[1] >= 65 else \
                 np.pad(sigs, ((0, 0), (0, 65 - sigs.shape[1])))
@@ -574,7 +606,7 @@ class BatchVerifier:
             pq[:n] = pubs
             pq[n:] = 0
             t0 = time.monotonic()
-            ds, dh, dq = jnp.asarray(ps), jnp.asarray(ph), jnp.asarray(pq)
+            ds, dh, dq = self._to_device(ps, ph, pq)
             if self.debug_timing:
                 jax.block_until_ready((ds, dh, dq))
             t1 = time.monotonic()
@@ -583,6 +615,8 @@ class BatchVerifier:
             t2 = time.monotonic()
             out = np.asarray(ok)[:n].astype(bool)
             t3 = time.monotonic()
+        finally:
+            self._stage_release(b, st)
         self._record_batch("verify", n, b, cached, t0, t1, t2, t3)
         return out
 
@@ -623,7 +657,7 @@ class BatchVerifier:
             ph[:n] = hashes
             ph[n:] = 0
             st.t0 = time.monotonic()
-            st.arrays = (jnp.asarray(ps), jnp.asarray(ph))
+            st.arrays = self._to_device(ps, ph)
         return st
 
     def commit_recover(self, st: _StagedBatch) -> _StagedBatch:
@@ -678,7 +712,9 @@ class _DeviceTarget:
         # dispatch by raising here; the scheduler's per-lane breaker is
         # the consumer
         self.failure_hook = None
-        self._stage: dict[int, tuple] = {}
+        # per-bucket pool of host staging pairs; _lock covers only the
+        # pop/push so a lane's device wait never blocks its peers
+        self._stage: dict[int, list] = {}
         self._lock = threading.Lock()
         # per-lane double buffers for the split-phase pipeline (the
         # AOT exec registry itself lives on the parent — shared across
@@ -710,11 +746,12 @@ class _DeviceTarget:
         cached = b in parent._compiled_buckets
         fn = self._exec_for(b)
         with self._lock:
-            st = self._stage.get(b)
-            if st is None:
-                st = (np.zeros((b, 65), np.uint8),
-                      np.zeros((b, 32), np.uint8))
-                self._stage[b] = st
+            pool = self._stage.setdefault(b, [])
+            st = pool.pop() if pool else None
+        if st is None:
+            st = (np.zeros((b, 65), np.uint8),
+                  np.zeros((b, 32), np.uint8))
+        try:
             ps, ph = st
             ps[:n] = sigs
             ps[n:] = 0
@@ -732,6 +769,11 @@ class _DeviceTarget:
             out = (np.asarray(addrs)[:n],
                    np.asarray(ok)[:n].astype(bool))
             t3 = time.monotonic()
+        finally:
+            # fence consumed the upload — the pair can serve the next
+            # micro-window on this lane
+            with self._lock:
+                self._stage.setdefault(b, []).append(st)
         parent._compiled_buckets.add(b)
         parent._record_batch("ecrecover", n, b, cached, t0, t1, t2, t3)
         return out

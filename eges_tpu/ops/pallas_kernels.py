@@ -1796,7 +1796,7 @@ def keccak_rows_pallas(words: jnp.ndarray, *,
             interpret=interpret,
         )(words)
         # digest order lo0 hi0 lo1 hi1 … (squeeze order of the flat twin)
-        return st[jnp.array([0, 25, 1, 26, 2, 27, 3, 28]), :]
+        return st[jnp.array([0, 25, 1, 26, 2, 27, 3, 28], jnp.int32), :]
     return pl.pallas_call(
         _keccak_kernel,
         out_shape=jax.ShapeDtypeStruct((8, wide), jnp.uint32),

@@ -9,6 +9,8 @@ XLA collectives riding ICI).  These helpers are the generic layer under
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 
@@ -34,9 +36,14 @@ def data_parallel_mesh(devices=None, axis: str = "dp"):
     return jax.sharding.Mesh(np.array(devs), (axis,))
 
 
+@functools.lru_cache(maxsize=None)
 def shard_rows(fn, mesh, axis: str = "dp", *, n_in: int, n_out: int,
                tally_out: int | None = None):
     """Wrap a row-batched function in `shard_map` over ``mesh[axis]``.
+
+    Memoized on ``(fn, mesh, axis, arity)`` — the wrapper (and its jit
+    cache) is built once per distinct graph, so calling this from the
+    dispatch path never re-traces.
 
     ``fn`` maps ``n_in`` row-sharded arrays to ``n_out`` row-sharded
     arrays; each device runs the identical fused kernel on its shard

@@ -24,6 +24,8 @@ the tally happens ON-DEVICE across chips.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 
@@ -52,12 +54,17 @@ def _shard_map_unchecked(fn, mesh, in_specs, out_specs):
                 out_specs=out_specs, **kw)
 
 
+@functools.lru_cache(maxsize=None)
 def ring_tally(fn, mesh, axis: str = "dp", *, n_in: int, n_out: int,
                tally_out: int):
     """Like :func:`~eges_tpu.parallel.shard_rows` but the tally is a
     RING all-reduce: N-1 `ppermute` hops, each adding the neighbor's
     partial sum — bitwise-identical result to `psum`, nearest-neighbor
-    traffic pattern."""
+    traffic pattern.
+
+    Memoized on ``(fn, mesh, axis, arity)``: dispatch-path callers get
+    the same wrapper (and jit cache) back instead of re-tracing a fresh
+    collective graph per window."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as PS
@@ -85,6 +92,7 @@ def ring_tally(fn, mesh, axis: str = "dp", *, n_in: int, n_out: int,
         tuple([PS(axis)] * n_out + [PS()])))
 
 
+@functools.lru_cache(maxsize=None)
 def all_to_all_resplit(fn, mesh, axis: str = "dp", *, n_in: int,
                        feature_axis: int = 1):
     """The Ulysses-style layout swap: inputs arrive ROW-sharded, an
@@ -126,6 +134,7 @@ def all_to_all_resplit(fn, mesh, axis: str = "dp", *, n_in: int,
         out_specs=PS(axis)))
 
 
+@functools.lru_cache(maxsize=None)
 def ring_gather(fn, mesh, axis: str = "dp", *, n_in: int,
                 gather_out: int = 0):
     """Row-sharded map whose ``gather_out`` output is ring-all-gathered:

@@ -37,7 +37,10 @@ def main(argv: list[str] | None = None) -> int:
         prog="python -m harness.analysis",
         description="AST static analysis: lock-discipline, lock-order/"
                     "fail-under-lock, future-lifecycle, determinism, "
-                    "jit-purity, vocabulary, robustness-hygiene.")
+                    "jit-purity, vocabulary, robustness-hygiene, and "
+                    "the device-hygiene pass (host-sync, "
+                    "recompile-hazard, transfer-hygiene, "
+                    "dtype-promotion) over the verifier hot path.")
     ap.add_argument("paths", nargs="*", default=list(core.DEFAULT_PATHS),
                     help="directories/files to scan (default: eges_tpu "
                          "harness)")
@@ -54,6 +57,9 @@ def main(argv: list[str] | None = None) -> int:
                          "git rev (the whole tree is still analyzed — "
                          "cross-file rules need it — but untouched files "
                          "can't fail the run)")
+    ap.add_argument("--github", action="store_true",
+                    help="also print ::error workflow annotations for "
+                         "unsuppressed findings (GitHub Actions)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore the checked-in baseline")
     ap.add_argument("--update-baseline", action="store_true",
@@ -101,10 +107,18 @@ def main(argv: list[str] | None = None) -> int:
         for e in report.stale_baseline:
             print(f"stale baseline entry (no longer fires): "
                   f"[{e['rule']}] {e['path']} {e['symbol']}")
+        for w in report.expiring_waivers:
+            print(f"waiver expiring soon: {w['path']}:{w['line']} "
+                  f"allow-{w['rule']} until={w['until']}")
         s = report.summary_json()
         print(f"{s['files']} files, {s['findings']} findings "
               f"({s['unsuppressed']} unsuppressed, {s['waived']} waived, "
               f"{s['baselined']} baselined) in {s['elapsed_s']}s")
+
+    if args.github:
+        for f in report.unsuppressed:
+            print(f"::error file={f.path},line={f.line}::"
+                  f"{f.rule}: {f.message}")
 
     if args.summary:
         with open(args.summary, "a", encoding="utf-8") as fh:

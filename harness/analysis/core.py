@@ -27,7 +27,7 @@ import os
 import re
 import time
 
-# rule ids, grouped by the seven checkers that own them
+# rule ids, grouped by the checkers that own them
 RULES = (
     "lock-discipline",                                   # lock_discipline
     "lock-order", "fail-under-lock",                     # lock_order
@@ -37,10 +37,16 @@ RULES = (
     "vocabulary",                                        # vocabulary
     "swallow", "thread-join", "socket-timeout",          # robustness
     "unbounded-queue", "no-print",                       # robustness
+    "host-sync",                                         # host_sync
+    "recompile-hazard",                                  # recompile
+    "transfer-hygiene",                                  # transfer
+    "dtype-promotion",                                   # dtypes
+    "waiver-expired",                                    # core (runner)
 )
 
 _WAIVER_RE = re.compile(r"#\s*analysis:\s*(.+)$")
 _ALLOW_RE = re.compile(r"allow-([a-z0-9-]+)(?:\(([^)]*)\))?")
+_UNTIL_RE = re.compile(r"until=(\d{4}-\d{2}-\d{2})")
 
 
 @dataclasses.dataclass
@@ -78,8 +84,14 @@ class SourceFile:
         self.lines = self.text.splitlines()
         self.tree = ast.parse(self.text, filename=relpath)
         # line -> {rule-token: reason}; a waiver comment alone on a line
-        # also covers the next line (annotation-above style)
+        # also covers the next line (annotation-above style).  A reason
+        # may carry an optional expiry: ``until=YYYY-MM-DD`` — past that
+        # date the waiver stops suppressing and becomes a finding.
         self.waivers: dict[int, dict[str, str]] = {}
+        self.waiver_until: dict[tuple[int, str], str] = {}
+        # one entry per waiver comment (no next-line duplicate), for
+        # expiry reporting: (comment line, token, until)
+        self.waiver_expiries: list[tuple[int, str, str]] = []
         for i, line in enumerate(self.lines, 1):
             m = _WAIVER_RE.search(line)
             if not m:
@@ -88,14 +100,28 @@ class SourceFile:
                       for tok, reason in _ALLOW_RE.findall(m.group(1))}
             if not tokens:
                 continue
+            standalone = line.lstrip().startswith("#")
             self.waivers.setdefault(i, {}).update(tokens)
-            if line.lstrip().startswith("#"):  # standalone comment line
+            if standalone:  # standalone comment line
                 self.waivers.setdefault(i + 1, {}).update(tokens)
+            for tok, reason in tokens.items():
+                mu = _UNTIL_RE.search(reason)
+                if not mu:
+                    continue
+                self.waiver_until[(i, tok)] = mu.group(1)
+                if standalone:
+                    self.waiver_until[(i + 1, tok)] = mu.group(1)
+                self.waiver_expiries.append((i, tok, mu.group(1)))
 
-    def waived(self, rule: str, line: int) -> bool:
+    def waived(self, rule: str, line: int,
+               today: str | None = None) -> bool:
         for tok in self.waivers.get(line, ()):
-            if rule == tok or rule.endswith("-" + tok):
-                return True
+            if rule != tok and not rule.endswith("-" + tok):
+                continue
+            until = self.waiver_until.get((line, tok))
+            if today is not None and until is not None and until < today:
+                continue  # expired — no longer suppresses
+            return True
         return False
 
     # -- annotation helpers (shared comment conventions) ----------------
@@ -225,12 +251,16 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 class Report:
     def __init__(self, findings: list[Finding], files: int,
                  elapsed_s: float, stale_baseline: list[dict],
-                 errors: list[str]):
+                 errors: list[str],
+                 expiring_waivers: list[dict] | None = None):
         self.findings = findings
         self.files = files
         self.elapsed_s = elapsed_s
         self.stale_baseline = stale_baseline
         self.errors = errors
+        # waivers whose until= date falls within the next 30 days —
+        # advance warning before they flip into waiver-expired findings
+        self.expiring_waivers = expiring_waivers or []
 
     @property
     def unsuppressed(self) -> list[Finding]:
@@ -259,6 +289,7 @@ class Report:
             "stale_baseline": len(self.stale_baseline),
             "findings_by_rule": self.findings_by_rule(),
             "unsuppressed_by_rule": self.unsuppressed_by_rule(),
+            "waivers_expiring_30d": self.expiring_waivers,
         }
 
 
@@ -266,16 +297,40 @@ def run(root: str, paths: tuple[str, ...] = DEFAULT_PATHS,
         rules: tuple[str, ...] | None = None,
         baseline_path: str | None = DEFAULT_BASELINE) -> Report:
     from harness.analysis import (
-        determinism, future_lifecycle, jit_purity, lock_discipline,
-        lock_order, robustness, vocabulary,
+        determinism, dtypes, future_lifecycle, host_sync, jit_purity,
+        lock_discipline, lock_order, recompile, robustness, transfer,
+        vocabulary,
     )
 
     t0 = time.monotonic()
     project = Project(root, paths)
     findings: list[Finding] = []
     for checker in (lock_discipline, lock_order, future_lifecycle,
-                    determinism, jit_purity, vocabulary, robustness):
+                    determinism, jit_purity, vocabulary, robustness,
+                    host_sync, recompile, transfer, dtypes):
         findings.extend(checker.check(project))
+
+    # waiver expiry: the clock is overridable so tests stay
+    # deterministic; an expired waiver both stops suppressing and is a
+    # finding of its own (a dead suppression is drift, not hygiene)
+    today = os.environ.get("EGES_ANALYSIS_TODAY") or \
+        time.strftime("%Y-%m-%d")
+    horizon = _plus_days(today, 30)
+    expiring: list[dict] = []
+    for src in project.files:
+        for line, tok, until in src.waiver_expiries:
+            if until < today:
+                findings.append(Finding(
+                    rule="waiver-expired", path=src.path, line=line,
+                    symbol=tok,
+                    message=f"waiver allow-{tok} expired on {until} — "
+                            "re-justify with a new until= date or fix "
+                            "the finding it suppressed"))
+            elif until <= horizon:
+                expiring.append({"path": src.path, "line": line,
+                                 "rule": tok, "until": until})
+    expiring.sort(key=lambda e: (e["until"], e["path"], e["line"]))
+
     if rules is not None:
         findings = [f for f in findings if f.rule in rules]
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
@@ -284,7 +339,7 @@ def run(root: str, paths: tuple[str, ...] = DEFAULT_PATHS,
     by_path = {f.path: f for f in project.files}
     for f in findings:
         src = by_path.get(f.path)
-        if src is not None and src.waived(f.rule, f.line):
+        if src is not None and src.waived(f.rule, f.line, today):
             f.waived = True
 
     # layer 2: baseline (line-number-free match, each entry usable once
@@ -319,4 +374,10 @@ def run(root: str, paths: tuple[str, ...] = DEFAULT_PATHS,
                 stale.append(e)
 
     return Report(findings, len(project.files), time.monotonic() - t0,
-                  stale, project.errors)
+                  stale, project.errors, expiring)
+
+
+def _plus_days(day: str, days: int) -> str:
+    import datetime
+    return (datetime.date.fromisoformat(day)
+            + datetime.timedelta(days=days)).isoformat()

@@ -1,0 +1,131 @@
+"""dtype-promotion: weak types and 64-bit leaks in device code.
+
+The limb kernels are pinned to uint32/int32 lanes; a python literal or
+a dtype-less constructor introduces a *weakly typed* array whose
+promotion differs from an explicitly typed one — and since dtype is
+part of the jit cache key, weak-type promotion is a recompile in
+disguise.  64-bit dtypes are worse: under the default
+``jax_enable_x64=False`` they silently truncate, and enabling x64
+changes every downstream dtype (which is why the AOT store keys its
+artifacts on the x64 flag).
+
+Whole-file scan of ``eges_tpu/`` modules that import ``jax.numpy``
+(the device layer; harness/bench tooling stays host-side):
+
+* ``jnp.zeros/ones/empty/full`` without an explicit ``dtype=``;
+* ``jnp.array``/``jnp.asarray`` of a python literal (list/tuple/
+  numeric constant/comprehension) without ``dtype=`` — arrays built
+  from existing typed values keep their dtype and are exempt;
+* any ``jnp.int64``/``jnp.float64`` reference, and ``dtype=float`` /
+  ``dtype="float64"``-style 64-bit requests inside jnp calls.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from harness.analysis.core import Finding, Project, SourceFile
+
+RULE = "dtype-promotion"
+
+_CTORS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2}
+_WRAPPERS = frozenset({"array", "asarray"})
+_BAD_DTYPES = frozenset({"int64", "float64", "uint64"})
+
+
+def _imports_jnp(src: SourceFile) -> bool:
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "jax.numpy" for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "") == "jax" and any(
+                    a.name == "numpy" for a in node.names):
+                return True
+    return False
+
+
+def _jnp_attr(node: ast.expr) -> str | None:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "jnp"):
+        return node.attr
+    return None
+
+
+def _literal_operand(node: ast.expr) -> bool:
+    if isinstance(node, (ast.List, ast.Tuple, ast.ListComp)):
+        return True
+    return isinstance(node, ast.Constant) and isinstance(
+        node.value, (int, float)) and not isinstance(node.value, bool)
+
+
+def _dtype_kw(node: ast.Call) -> ast.expr | None:
+    for kw in node.keywords:
+        if kw.arg == "dtype":
+            return kw.value
+    return None
+
+
+def _bad_dtype_value(value: ast.expr) -> str | None:
+    if isinstance(value, ast.Name) and value.id in ("float", "int"):
+        return value.id
+    if isinstance(value, ast.Constant) and isinstance(value.value, str) \
+            and value.value in _BAD_DTYPES:
+        return value.value
+    return None
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in project.files:
+        if not src.path.startswith("eges_tpu/"):
+            continue
+        if not _imports_jnp(src):
+            continue
+        for node in ast.walk(src.tree):
+            attr = _jnp_attr(node)
+            if attr in _BAD_DTYPES:
+                findings.append(Finding(
+                    rule=RULE, path=src.path, line=node.lineno,
+                    symbol=f"jnp.{attr}",
+                    message=f"jnp.{attr} in device code — 64-bit lanes "
+                            "silently truncate under the default "
+                            "jax_enable_x64=False and double every "
+                            "limb's footprint when enabled; the kernels "
+                            "are pinned to 32-bit limbs"))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _jnp_attr(node.func)
+            if fname is None:
+                continue
+            dtype = _dtype_kw(node)
+            if dtype is not None:
+                bad = _bad_dtype_value(dtype)
+                if bad is not None:
+                    findings.append(Finding(
+                        rule=RULE, path=src.path, line=node.lineno,
+                        symbol=f"jnp.{fname}",
+                        message=f"dtype={bad} requests a 64-bit (or "
+                                "python-weak) type in device code — pin "
+                                "an explicit 32-bit jnp dtype"))
+                continue
+            if fname in _CTORS and len(node.args) <= _CTORS[fname]:
+                findings.append(Finding(
+                    rule=RULE, path=src.path, line=node.lineno,
+                    symbol=f"jnp.{fname}",
+                    message=f"jnp.{fname} without an explicit dtype "
+                            "defaults to float32 weak promotion — limb "
+                            "buffers must pin uint32/int32 explicitly"))
+            elif fname in _WRAPPERS and len(node.args) == 1 and \
+                    _literal_operand(node.args[0]):
+                findings.append(Finding(
+                    rule=RULE, path=src.path, line=node.lineno,
+                    symbol=f"jnp.{fname}",
+                    message=f"jnp.{fname} of a python literal without "
+                            "dtype creates a weakly-typed array — "
+                            "weak-type promotion changes the jit cache "
+                            "key downstream (a recompile in disguise); "
+                            "pass dtype=jnp.int32/uint32"))
+    return findings

@@ -1,0 +1,166 @@
+"""transfer-hygiene: H2D placement and staging discipline on the hot path.
+
+Three habits keep host↔device traffic off the critical path, and this
+rule enforces each:
+
+* **no uploads inside loops** — an ``jnp.asarray``/``jax.device_put``
+  in a ``for``/``while`` body issues one PCIe transfer per iteration;
+  batch the operands and upload once per window;
+* **lane dispatch pins its device** — a mesh-capable class (one that
+  assigns ``self._mesh`` or carries a ``self.device``) committing
+  arrays with a plain ``jnp.asarray``/``jnp.array`` sends them to the
+  *default* device and pays a resharding copy when the computation runs
+  somewhere else; use ``jax.device_put(..., lane.device)`` or a
+  sharding-aware ``_to_device`` helper (methods named ``*to_device*``
+  and mesh-gated branches are the approved homes for the fallback);
+* **no staging-buffer reuse while a window is in flight** — the
+  split-phase ``stage_*`` half runs concurrently with an earlier
+  window's device compute; touching the single-buffer ``_stag*`` pool
+  there overwrites operands the device may still be reading.  Staging
+  must go through the double-buffered pair (``_pipe*``) or a
+  checked-out pool slot.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from harness.analysis.core import Finding, Project
+from harness.analysis import hotpath
+
+RULE = "transfer-hygiene"
+
+_UPLOAD_ATTRS = frozenset({"asarray", "array"})
+
+
+def _upload_desc(node: ast.Call) -> str | None:
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    if f.attr in _UPLOAD_ATTRS and isinstance(f.value, ast.Name) \
+            and f.value.id == "jnp":
+        return f"jnp.{f.attr}"
+    if f.attr == "device_put":
+        return "jax.device_put"
+    return None
+
+
+def _mesh_capable_classes(graph: hotpath.HotGraph) -> set[tuple[str, str]]:
+    """(path, class) pairs that assign ``self._mesh`` or
+    ``self.device`` anywhere — these have a better home for arrays than
+    the default device."""
+    capable: set[tuple[str, str]] = set()
+    for path, mod in graph.modules.items():
+        for cname, tab in mod.classes.items():
+            for fn in tab["methods"].values():
+                for node in ast.walk(fn):
+                    if (isinstance(node, ast.Attribute)
+                            and isinstance(node.ctx, ast.Store)
+                            and isinstance(node.value, ast.Name)
+                            and node.value.id == "self"
+                            and node.attr in ("_mesh", "device")):
+                        capable.add((path, cname))
+    return capable
+
+
+def _mesh_gated(test: ast.expr) -> bool:
+    for node in ast.walk(test):
+        name = (node.attr if isinstance(node, ast.Attribute)
+                else node.id if isinstance(node, ast.Name) else "")
+        if "_mesh" in name or "_sharded" in name:
+            return True
+    return False
+
+
+class _Scan(ast.NodeVisitor):
+    def __init__(self, fn: hotpath.HotFunction, mesh_capable: bool,
+                 findings: list[Finding]):
+        self.fn = fn
+        self.mesh_capable = mesh_capable
+        self.findings = findings
+        self.loop_depth = 0
+        self.gate_depth = 0
+        self.in_to_device = "to_device" in fn.node.name
+        self.staging = fn.node.name.startswith("stage")
+
+    def visit_For(self, node: ast.For) -> None:
+        self._loop(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._loop(node)
+
+    def _loop(self, node) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    def visit_If(self, node: ast.If) -> None:
+        gated = _mesh_gated(node.test)
+        if gated:
+            self.gate_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if gated:
+            self.gate_depth -= 1
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        fn = self.fn
+        if (self.staging and isinstance(node.ctx, ast.Load)
+                and node.attr.startswith("_stag")
+                and "lock" not in node.attr
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            self.findings.append(Finding(
+                rule=RULE, path=fn.path, line=node.lineno,
+                symbol=fn.qualname,
+                message=f"stage-phase access to single-buffer "
+                        f"{node.attr} — the previous window's device "
+                        "compute may still be reading it; use the "
+                        "double-buffered pair or a checked-out pool "
+                        "slot"))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        desc = _upload_desc(node)
+        fn = self.fn
+        if desc is not None:
+            if self.loop_depth:
+                self.findings.append(Finding(
+                    rule=RULE, path=fn.path, line=node.lineno,
+                    symbol=fn.qualname,
+                    message=f"{desc} inside a loop on the hot path "
+                            f"(via {fn.entry}) — one H2D transfer per "
+                            "iteration; batch operands and upload once "
+                            "per window"))
+            elif (desc.startswith("jnp.") and self.mesh_capable
+                    and not self.gate_depth and not self.in_to_device):
+                self.findings.append(Finding(
+                    rule=RULE, path=fn.path, line=node.lineno,
+                    symbol=fn.qualname,
+                    message=f"{desc} commits operands to the default "
+                            "device on a mesh/lane-capable class — use "
+                            "jax.device_put(..., lane.device) or the "
+                            "sharding-aware _to_device helper so rows "
+                            "land where the compute runs"))
+        self.generic_visit(node)
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    graph = hotpath.hot_graph(project)
+    capable = _mesh_capable_classes(graph)
+    for fn in graph.functions():
+        if not hotpath.imports_jax(fn.src):
+            continue
+        mesh_capable = fn.cls is not None and (fn.path, fn.cls) in capable
+        scan = _Scan(fn, mesh_capable, findings)
+        for stmt in fn.node.body:
+            scan.visit(stmt)
+    return findings

@@ -107,11 +107,22 @@ def check(project: Project) -> list[Finding]:
     family_kinds: dict[str, set[str]] = {}
     family_seen: dict[str, tuple[str, int]] = {}
     dispatch_methods: dict[str, tuple[str, int]] = {}
+    # every string literal passed to ANY call outside the registry
+    # module counts as a potential emit site — deliberately loose
+    # (events flow through wrappers like slo._transition), so only a
+    # name nobody mentions anywhere is declared dead
+    event_witnesses: set[str] = set()
 
     for src in project.files:
         for node in ast.walk(src.tree):
             if not isinstance(node, (ast.Call, ast.Compare)):
                 continue
+
+            if isinstance(node, ast.Call) and src.path != JOURNAL_PATH:
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    for lit in _str_consts(arg):
+                        event_witnesses.add(lit)
 
             # journal.record("<type>") / self._breakdown("<phase>")
             if (isinstance(node, ast.Call)
@@ -182,6 +193,16 @@ def check(project: Project) -> list[Finding]:
                 rule="vocabulary", path=path, line=line, symbol=fam,
                 message=f'metric family "{fam}" is used as multiple '
                         f"kinds: {', '.join(sorted(kinds))}"))
+
+    # registered event with no emit site anywhere → dead vocabulary
+    # (the journal registry keeps growing PR over PR; a name nothing
+    # can ever record is drift, same as a stale metric family)
+    for ev in sorted(event_types - event_witnesses):
+        findings.append(Finding(
+            rule="vocabulary", path=JOURNAL_PATH, line=1, symbol=ev,
+            message=f'journal event "{ev}" is registered in '
+                    "EVENT_TYPES but never emitted — no call site "
+                    "passes it anywhere in the tree"))
 
     # registered but never emitted → stale vocabulary
     if families is not None:

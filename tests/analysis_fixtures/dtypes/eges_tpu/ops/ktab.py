@@ -1,0 +1,23 @@
+"""Seeded dtype-promotion leaks in device code."""
+
+import jax.numpy as jnp
+import numpy as np
+
+SQUEEZE = jnp.array([0, 25, 1, 26])          # firing: weak literal array
+SCRATCH = jnp.zeros((8, 8))                  # firing: dtype-less ctor
+WIDE = jnp.asarray([1, 2, 3], dtype="int64")  # firing: 64-bit request
+
+
+def lane_index(i):
+    return jnp.full((2, 2), i, dtype=jnp.int64)  # firing: jnp.int64
+
+
+# -- clean twins ----------------------------------------------------------
+
+SQUEEZE_OK = jnp.array([0, 25, 1, 26], jnp.int32)
+SCRATCH_OK = jnp.zeros((8, 8), dtype=jnp.uint32)
+HOST_SIDE = np.zeros((8, 8))                 # numpy stays host-typed
+
+
+def reupload(existing):
+    return jnp.asarray(existing)             # clean: keeps source dtype

@@ -1,0 +1,28 @@
+"""Seeded waiver-expiry shapes: one expired, one far-future, one
+expiring inside the 30-day warning window (the clock is pinned by
+EGES_ANALYSIS_TODAY in the tests)."""
+
+
+def risky():
+    raise RuntimeError
+
+
+def expired_waiver():
+    try:
+        risky()
+    except Exception:  # analysis: allow-swallow(probe until=2020-01-01)
+        pass
+
+
+def live_waiver():
+    try:
+        risky()
+    except Exception:  # analysis: allow-swallow(probe until=2142-01-01)
+        pass
+
+
+def soon_waiver():
+    try:
+        risky()
+    except Exception:  # analysis: allow-swallow(probe until=2099-01-10)
+        pass

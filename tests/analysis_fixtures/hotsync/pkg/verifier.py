@@ -1,0 +1,61 @@
+"""Seeded host-sync defects: blocking device reads on the hot path."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class WindowVerifier:
+    """Entry-pattern class: *Verifier + ENTRY_METHODS seed the graph."""
+
+    def __init__(self):
+        self._staging_lock = threading.Lock()
+        self._buf = np.zeros((64, 65), np.uint8)
+        self.debug_timing = False
+
+    def ecrecover(self, sigs, hashes):
+        # holding a lock across the device round trip serializes every
+        # submitter — fires even though ecrecover is a resolve boundary
+        with self._staging_lock:
+            ds = jnp.asarray(self._buf)
+            ok = self._compute(ds)
+            jax.block_until_ready(ok)        # firing: sync under lock
+            out = np.asarray(ok)             # firing: D2H under lock
+        return out
+
+    def stage_window(self, sigs):  # hot-path-entry
+        ds = jnp.asarray(sigs)
+        ok = self._compute(ds)
+        jax.block_until_ready(ok)            # firing: mid-pipeline sync
+        return ok
+
+    def _compute(self, ds):
+        return ds
+
+
+def bucket_round(n, minimum):
+    b = max(n, minimum)
+    return 1 << (b - 1).bit_length()
+
+
+class CleanVerifier:
+    """The approved shapes: gate, boundary, collect — all quiet."""
+
+    def __init__(self):
+        self.debug_timing = False
+
+    def verify(self, sigs, hashes, pubs):
+        b = bucket_round(len(sigs), 16)
+        padded = sigs[:b]
+        ds = jnp.asarray(padded)
+        if self.debug_timing:
+            jax.block_until_ready(ds)        # clean: debug-gated probe
+        ok = ds
+        jax.block_until_ready(ok)            # clean: sync facade boundary
+        return np.asarray(ok)                # clean: boundary D2H
+
+    def collect_recover(self, st):
+        jax.block_until_ready(st)            # clean: collect half
+        return np.asarray(st)

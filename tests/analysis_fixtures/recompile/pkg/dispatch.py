@@ -1,0 +1,50 @@
+"""Seeded recompile hazards: per-call jit, unbucketed uploads,
+per-call static_argnums values."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def bucket_round(n, minimum):
+    b = max(n, minimum)
+    return 1 << (b - 1).bit_length()
+
+
+def _graph(ds):
+    return ds
+
+
+def _graph2(ds, width):
+    return ds[:width]
+
+
+_widthed = jax.jit(_graph2, static_argnums=1)
+
+
+class JitPerCallVerifier:
+    def verify(self, sigs, hashes, pubs):
+        fn = jax.jit(_graph)                 # firing: jit in a hot fn
+        ds = jnp.asarray(sigs)               # firing: unbucketed upload
+        return fn(ds)
+
+
+class StaticArgVerifier:
+    def ecrecover(self, sigs, hashes):
+        n = sigs.shape[0]
+        ds = jnp.asarray(sigs[:8])
+        return _widthed(ds, n)               # firing: per-call static
+
+
+class CleanBucketVerifier:
+    @functools.lru_cache(maxsize=None)
+    def _builder(self, b):                   # hot-path-entry
+        return jax.jit(_graph)               # clean: memoized builder
+
+    def recover_addresses(self, sigs, hashes):
+        n = sigs.shape[0]
+        b = bucket_round(n, 16)
+        padded = sigs[:b]
+        ds = jnp.asarray(padded)             # clean: bucketed operand
+        return _widthed(ds, 32)              # clean: constant static

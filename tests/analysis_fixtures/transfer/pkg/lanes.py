@@ -1,0 +1,54 @@
+"""Seeded transfer-hygiene defects: uploads in loops, default-device
+commits on a lane class, staging reuse in the split-phase path."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bucket_round(n, minimum):
+    b = max(n, minimum)
+    return 1 << (b - 1).bit_length()
+
+
+class LoopyLaneVerifier:
+    def __init__(self, mesh, device):
+        self._mesh = mesh
+        self.device = device
+        self._staging_buf = np.zeros((64, 65), np.uint8)
+        self._staging_lock = threading.Lock()
+
+    def ecrecover(self, sigs, hashes):
+        outs = []
+        for chunk in sigs:
+            outs.append(jax.device_put(chunk, self.device))  # firing: loop
+        ds = jnp.asarray(hashes)             # firing: default-device commit
+        return outs, ds
+
+    def stage_recover(self, sigs):
+        buf = self._staging_buf              # firing: single-buffer reuse
+        buf[: len(sigs)] = sigs
+        return jax.device_put(buf, self.device)
+
+
+class CleanDeviceLane:
+    def __init__(self, mesh, device):
+        self._mesh = mesh
+        self.device = device
+        self._pipe = [np.zeros((64, 65), np.uint8) for _ in range(2)]
+        self._pipe_toggle = 0
+
+    def stage_recover(self, sigs):
+        n = bucket_round(len(sigs), 16)
+        i = self._pipe_toggle
+        self._pipe_toggle = i ^ 1
+        buf = self._pipe[i]                  # clean: double-buffer pair
+        buf[:n] = sigs[:n]
+        return jax.device_put(buf, self.device)  # clean: pinned, no loop
+
+    def _to_device_fallback(self, m):
+        if self._mesh is None:
+            return jnp.asarray(m)            # clean: mesh-gated fallback
+        return jax.device_put(m, self.device)

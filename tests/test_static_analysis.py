@@ -75,6 +75,8 @@ def test_vocabulary_flags_each_drift_mode():
     assert "multiple" in by_symbol["pool.pending"]  # counter+gauge clash
     assert "never emitted" in by_symbol["pool.flushed"]  # stale entry
     assert "eth_unknown" in by_symbol            # unregistered dispatch
+    # dead vocabulary: a registered event no call site ever passes
+    assert "never emitted" in by_symbol["block_committed"]
     # registered uses and the debug_* prefix dispatcher stay clean
     assert "vote_cast" not in by_symbol
     assert "eth_ping" not in by_symbol
@@ -162,6 +164,112 @@ def test_determinism_closure_and_approved_plumbing():
                for f in un)
 
 
+# -- device hygiene: host-sync --------------------------------------------
+
+def test_host_sync_flags_lock_and_midpipeline_blocking():
+    rep = _run_fixture("hotsync", paths=("pkg",), rules=("host-sync",))
+    msgs = {f.line: f.message for f in rep.unsuppressed}
+    assert len(msgs) == 3, [f.render() for f in rep.unsuppressed]
+    # a device wait under a lock fires even at a resolve boundary...
+    assert "holding _staging_lock" in msgs[24]
+    assert "D2H" in msgs[25]
+    # ...and a bare mid-pipeline sync in a stage phase fires too
+    assert "mid-pipeline" in msgs[31]
+    # every report names the entry point the sink was reached from
+    assert all("via WindowVerifier" in m for m in msgs.values())
+
+
+def test_host_sync_exempts_gated_boundary_and_collect():
+    rep = _run_fixture("hotsync", paths=("pkg",), rules=("host-sync",))
+    assert not any("CleanVerifier" in f.symbol for f in rep.findings), [
+        f.render() for f in rep.findings]
+
+
+# -- device hygiene: recompile-hazard -------------------------------------
+
+def test_recompile_flags_jit_in_hot_fn_unbucketed_and_static_args():
+    rep = _run_fixture("recompile", paths=("pkg",),
+                       rules=("recompile-hazard",))
+    msgs = "\n".join(f.message for f in rep.unsuppressed)
+    assert "jax.jit call site inside a hot function" in msgs
+    assert "129–151" in msgs                       # the measured cost
+    assert "without passing through bucket_round" in msgs
+    assert "static_argnums position 1" in msgs
+
+
+def test_recompile_exempts_cached_builder_and_bucketed_flow():
+    rep = _run_fixture("recompile", paths=("pkg",),
+                       rules=("recompile-hazard",))
+    assert not any("CleanBucketVerifier" in f.symbol
+                   for f in rep.findings), [
+        f.render() for f in rep.findings]
+
+
+# -- device hygiene: transfer-hygiene -------------------------------------
+
+def test_transfer_flags_loop_upload_default_device_and_stage_reuse():
+    rep = _run_fixture("transfer", paths=("pkg",),
+                       rules=("transfer-hygiene",))
+    msgs = "\n".join(f.message for f in rep.unsuppressed)
+    assert len(rep.unsuppressed) == 3, [
+        f.render() for f in rep.unsuppressed]
+    assert "inside a loop" in msgs
+    assert "default device on a mesh/lane-capable class" in msgs
+    assert "single-buffer _staging_buf" in msgs
+
+
+def test_transfer_exempts_pinned_double_buffer_and_gated_fallback():
+    rep = _run_fixture("transfer", paths=("pkg",),
+                       rules=("transfer-hygiene",))
+    assert not any("CleanDeviceLane" in f.symbol for f in rep.findings), [
+        f.render() for f in rep.findings]
+
+
+# -- device hygiene: dtype-promotion --------------------------------------
+
+def test_dtype_flags_weak_literals_ctors_and_64bit():
+    rep = _run_fixture("dtypes", paths=("eges_tpu",),
+                       rules=("dtype-promotion",))
+    by_line = {f.line: f.message for f in rep.unsuppressed}
+    assert len(by_line) == 4, [f.render() for f in rep.unsuppressed]
+    assert "weakly-typed array" in by_line[6]      # literal jnp.array
+    assert "without an explicit dtype" in by_line[7]  # dtype-less zeros
+    assert "dtype=int64" in by_line[8]             # 64-bit string request
+    assert "jnp.int64" in by_line[12]              # 64-bit dtype attr
+
+
+def test_dtype_exempts_typed_twins_and_host_numpy():
+    rep = _run_fixture("dtypes", paths=("eges_tpu",),
+                       rules=("dtype-promotion",))
+    lines = {f.line for f in rep.findings}
+    assert lines == {6, 7, 8, 12}, [f.render() for f in rep.findings]
+
+
+# -- waiver expiry --------------------------------------------------------
+
+def test_waiver_expiry_flips_and_warns(monkeypatch):
+    monkeypatch.setenv("EGES_ANALYSIS_TODAY", "2098-12-20")
+    rep = _run_fixture("expiry", paths=("pkg",))
+    un = {(f.rule, f.line) for f in rep.unsuppressed}
+    # the expired waiver stops suppressing AND becomes its own finding
+    assert ("swallow", 13) in un
+    assert ("waiver-expired", 13) in un
+    # far-future and inside-the-window waivers still suppress...
+    assert not any(line in (20, 27) for _, line in un)
+    # ...but the one inside 30 days is surfaced for renewal
+    assert [w["line"] for w in rep.expiring_waivers] == [27]
+    assert rep.expiring_waivers[0]["until"] == "2099-01-10"
+    assert "waivers_expiring_30d" in rep.summary_json()
+
+
+def test_waiver_expiry_before_the_deadline_still_suppresses(monkeypatch):
+    monkeypatch.setenv("EGES_ANALYSIS_TODAY", "2000-01-01")
+    rep = _run_fixture("expiry", paths=("pkg",))
+    assert rep.unsuppressed == [], [
+        f.render() for f in rep.unsuppressed]
+    assert rep.expiring_waivers == []
+
+
 # -- waiver grammar edge cases --------------------------------------------
 
 def test_waiver_stacked_tokens_and_wrong_line_attachment():
@@ -239,7 +347,13 @@ def test_cli_gate_exit_codes_and_summary(tmp_path):
     line = json.loads(open(summary).read().strip())
     assert set(line["findings_by_rule"]) >= {"lock-discipline",
                                              "jit-purity", "vocabulary",
-                                             "swallow", "no-print"}
+                                             "swallow", "no-print",
+                                             "host-sync",
+                                             "recompile-hazard",
+                                             "transfer-hygiene",
+                                             "dtype-promotion",
+                                             "waiver-expired"}
+    assert line["waivers_expiring_30d"] == []
 
     # seeded regression: the same CLI exits non-zero on a dirty tree
     proc = subprocess.run(
@@ -253,6 +367,10 @@ def test_cli_gate_exit_codes_and_summary(tmp_path):
     ("lockorder", "pkg"),      # seeded AB/BA deadlock cycle
     ("future", "pkg"),         # seeded pending-future leak
     ("determinism", "simtree"),  # seeded wall clock in chaos-reachable code
+    ("hotsync", "pkg"),        # seeded device sync under a lock
+    ("recompile", "pkg"),      # seeded per-call jit / unbucketed upload
+    ("transfer", "pkg"),       # seeded loop upload / staging reuse
+    ("dtypes", "eges_tpu"),    # seeded weak-type / 64-bit leaks
 ])
 def test_cli_exits_nonzero_on_each_seeded_concurrency_bug(tree, paths):
     proc = subprocess.run(
@@ -260,6 +378,40 @@ def test_cli_exits_nonzero_on_each_seeded_concurrency_bug(tree, paths):
          os.path.join(FIXTURES, tree), "--no-baseline", paths],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 1, proc.stdout + proc.stderr
+
+
+def test_cli_fixture_reports_are_byte_stable():
+    def once():
+        proc = subprocess.run(
+            [sys.executable, "-m", "harness.analysis", "--root",
+             os.path.join(FIXTURES, "hotsync"), "--no-baseline", "pkg"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 1
+        # drop the trailing summary line: elapsed_s legitimately varies
+        return proc.stdout.splitlines()[:-1]
+
+    assert once() == once()
+
+
+def test_cli_github_annotations():
+    proc = subprocess.run(
+        [sys.executable, "-m", "harness.analysis", "--root",
+         os.path.join(FIXTURES, "dtypes"), "--no-baseline", "--github",
+         "eges_tpu"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    notes = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("::error ")]
+    assert notes, proc.stdout
+    assert notes[0].startswith(
+        "::error file=eges_tpu/ops/ktab.py,line="), notes[0]
+    assert "::dtype-promotion: " in notes[0]
+    # a clean tree emits no annotations
+    proc = subprocess.run(
+        [sys.executable, "-m", "harness.analysis", "--github"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "::error" not in proc.stdout
 
 
 # -- --diff scoping -------------------------------------------------------
