@@ -3,12 +3,17 @@
 # a fresh clone with no remote), then the fast test suite.
 BASE := $(shell git rev-parse --verify -q origin/main || echo HEAD)
 
-.PHONY: check analyze test
+.PHONY: check analyze test anatomy-smoke
 
-check: analyze test
+check: analyze test anatomy-smoke
 
 analyze:
 	python -m harness.analysis --github --diff $(BASE)
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+# fast determinism smoke: two commit-anatomy assembler passes over the
+# same sim journals must byte-match (harness/anatomy.py --selftest)
+anatomy-smoke:
+	JAX_PLATFORMS=cpu python -m harness.anatomy --selftest

@@ -37,11 +37,13 @@ deserializing stored executables instead of recompiling; gated
 lower-is-better), ``pipeline_overlap_ratio`` (the scheduler's
 double-buffered lane pipeline measured host-side over
 ``PipelinedNativeVerifier`` — overlapped windows / pipelined windows),
-and ``slo_compliance_ratio`` / ``slo_false_positive_alerts`` (a calm
+``slo_compliance_ratio`` / ``slo_false_positive_alerts`` (a calm
 sim cluster through the live telemetry collector + burn-rate SLO
 engine, ``harness/collector.py`` / ``harness/slo.py`` — any alert
 firing on a healthy cluster is a false positive, gated at exactly
-zero).
+zero), and ``commit_p99_ms`` (the commit-anatomy critical-path
+assembler over the same calm-sim shape, ``harness/anatomy.py`` —
+end-to-end commit p99 plus per-phase shares, gated lower-is-better).
 
 ``bench.py mesh`` is a separate stage: it regenerates MESH_SCALING.json
 through ``harness/mesh_scaling.run`` (psum/ring A/B, recorded collective
@@ -568,6 +570,71 @@ def _slo_stage() -> dict | None:
         return None
 
 
+def _anatomy_stage() -> dict | None:
+    """Commit-anatomy stage: the same calm sim shape as ``_slo_stage``
+    through the live collector, but reporting the critical-path
+    assembler's view (``harness/anatomy.py``) — end-to-end commit
+    p50/p99 and the per-phase latency shares.  The history series
+    ``commit_p99_ms`` is gated lower-is-better by
+    ``harness/check_regression.py``, so a commit-latency regression
+    fails the round even when steady-state verifies/s holds.
+
+    Runs in the PARENT like ``_slo_stage``: the sim imports no JAX and
+    the phase chain is measured on the virtual clock."""
+    try:
+        from eges_tpu.sim.cluster import SimCluster
+        from harness.collector import ClusterCollector
+
+        t0 = time.monotonic()
+        col = ClusterCollector()
+        cluster = SimCluster(4, seed=0, txn_per_block=5, txpool=True)
+        cluster.enable_telemetry(sink=col.ingest, interval_s=0.5)
+        cluster.start()
+        cluster.run(600.0,
+                    stop_condition=lambda: cluster.min_height() >= 4)
+        for sn in cluster.nodes:
+            sn.node.stop()
+        cluster.flush_telemetry()
+        col.finalize()
+        rep = col.report()["anatomy"]
+        if not rep["blocks"] or rep["commit_p99_ms"] is None:
+            return None
+        dom = rep.get("dominant") or {}
+        return {
+            "blocks": rep["blocks"],
+            "commit_p50_ms": rep["commit_p50_ms"],
+            "commit_p99_ms": rep["commit_p99_ms"],
+            "phase_shares": {
+                k: v["share"] for k, v in rep["phases"].items()},
+            "dominant_phase": dom.get("phase"),
+            "elapsed_s": round(time.monotonic() - t0, 2),
+        }
+    # analysis: allow-swallow(optional bench stage; a failed leg reports null)
+    except Exception:
+        return None
+
+
+def _platform_detail(probe_state: dict, best: dict) -> dict:
+    """Requested-vs-actual backend stamp for every history line: the
+    bench always WANTS the accelerator, so when a line was measured on
+    the CPU backend the reader should not have to reverse-engineer why
+    from probe counters — the reason is spelled out in place."""
+    actual = ("tpu" if best.get("tpu")
+              else "cpu" if best.get("cpu") else "none")
+    out = {"requested": "tpu", "actual": actual,
+           "tunnel": probe_state.get("tunnel", "unprobed")}
+    if actual != "tpu":
+        if probe_state.get("tunnel") != "up":
+            out["fallback_reason"] = (
+                "tpu tunnel down after %d probe(s), waited %.1f s" % (
+                    probe_state.get("probes", 0),
+                    probe_state.get("waited_s", 0.0)))
+        else:
+            out["fallback_reason"] = ("tpu probe answered but the tpu "
+                                      "child produced no result")
+    return out
+
+
 def _spawn(kind: str, deadline: float, max_batch: int) -> subprocess.Popen:
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -648,6 +715,7 @@ def main() -> None:
     coalesced = _coalesced_stage()
     pipeline = _pipeline_stage()
     slo = _slo_stage()
+    anatomy = _anatomy_stage()
 
     best: dict = {}      # kind -> best stage result for that backend
     # kind -> {batch(str): {p50_ms, p99_ms}} — every stage's tails, not
@@ -693,6 +761,7 @@ def main() -> None:
             cap = _watcher_capture()
             if cap:
                 out["watcher_tpu_capture"] = cap
+        out["platform_detail"] = _platform_detail(probe_state, best)
         if lat_by_batch[kind]:
             out["latency_ms_by_batch"] = dict(sorted(
                 lat_by_batch[kind].items(), key=lambda kv: int(kv[0])))
@@ -829,6 +898,7 @@ def main() -> None:
             "watcher_tpu_capture": _watcher_capture(),
             "cpu_baseline_measured_per_s":
                 round(measured, 1) if measured else None,
+            "platform_detail": _platform_detail(probe_state, best),
         }
         fail.update(_provenance())
         print(json.dumps(fail), flush=True)
@@ -846,7 +916,9 @@ def main() -> None:
                 line = {"metric": "cold_start_seconds",
                         "value": final["cold_start_seconds"], "unit": "s",
                         "device": final.get("device"),
-                        "aot": final.get("aot")}
+                        "aot": final.get("aot"),
+                        "platform_detail":
+                            _platform_detail(probe_state, best)}
                 line.update(_provenance())
                 print(json.dumps(line), flush=True)
                 _append_history(line)
@@ -857,7 +929,8 @@ def main() -> None:
                 "value": pipeline["overlap_ratio"], "unit": "ratio",
                 "windows": pipeline["windows"],
                 "overlapped": pipeline["overlapped"],
-                "rows": pipeline["rows"]}
+                "rows": pipeline["rows"],
+                "platform_detail": _platform_detail(probe_state, best)}
         line.update(_provenance())
         print(json.dumps(line), flush=True)
         _append_history(line)
@@ -872,10 +945,26 @@ def main() -> None:
                  slo["false_positive_alerts"], "count")):
             line = {"metric": metric, "value": value, "unit": unit,
                     "eval_ticks": slo["eval_ticks"],
-                    "envelopes": slo["envelopes"]}
+                    "envelopes": slo["envelopes"],
+                    "platform_detail":
+                        _platform_detail(probe_state, best)}
             line.update(_provenance())
             print(json.dumps(line), flush=True)
             _append_history(line)
+    if anatomy:
+        # parent-side stage: per-block critical-path attribution over a
+        # calm sim — gated lower-is-better so a commit-latency
+        # regression fails the round even when verifies/s holds
+        line = {"metric": "commit_p99_ms",
+                "value": anatomy["commit_p99_ms"], "unit": "ms",
+                "commit_p50_ms": anatomy["commit_p50_ms"],
+                "blocks": anatomy["blocks"],
+                "phase_shares": anatomy["phase_shares"],
+                "dominant_phase": anatomy["dominant_phase"],
+                "platform_detail": _platform_detail(probe_state, best)}
+        line.update(_provenance())
+        print(json.dumps(line), flush=True)
+        _append_history(line)
 
     # trend the static-analysis counts alongside the perf series: one
     # findings_by_rule/unsuppressed_by_rule line per bench round, the

@@ -176,6 +176,14 @@ class GeecNode:
         self._seal_t0 = 0.0
         self._elect_t = 0.0
         self._ack_t = 0.0
+        # commit-anatomy phase splits for the in-flight proposal: the
+        # election and ack-quorum durations land here when each phase
+        # completes, and _finish_seal journals them as ONE
+        # ``commit_anatomy`` stage="seal" event so the critical-path
+        # assembler (harness/anatomy.py) can segment seal time without
+        # re-joining three breakdown spans
+        self._election_dt = 0.0
+        self._ack_dt = 0.0
 
         # timers
         self._timers: dict[str, object] = {}
@@ -542,6 +550,7 @@ class GeecNode:
         self._cancel_timer("election")
         dt = self.clock.now() - self._elect_t
         self._breakdown("election", dt, blk=wb.blk_num)
+        self._election_dt = dt
         self.elections_won += 1
         self.journal.record("election_won", blk=wb.blk_num,
                             version=self._proposal_version, dt=dt,
@@ -690,6 +699,7 @@ class GeecNode:
             self._cancel_timer("validate")
             dt = self.clock.now() - self._ack_t
             self._breakdown("ack", dt, blk=wb.blk_num)
+            self._ack_dt = dt
             self.journal.record("validate_quorum", blk=wb.blk_num, dt=dt,
                                 acks=len(wb.validate_replies))
             self._phase = BACKOFF
@@ -721,8 +731,18 @@ class GeecNode:
         self._proposal_geec_txns = []  # included in the sealed block
         from eges_tpu.utils.metrics import DEFAULT as metrics
         metrics.counter("consensus.sealed").inc()
-        self._breakdown("seal_total", self.clock.now() - self._seal_t0,
-                        blk=block.number)
+        seal_s = self.clock.now() - self._seal_t0
+        self._breakdown("seal_total", seal_s, blk=block.number)
+        # commit-anatomy seal stage: the proposer-side phase split of
+        # this block's seal, on the virtual clock.  t_seal_start lets
+        # the assembler place the segment absolutely; election/ack are
+        # the measured sub-phases, the remainder is build/backoff.
+        self.journal.record(
+            "commit_anatomy", blk=block.number, stage="seal",
+            t_seal_start=round(self._seal_t0, 6),
+            seal_s=round(seal_s, 6),
+            election_s=round(self._election_dt, 6),
+            ack_s=round(self._ack_dt, 6))
         self.chain.offer(sealed)  # our own insert funnel
         self.transport.gossip(M.pack_gossip(M.GOSSIP_CONFIRM_BLOCK, confirm))
 

@@ -64,6 +64,14 @@ class TxPool:
         self.owner = ""  # identifies this pool's node in span attrs
         self._ingest_ctx: dict[bytes, tracing.SpanContext] = {}
         self._INGEST_CTX_CAP = 8192
+        # commit-anatomy linkage: per-txn ingest/admit timestamps on the
+        # node clock (virtual under the simulator), emitted as one
+        # ``commit_anatomy`` stage="pool" event when a block includes
+        # the txns — the ingest->admission leg of the per-block
+        # critical path (harness/anatomy.py).  Same cap discipline as
+        # ``_ingest_ctx``: entries die at eviction.
+        self._ingest_t: dict[bytes, float] = {}
+        self._admit_t: dict[bytes, float] = {}
         # consensus event journal (utils/journal.py), attached by the
         # owning GeecNode; distinct from the RLP txn journal above
         self.event_journal = None
@@ -92,6 +100,8 @@ class TxPool:
                 self._queue.append(t)
                 if len(self._ingest_ctx) < self._INGEST_CTX_CAP:
                     self._ingest_ctx[h] = ctx
+                if len(self._ingest_t) < self._INGEST_CTX_CAP:
+                    self._ingest_t[h] = self.clock.now()
                 fresh += 1
             sp.set_attr("fresh", fresh)
             if len(self._queue) >= self.max_batch:
@@ -174,6 +184,8 @@ class TxPool:
         by_nonce[t.nonce] = t
         self._order.append((sender, t))
         self._by_hash[t.hash] = (sender, t.nonce)
+        if len(self._admit_t) < self._INGEST_CTX_CAP:
+            self._admit_t[t.hash] = self.clock.now()
         self._maybe_compact()
         self.stats["admitted"] += 1
         self._depth_gauge()
@@ -264,6 +276,8 @@ class TxPool:
                         del self.pending[sender]
             self._dead.add(t.hash)
             self._ingest_ctx.pop(t.hash, None)
+            self._ingest_t.pop(t.hash, None)
+            self._admit_t.pop(t.hash, None)
         self._maybe_compact()
         self._depth_gauge()
 
@@ -279,6 +293,22 @@ class TxPool:
                         "tx.commit", 0.0, parent=ctx, owner=self.owner,
                         tx=t.hash.hex()[:16],
                         **({"block": block} if block is not None else {}))
+            # commit-anatomy pool stage: the ingest->admission leg of
+            # this block's critical path, on the node clock (virtual
+            # under the simulator, so deterministic in sims).  Emitted
+            # BEFORE eviction drops the per-txn timestamps.
+            if self.event_journal is not None and txns:
+                ing = [self._ingest_t[t.hash] for t in txns
+                       if t.hash in self._ingest_t]
+                adm = [self._admit_t[t.hash] for t in txns
+                       if t.hash in self._admit_t]
+                if ing and adm:
+                    self.event_journal.record(
+                        "commit_anatomy", blk=block, stage="pool",
+                        count=len(txns),
+                        t_first_ingest=round(min(ing), 6),
+                        t_last_admit=round(max(adm), 6),
+                        ingest_to_admit_s=round(max(adm) - min(ing), 6))
             self._evict(txns)
             if self.event_journal is not None and txns:
                 self.event_journal.record("txns_included", blk=block,

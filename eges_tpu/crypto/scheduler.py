@@ -211,6 +211,12 @@ class VerifierScheduler:
         # key -> ([futures], t_submit): identical in-flight keys share
         # one row (in-batch dedup), arrival order preserved
         self._pending: OrderedDict[tuple, list] = OrderedDict()
+        # key -> trace id of the submitter's active span (txpool ingest,
+        # quorum verify): commit-anatomy linkage tying flight-recorder
+        # windows back to the transactions that rode them.  Bounded like
+        # the ingest-context map; entries pop when their window records.
+        self._pending_trace: dict[tuple, str] = {}
+        self._PENDING_TRACE_CAP = 8192
         self._kick = False
         self._closed = False
         self._admission_done = False  # set once the dispatch loop exits
@@ -283,6 +289,11 @@ class VerifierScheduler:
                 else:
                     # analysis: allow-determinism(coalescing deadline is real-time by contract; chaos pins batching via max_batch kicks)
                     self._pending[key] = [[fut], time.monotonic()]
+                    from eges_tpu.utils import tracing
+                    ctx = tracing.DEFAULT.current_context()
+                    if (ctx is not None and len(self._pending_trace)
+                            < self._PENDING_TRACE_CAP):
+                        self._pending_trace[key] = ctx.trace_id
                     self._ensure_thread()
                 if len(self._pending) >= self.max_batch:
                     self._kick = True
@@ -407,6 +418,7 @@ class VerifierScheduler:
                     leftovers.extend(row for _k, row in batch)
             leftovers.extend(self._pending.values())
             self._pending.clear()
+            self._pending_trace.clear()
         for futs, _t in leftovers:
             for f in futs:
                 if not f.done():
@@ -985,8 +997,14 @@ class VerifierScheduler:
             "stage_ms": round((t_dispatch - p.t0) * 1e3, 3),
             "compute_ms": round((t_collect - t_dispatch) * 1e3, 3),
             "total_ms": round((done - oldest) * 1e3, 3),
+            "traces": [],
         }
         with self._lock:
+            # blk/trace linkage: distinct submitter trace ids riding this
+            # window (txpool ingest spans, quorum verifies) — popped here
+            # so the map never outlives its window
+            traces = sorted({t for t in (self._pending_trace.pop(k, None)
+                                         for k in keys) if t})
             for k, r in zip(keys, p.results):
                 self._cache_put(k, r)
             self._stats["batches"] += 1
@@ -1000,6 +1018,8 @@ class VerifierScheduler:
                 lane.stats["straggler_diverts"] += 1
             windows = self._stats["pipeline_windows"]
             overlapped = self._stats["pipeline_overlapped"]
+            flight["traces"] = traces[:4]
+            flight["trace_count"] = len(traces)
             flight["window"] = self._flight_seq
             self._flight_seq += 1
             self._flights.append(flight)
@@ -1032,6 +1052,20 @@ class VerifierScheduler:
             journal.record("verifier_flush", rows=rows, reason=p.reason,
                            occupancy=round(rows / bucket, 4),
                            waited_ms=round(waited * 1e3, 3))
+            # commit-anatomy verify-window interior: the wall-clock
+            # wait/stage/compute split plus lane and trace linkage, so
+            # the critical-path assembler can attribute the admission
+            # leg to scheduler queueing vs device time.  The wall-clock
+            # attrs (and the race-placed lane) are volatile-stripped by
+            # the chaos canonical dump; rows/reason/diverted are pinned
+            # by kick-driven batching and stay in it.
+            journal.record("commit_anatomy", stage="verify_window",
+                           rows=rows, reason=p.reason,
+                           diverted=bool(p.diverted), lane=lane.index,
+                           wait_ms=round(waited * 1e3, 3),
+                           stage_ms=flight["stage_ms"],
+                           compute_ms=flight["compute_ms"],
+                           traces=len(traces))
             if mesh:
                 journal.record("verifier_mesh_dispatch",
                                device=lane.index, rows=rows,

@@ -33,6 +33,7 @@ import json
 
 from eges_tpu.core import rlp
 from eges_tpu.core.types import Block, Transaction
+from eges_tpu.utils.limits import clamp_rpc_limit
 
 # Closed vocabulary of dispatched JSON-RPC methods.  The static-analysis
 # vocabulary rule checks this both ways against the ``method == "..."``
@@ -322,7 +323,7 @@ class RpcServer:
                     trace = p.get("trace")
                 else:
                     limit = int(p)
-            limit = max(1, min(limit, 4096))
+            limit = clamp_rpc_limit(limit)
             spans = tracing.DEFAULT.finished(limit=limit, trace=trace)
             spans.reverse()
             return spans
@@ -346,7 +347,7 @@ class RpcServer:
                     since = int(p.get("since_seq", p.get("since", since)))
                 else:
                     limit = int(p)
-            limit = max(1, min(limit, 4096))
+            limit = clamp_rpc_limit(limit)
             return self.node.journal.events(limit=limit, since=since)
         if method == "thw_flight":
             # verifier window flight recorder (crypto/scheduler.py),
@@ -360,7 +361,7 @@ class RpcServer:
                     limit = int(p.get("limit", limit))
                 else:
                     limit = int(p)
-            limit = max(1, min(limit, 4096))
+            limit = clamp_rpc_limit(limit)
             recorder = getattr(self.chain, "verifier", None)
             flights = getattr(recorder, "flights", None)
             if not callable(flights):

@@ -83,6 +83,14 @@ EVENT_TYPES = frozenset({
     # transitions, journaled so chaos scenarios assert on them and
     # --check-determinism byte-compares the alert stream
     "slo_pending", "slo_firing", "slo_resolved",
+    # commit anatomy (harness/anatomy.py): per-block phase boundaries
+    # emitted at three sites — the txpool's ingest/admit timestamps for
+    # a block's included txns (stage="pool"), the proposer's
+    # election/ack/seal split at seal time (stage="seal"), and one
+    # verify-window interior per computed scheduler window
+    # (stage="verify_window", wall-clock ms + lane; those attrs are
+    # volatile-stripped by the chaos canonical dump)
+    "commit_anatomy",
 })
 
 # The registered ``_breakdown`` phase vocabulary (consensus/node.py);

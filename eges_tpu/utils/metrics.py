@@ -81,6 +81,8 @@ METRIC_FAMILIES = frozenset({
     "telemetry.envelopes", "telemetry.samples",
     # harness/slo.py — burn-rate SLO engine
     "slo.alerts_firing", "slo.transitions",
+    # harness/anatomy.py — commit critical-path assembler
+    "anatomy.blocks",
 })
 
 # One-line help string per registered family, emitted as ``# HELP``
@@ -162,6 +164,7 @@ METRIC_HELP = {
     "telemetry.samples": "Registry samples taken by the telemetry sampler.",
     "slo.alerts_firing": "SLO objectives currently in the firing state.",
     "slo.transitions": "SLO alert state-machine transitions journaled.",
+    "anatomy.blocks": "Committed blocks assembled by the anatomy profiler.",
 }
 
 

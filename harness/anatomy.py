@@ -1,0 +1,363 @@
+"""Commit anatomy: cross-node critical-path attribution for block latency.
+
+Every layer already emits half the story — the txpool stamps when a
+block's transactions were ingested and admitted (``commit_anatomy``
+stage="pool"), the proposer journals its election/ack/seal split at
+seal time (stage="seal"), the verifier scheduler records each window's
+wait/stage/compute interior (stage="verify_window"), and every node's
+``block_committed`` marks when the block landed locally.  This module
+joins them: for every committed block it reconstructs the causal chain
+
+    tx ingest -> admission (verify window) -> election -> ack quorum ->
+    seal -> publish -> cross-node propagation -> last commit
+
+on the virtual/journal clock, extracts the critical path (the phases in
+descending duration), and attributes p50/p99 end-to-end commit latency
+to phases.  The verify-window interior is wall-clock by nature (device
+time is real even under the sim clock) and is reported as a separate
+lane-attributed sub-account rather than mixed into the virtual-time
+phase chain.
+
+Determinism contract: :class:`AnatomyAssembler` is a pure incremental
+function over the event stream — ``harness/collector.py`` feeds it in
+the same sorted ``(ts, node, seq, type)`` order live and in replay, so
+the anatomy section of the collector report stays byte-identical
+between the two.  The :meth:`AnatomyAssembler.dominant` hint (attached
+to firing SLO alerts) uses only virtual-time phases and divert row
+COUNTS, never wall-clock interiors, so chaos ``--check-determinism``
+holds across same-seed runs too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from eges_tpu.utils.metrics import DEFAULT as metrics
+from eges_tpu.utils.metrics import percentile
+
+# phase order of the per-block causal chain (rendering + tables)
+PHASE_ORDER = ("pool_admit", "pool_queue", "election", "ack_quorum",
+               "seal_other", "publish", "propagation")
+
+# bound the per-block detail in reports: aggregates cover every block,
+# the waterfall keeps the newest N
+PER_BLOCK_CAP = 64
+
+# divert share at/above which the verify path (not a macro phase) is
+# named the dominant cause — the circuit-breaker blackout signature
+VERIFY_DIVERT_DOMINANT = 0.5
+
+
+def _order_key(ev: dict) -> tuple:
+    # identical to harness/collector._order_key; duplicated to keep the
+    # assembler importable without pulling the collector's socket deps
+    return (float(ev.get("ts", 0.0)), str(ev.get("node", "")),
+            int(ev.get("seq", 0)), str(ev.get("type", "")))
+
+
+class AnatomyAssembler:
+    """Incremental per-block critical-path state.
+
+    Feed journal events via :meth:`ingest` (sorted order is the
+    caller's job — the collector's barrier flush provides it);
+    :meth:`report` is a pure function of the ingested state.
+    """
+
+    def __init__(self):
+        # blk -> {node: first local commit ts}
+        self._commits: dict[int, dict[str, float]] = {}
+        # blk -> proposer seal split (last writer wins: a re-proposed
+        # block's final successful seal is the one that committed)
+        self._seal: dict[int, dict] = {}
+        # blk -> {node: pool-stage attrs}
+        self._pool: dict[int, dict[str, dict]] = {}
+        # verify-window interior aggregate, per lane (str key for JSON)
+        self._lanes: dict[str, dict] = {}
+
+    # -- ingestion ------------------------------------------------------
+    def ingest(self, ev: dict) -> None:
+        etype = ev.get("type")
+        if etype == "block_committed":
+            blk = ev.get("blk")
+            if not isinstance(blk, int):
+                return
+            node = str(ev.get("node", "?"))
+            per = self._commits.get(blk)
+            if per is None:
+                per = self._commits[blk] = {}
+                metrics.counter("anatomy.blocks").inc()
+            ts = float(ev.get("ts", 0.0))
+            if node not in per:
+                per[node] = ts
+            return
+        if etype != "commit_anatomy":
+            return
+        stage = ev.get("stage")
+        if stage == "seal":
+            blk = ev.get("blk")
+            if isinstance(blk, int):
+                self._seal[blk] = {
+                    "node": str(ev.get("node", "?")),
+                    "t_seal_start": float(ev.get("t_seal_start", 0.0)),
+                    "seal_s": float(ev.get("seal_s", 0.0)),
+                    "election_s": float(ev.get("election_s", 0.0)),
+                    "ack_s": float(ev.get("ack_s", 0.0)),
+                }
+        elif stage == "pool":
+            blk = ev.get("blk")
+            if isinstance(blk, int):
+                self._pool.setdefault(blk, {})[
+                    str(ev.get("node", "?"))] = {
+                    "t_first_ingest": float(ev.get("t_first_ingest", 0.0)),
+                    "t_last_admit": float(ev.get("t_last_admit", 0.0)),
+                    "count": int(ev.get("count", 0)),
+                }
+        elif stage == "verify_window":
+            lane = str(ev.get("lane", "?"))
+            agg = self._lanes.get(lane)
+            if agg is None:
+                agg = self._lanes[lane] = {
+                    "windows": 0, "rows": 0, "eligible_rows": 0,
+                    "diverted_rows": 0,
+                    "wait_ms": 0.0, "stage_ms": 0.0, "compute_ms": 0.0}
+            rows = int(ev.get("rows", 0))
+            agg["windows"] += 1
+            agg["rows"] += rows
+            # singleton windows are host-recovered BY DESIGN (a padded
+            # 1-row device dispatch costs more than one native recover),
+            # healthy device or not — only multi-row windows can tell a
+            # breaker divert from steady state, so only they count
+            # toward the divert share
+            if rows > 1:
+                agg["eligible_rows"] += rows
+            if ev.get("diverted"):
+                agg["diverted_rows"] += rows
+            for k in ("wait_ms", "stage_ms", "compute_ms"):
+                v = ev.get(k)
+                if isinstance(v, (int, float)):
+                    agg[k] += float(v)
+
+    # -- per-block reconstruction ---------------------------------------
+    def _block_record(self, blk: int) -> dict | None:
+        commits = self._commits.get(blk)
+        if not commits:
+            return None
+        t_first = min(commits.values())
+        t_last = max(commits.values())
+        seal = self._seal.get(blk)
+        pool = self._pool.get(blk)
+        phases: dict[str, float] = {}
+        t0 = None
+        t_adm = None
+        if pool:
+            # the proposer's pool view is the critical one (its admitted
+            # set became the block); fall back to the earliest-ingest
+            # entry, ties broken by node name, so the pick never depends
+            # on dict order
+            src = None
+            if seal is not None:
+                src = pool.get(seal["node"])
+            if src is None:
+                src = pool[min(pool, key=lambda n: (
+                    pool[n]["t_first_ingest"], n))]
+            t0 = src["t_first_ingest"]
+            t_adm = src["t_last_admit"]
+            phases["pool_admit"] = max(t_adm - t0, 0.0)
+        if seal is not None:
+            ss = seal["t_seal_start"]
+            if t_adm is not None:
+                phases["pool_queue"] = max(ss - t_adm, 0.0)
+            phases["election"] = max(seal["election_s"], 0.0)
+            phases["ack_quorum"] = max(seal["ack_s"], 0.0)
+            phases["seal_other"] = max(
+                seal["seal_s"] - seal["election_s"] - seal["ack_s"], 0.0)
+            phases["publish"] = max(t_first - (ss + seal["seal_s"]), 0.0)
+            if t0 is None:
+                t0 = ss
+        phases["propagation"] = max(t_last - t_first, 0.0)
+        if t0 is None:
+            t0 = t_first
+        e2e = max(t_last - t0, 0.0)
+        rec = {
+            "blk": blk,
+            "e2e_s": round(e2e, 6),
+            "phases": {k: round(v, 6) for k, v in phases.items()},
+            "critical_path": [k for k, _ in sorted(
+                phases.items(), key=lambda kv: (-kv[1], kv[0]))],
+            "commits": len(commits),
+        }
+        if seal is not None:
+            rec["proposer"] = seal["node"]
+        return rec
+
+    # -- export ---------------------------------------------------------
+    def verify_summary(self) -> dict:
+        lanes = {}
+        windows = rows = eligible = diverted = 0
+        wait = stage = compute = 0.0
+        for lane in sorted(self._lanes):
+            agg = self._lanes[lane]
+            lanes[lane] = {
+                "windows": agg["windows"], "rows": agg["rows"],
+                "eligible_rows": agg["eligible_rows"],
+                "diverted_rows": agg["diverted_rows"],
+                "wait_ms": round(agg["wait_ms"], 3),
+                "stage_ms": round(agg["stage_ms"], 3),
+                "compute_ms": round(agg["compute_ms"], 3),
+            }
+            windows += agg["windows"]
+            rows += agg["rows"]
+            eligible += agg["eligible_rows"]
+            diverted += agg["diverted_rows"]
+            wait += agg["wait_ms"]
+            stage += agg["stage_ms"]
+            compute += agg["compute_ms"]
+        return {
+            "windows": windows, "rows": rows,
+            "eligible_rows": eligible, "diverted_rows": diverted,
+            "divert_share": (round(diverted / eligible, 4)
+                             if eligible else 0.0),
+            "wait_ms": round(wait, 3), "stage_ms": round(stage, 3),
+            "compute_ms": round(compute, 3), "lanes": lanes,
+        }
+
+    def report(self) -> dict:
+        records = []
+        for blk in sorted(self._commits):
+            rec = self._block_record(blk)
+            if rec is not None:
+                records.append(rec)
+        e2e = sorted(r["e2e_s"] for r in records)
+        totals: dict[str, float] = {}
+        for r in records:
+            for k, v in r["phases"].items():
+                totals[k] = totals.get(k, 0.0) + v
+        total_e2e = sum(e2e)
+        phases = {}
+        for k in PHASE_ORDER:
+            if k in totals:
+                phases[k] = {
+                    "total_s": round(totals[k], 6),
+                    "share": (round(totals[k] / total_e2e, 4)
+                              if total_e2e > 0 else 0.0),
+                }
+        return {
+            "blocks": len(records),
+            "per_block": records[-PER_BLOCK_CAP:],
+            "phases": phases,
+            "commit_p50_ms": (round(percentile(e2e, 50.0) * 1e3, 3)
+                              if e2e else None),
+            "commit_p99_ms": (round(percentile(e2e, 99.0) * 1e3, 3)
+                              if e2e else None),
+            "verify": self.verify_summary(),
+            "dominant": self.dominant(),
+        }
+
+    def dominant(self) -> dict | None:
+        """The single phase to blame right now, or None without data.
+
+        Deterministic by construction: the verify-divert test uses row
+        COUNTS (pinned by kick-driven batching under the sim), the
+        macro comparison uses virtual-time phase totals — never the
+        wall-clock window interiors."""
+        rows = sum(a["eligible_rows"] for a in self._lanes.values())
+        diverted = sum(a["diverted_rows"] for a in self._lanes.values())
+        if rows and diverted / rows >= VERIFY_DIVERT_DOMINANT:
+            lane = min(
+                (la for la in self._lanes
+                 if self._lanes[la]["diverted_rows"] > 0),
+                key=lambda la: (-self._lanes[la]["diverted_rows"], la),
+                default="?")
+            return {"phase": "verify_divert",
+                    "share": round(diverted / rows, 4), "lane": lane}
+        totals: dict[str, float] = {}
+        total_e2e = 0.0
+        for blk in sorted(self._commits):
+            rec = self._block_record(blk)
+            if rec is None:
+                continue
+            total_e2e += rec["e2e_s"]
+            for k, v in rec["phases"].items():
+                totals[k] = totals.get(k, 0.0) + v
+        if not totals or total_e2e <= 0:
+            return None
+        name = max(sorted(totals), key=lambda k: totals[k])
+        return {"phase": name,
+                "share": round(totals[name] / total_e2e, 4)}
+
+
+def assemble(by_node: dict[str, list[dict]]) -> dict:
+    """Offline anatomy over merged journal streams (the shape
+    ``SimCluster.journals()`` / ``observatory.load_journals`` produce).
+    Events feed in the same sorted order the live collector uses, so a
+    replayed report byte-matches the live one."""
+    asm = AnatomyAssembler()
+    merged: list[dict] = []
+    for name in sorted(by_node):
+        merged.extend(e for e in by_node[name] if isinstance(e, dict))
+    for ev in sorted(merged, key=_order_key):
+        asm.ingest(ev)
+    return asm.report()
+
+
+def _selftest() -> int:
+    """Fast determinism smoke for ``make check``: two assembler passes
+    over the same journals (one through a JSON round-trip) must
+    byte-match, and a sim short enough for CI must yield blocks."""
+    from eges_tpu.sim.cluster import SimCluster
+
+    cluster = SimCluster(4, seed=0, txn_per_block=4, txpool=True)
+    cluster.start()
+    cluster.run(600.0, stop_condition=lambda: cluster.min_height() >= 3)
+    for sn in cluster.nodes:
+        sn.node.stop()
+    by_node = cluster.journals()
+    pass1 = json.dumps(assemble(by_node), sort_keys=True)
+    pass2 = json.dumps(assemble(json.loads(json.dumps(by_node))),
+                       sort_keys=True)
+    rep = json.loads(pass1)
+    if pass1 != pass2:
+        print("anatomy selftest: FAIL (passes differ)")
+        return 1
+    if not rep["blocks"] or rep["commit_p99_ms"] is None:
+        print("anatomy selftest: FAIL (no committed blocks assembled)")
+        return 1
+    print(f"anatomy selftest: OK ({rep['blocks']} blocks, "
+          f"p99 {rep['commit_p99_ms']} ms, "
+          f"dominant {rep['dominant']['phase']})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-block commit-latency critical-path attribution")
+    ap.add_argument("--replay", metavar="DIR",
+                    help="assemble from a journal dump directory "
+                         "(observatory --dump format)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw report as JSON")
+    ap.add_argument("--selftest", action="store_true",
+                    help="fast determinism smoke (make check)")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.replay:
+        ap.error("--replay DIR or --selftest required")
+    from harness.observatory import load_journals, render_anatomy
+    rep = assemble(load_journals(args.replay))
+    if args.json:
+        print(json.dumps(rep, indent=2, sort_keys=True))
+    else:
+        print(render_anatomy(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -65,6 +65,17 @@ VOLATILE_KEYS = {
     # sample's EXISTENCE and step number are protocol, its values are
     # measurements
     "telemetry_sample": ("metrics",),
+    # the verify_window stage mirrors verifier_flush plus wall-clock
+    # interiors and a thread-race-dependent lane pick; the pool/seal
+    # stages are fully virtual-time and keep every attribute (they never
+    # carry these keys).  "trace"/"traces" are os.urandom-derived span
+    # linkage — observability-only, never protocol.
+    "commit_anatomy": ("wait_ms", "stage_ms", "compute_ms", "lane",
+                       "trace", "traces"),
+    # the dominant-phase hint on a firing alert can name a lane (racy
+    # under mesh dispatch) and a share derived from wall-clock-adjacent
+    # aggregates — the FIRING itself is the protocol content
+    "slo_firing": ("phase", "phase_share", "lane"),
 }
 
 
@@ -477,6 +488,79 @@ def _scn_calm_baseline(seed: int, fast: bool) -> dict:
     return res
 
 
+def _scn_commit_attribution(seed: int, fast: bool) -> dict:
+    """The commit-anatomy profiler must blame the fault we injected:
+    a partition hold-back makes cross-node propagation the dominant
+    phase, a verifier blackout makes the divert path dominant — both
+    verdicts byte-deterministic across same-seed runs."""
+    from harness import anatomy as anatomy_mod
+
+    # part A: isolate node3, then heal — its catch-up commits stretch
+    # cross-node propagation (t_last_commit - t_first_commit) far past
+    # every other phase of the partition-era blocks
+    heal_t = 30.0 if fast else 60.0
+    cluster = SimCluster(4, seed=seed, txn_per_block=5, txpool=True)
+    inj = FaultInjector(cluster)
+    inj.apply(FaultPlan()
+              .partition(2.0, "node3")
+              .heal(heal_t, "node3"))
+    cluster.start()
+    cluster.run(heal_t + 1.0)
+    res = _finish("commit_attribution", seed, cluster,
+                  extra_blocks=3, bound_s=240.0, checks={})
+    part = anatomy_mod.assemble(res["journals"])
+    dom_part = part.get("dominant") or {}
+
+    # part B: same blackout shape as verifier_blackout, never healed —
+    # every window fails over host-side, so the assembler's divert-share
+    # test must name the verify path (with its lane), not a macro phase
+    from eges_tpu.crypto.scheduler import VerifierScheduler
+    from eges_tpu.crypto.verify_host import NativeBatchVerifier
+
+    # long window => flushes are kick-driven only (deterministic rows);
+    # a huge cooldown pins the breaker open for the whole run
+    sched = VerifierScheduler(NativeBatchVerifier(), window_ms=10_000.0,
+                              breaker_cooldown_s=1e9)
+    cluster_b = SimCluster(4, seed=seed, verifier=sched, signed=True)
+    sched.breaker_clock = cluster_b.clock.now
+
+    def _dead_device(rows: int) -> None:
+        raise RuntimeError("device lost (injected blackout)")
+
+    sched.failure_hook = _dead_device
+    FaultInjector(cluster_b)         # journals the (empty) fault plan
+    cluster_b.start()
+    blocks = 3 if fast else 5
+    cluster_b.run(600.0,
+                  stop_condition=lambda: cluster_b.min_height() >= blocks)
+    for sn in cluster_b.live_nodes():
+        sn.node.stop()
+    sched.close()
+    journals_b = cluster_b.journals()
+    blackout = anatomy_mod.assemble(journals_b)
+    dom_black = blackout.get("dominant") or {}
+
+    # fold part B's streams into the dump under a distinct prefix so
+    # --check-determinism byte-compares BOTH attributions
+    res["journals"].update(
+        {"blackout.%s" % name: evs for name, evs in journals_b.items()})
+    res["anatomy"] = {
+        "partition_dominant": dom_part,
+        "blackout_dominant": dom_black,
+        "blackout_divert_share": blackout["verify"]["divert_share"],
+    }
+    checks = {
+        "propagation_blamed": dom_part.get("phase") == "propagation",
+        "blackout_diverted":
+            blackout["verify"]["divert_share"] >= 0.5,
+        "verify_divert_blamed":
+            dom_black.get("phase") == "verify_divert",
+    }
+    res["checks"].update(checks)
+    res["ok"] = bool(res["ok"] and all(checks.values()))
+    return res
+
+
 def _scn_combo(seed: int, fast: bool) -> dict:
     """The acceptance storm: leader-kill + 20% loss + an asymmetric
     partition, all at once, then heal everything.  Live nodes must
@@ -513,6 +597,7 @@ SCENARIOS = {
     "verifier_blackout": _scn_verifier_blackout,
     "mesh_device_blackout": _scn_mesh_device_blackout,
     "calm_baseline": _scn_calm_baseline,
+    "commit_attribution": _scn_commit_attribution,
     "combo": _scn_combo,
 }
 
@@ -565,6 +650,14 @@ def render_result(res: dict) -> str:
             s["alerts_fired"], s["compliance_ratio"],
             "  ".join("%s=%s" % (k, v)
                       for k, v in sorted(s["alert_states"].items()))))
+    if "anatomy" in res:
+        a = res["anatomy"]
+        out.append("  anatomy: partition blames %s (%.2f%%)  "
+                   "blackout blames %s (divert share %.4f)" % (
+                       a["partition_dominant"].get("phase", "?"),
+                       a["partition_dominant"].get("share", 0.0) * 100.0,
+                       a["blackout_dominant"].get("phase", "?"),
+                       a["blackout_divert_share"]))
     if "flight_stragglers" in res:
         out.append("  flight stragglers: %s" % (
             ", ".join(str(d) for d in res["flight_stragglers"])

@@ -28,6 +28,7 @@ import threading
 
 from eges_tpu.utils.metrics import DEFAULT as metrics
 from eges_tpu.utils.timeseries import SeriesStore, fold_payload
+from harness.anatomy import AnatomyAssembler
 from harness.slo import SLOEngine
 
 
@@ -53,6 +54,12 @@ class ClusterCollector:
         if objectives is not None:
             kw["objectives"] = objectives
         self.slo = SLOEngine(**kw)
+        # commit-anatomy fold rides the same sorted barrier flush as the
+        # SLO engine, so the anatomy section of the report keeps the
+        # live/replay byte-identity; firing alerts pull their dominant
+        # phase from the state folded so far
+        self.anatomy = AnatomyAssembler()
+        self.slo.phase_hint = self.anatomy.dominant
         self._buffer: list[dict] = []
         self._event_counts: dict[str, int] = {}
         self.envelopes = 0
@@ -97,6 +104,7 @@ class ClusterCollector:
             self._buffer = [e for e in self._buffer
                             if float(e.get("ts", 0.0)) >= before_ts]
         for ev in sorted(ready, key=_order_key):
+            self.anatomy.ingest(ev)
             self.slo.ingest(ev)
 
     def _step(self, sample: dict, ts: float) -> None:
@@ -132,6 +140,7 @@ class ClusterCollector:
             "alert_states": self.slo.alert_states(),
             "compliance_ratio": round(self.slo.compliance_ratio, 6),
             "alerts_fired": self.slo.fired_total,
+            "anatomy": self.anatomy.report(),
         }
 
     def report_json(self) -> str:

@@ -41,12 +41,13 @@ if _REPO not in sys.path:
 
 from eges_tpu.utils import journal as journal_mod
 from eges_tpu.utils.metrics import percentile
+from harness import anatomy as anatomy_mod
 
 # Event types this report consumes; the lint test asserts this is a
 # subset of journal.EVENT_TYPES so parser and emit sites cannot drift.
 CONSUMED = ("election_started", "election_won", "election_lost",
             "validate_quorum", "version_bump", "block_committed",
-            "block_confirmed",
+            "block_confirmed", "commit_anatomy",
             "fault_crash", "fault_restart", "fault_partition",
             "fault_heal", "fault_link", "fault_net", "fault_skew",
             "fault_trigger", "fault_breaker", "verifier_mesh_dispatch",
@@ -119,10 +120,18 @@ def summarize(by_node: dict[str, list[dict]],
     # telemetry sampler heartbeats, merged across streams
     slo_alerts: list[tuple] = []
     telemetry_samples: dict[str, int] = {}
+    # forward compatibility: journals written by a NEWER build may carry
+    # event types this parser has never heard of — count and skip them
+    # instead of letting a per-type branch trip over missing attrs
+    unknown_events: dict[str, int] = {}
 
     for name in sorted(by_node):
         for ev in by_node[name]:
             typ = ev.get("type")
+            if typ not in journal_mod.EVENT_TYPES:
+                key = str(typ)
+                unknown_events[key] = unknown_events.get(key, 0) + 1
+                continue
             blk = ev.get("blk")
             if typ == "telemetry_sample":
                 telemetry_samples[name] = telemetry_samples.get(name, 0) + 1
@@ -256,6 +265,9 @@ def summarize(by_node: dict[str, list[dict]],
         "telemetry_samples": {
             name: telemetry_samples[name]
             for name in sorted(telemetry_samples)},
+        "unknown_events": {
+            typ: unknown_events[typ] for typ in sorted(unknown_events)},
+        "anatomy": anatomy_mod.assemble(by_node),
     }
 
 
@@ -327,6 +339,80 @@ def render_flights(flights: list[dict], width: int = 40) -> str:
     stragglers = flight_straggler_lanes(rows)
     out.append("  stragglers: %s   (* diverted, ? breaker probe)" % (
         ", ".join(str(d) for d in stragglers) if stragglers else "-"))
+    return "\n".join(out)
+
+
+# -- commit anatomy -------------------------------------------------------
+
+# one glyph per macro phase in the per-block waterfall bars
+_PHASE_GLYPH = {"pool_admit": "a", "pool_queue": "q", "election": "e",
+                "ack_quorum": "k", "seal_other": "s", "publish": "p",
+                "propagation": "~"}
+
+
+def render_anatomy(rep: dict, width: int = 40,
+                   max_blocks: int = 8) -> str:
+    """Text view of an anatomy report (``AnatomyAssembler.report`` /
+    ``anatomy.assemble``): phase-attribution table, per-block waterfall
+    of the newest blocks, verify-lane sub-account, and the dominant
+    verdict line."""
+    out = ["commit anatomy — %d block(s)" % rep.get("blocks", 0)]
+    if not rep.get("blocks"):
+        out.append("  (no committed blocks assembled)")
+        return "\n".join(out)
+
+    def _ms(v) -> str:
+        return "-" if v is None else "%.3f ms" % v
+
+    out.append("  commit e2e: p50 %s  p99 %s" % (
+        _ms(rep.get("commit_p50_ms")), _ms(rep.get("commit_p99_ms"))))
+    phases = rep.get("phases", {})
+    if phases:
+        out.append("  phase attribution (share of total e2e):")
+        for name in anatomy_mod.PHASE_ORDER:
+            d = phases.get(name)
+            if d is None:
+                continue
+            bar = "#" * int(round(d["share"] * width))
+            out.append("    %-12s %8.3f s  %6.2f%%  %s" % (
+                name, d["total_s"], d["share"] * 100.0, bar))
+    blocks = rep.get("per_block", [])[-max_blocks:]
+    if blocks:
+        out.append("  per-block waterfall (newest %d; %s):" % (
+            len(blocks), " ".join(
+                "%s=%s" % (_PHASE_GLYPH[p], p)
+                for p in anatomy_mod.PHASE_ORDER)))
+        for r in blocks:
+            e2e = r.get("e2e_s", 0.0) or 0.0
+            bar = ""
+            if e2e > 0:
+                for p in anatomy_mod.PHASE_ORDER:
+                    v = r.get("phases", {}).get(p, 0.0)
+                    bar += _PHASE_GLYPH[p] * int(round(v / e2e * width))
+            crit = r.get("critical_path", [])
+            out.append("    blk %-4s [%-*s] %9.6f s  crit: %s" % (
+                r.get("blk", "?"), width, bar[:width], e2e,
+                " > ".join(crit[:3]) if crit else "-"))
+    verify = rep.get("verify", {})
+    if verify.get("windows"):
+        out.append(
+            "  verify windows (wall-clock sub-account): %d window(s)  "
+            "%d rows  divert share %.4f" % (
+                verify["windows"], verify["rows"],
+                verify["divert_share"]))
+        for lane, d in sorted(verify.get("lanes", {}).items()):
+            out.append(
+                "    lane %-3s %4d window(s)  %6d rows  "
+                "wait %8.3f ms  stage %8.3f ms  compute %8.3f ms%s" % (
+                    lane, d["windows"], d["rows"], d["wait_ms"],
+                    d["stage_ms"], d["compute_ms"],
+                    "  [diverted %d]" % d["diverted_rows"]
+                    if d["diverted_rows"] else ""))
+    dom = rep.get("dominant")
+    if dom:
+        lane = ("  (lane %s)" % dom["lane"]) if "lane" in dom else ""
+        out.append("  dominant: %s at %.2f%% of commit latency%s" % (
+            dom["phase"], dom["share"] * 100.0, lane))
     return "\n".join(out)
 
 
@@ -446,6 +532,12 @@ def render(summary: dict, net: dict | None = None) -> str:
                 "      %12.6f  %s %s  burn fast %.2f / slow %.2f" % (
                     r["ts"], r["type"].removeprefix("slo_"),
                     r["objective"], r["burn_fast"], r["burn_slow"]))
+    if summary.get("unknown_events"):
+        out.append("  unknown event types (skipped): " + "  ".join(
+            "%s %d" % (typ, n)
+            for typ, n in summary["unknown_events"].items()))
+    if summary.get("anatomy") is not None:
+        out.append(render_anatomy(summary["anatomy"]))
     return "\n".join(out)
 
 

@@ -100,6 +100,13 @@ class SLOEngine:
         self._now = 0.0
         self.journal = journal if journal is not None else Journal(
             "slo", clock=lambda: self._now)
+        # optional commit-anatomy hook (harness/anatomy.py): a callable
+        # returning {"phase", "share"[, "lane"]} or None.  When set (the
+        # collector wires its assembler's ``dominant``), every firing
+        # transition carries the phase currently dominating commit
+        # latency — "commit_latency firing: 61% in verify_divert,
+        # lane 0" instead of a bare burn rate.
+        self.phase_hint = None
         # routing state
         self._max_blk = -1
         self._last_commit_ts: float | None = None
@@ -225,9 +232,19 @@ class SLOEngine:
     def _transition(self, etype: str, objective: str, fast: float,
                     slow: float) -> dict:
         metrics.counter("slo.transitions").inc()
+        extra: dict = {}
+        if etype == "slo_firing" and self.phase_hint is not None:
+            hint = self.phase_hint()
+            if isinstance(hint, dict) and hint.get("phase"):
+                extra["phase"] = hint["phase"]
+                share = hint.get("share")
+                if isinstance(share, (int, float)):
+                    extra["phase_share"] = round(float(share), 4)
+                if "lane" in hint:
+                    extra["lane"] = hint["lane"]
         return self.journal.record(
             etype, objective=objective, burn_fast=round(fast, 4),
-            burn_slow=round(slow, 4))
+            burn_slow=round(slow, 4), **extra)
 
     # -- export ---------------------------------------------------------
     def alert_states(self) -> dict[str, str]:

@@ -160,6 +160,24 @@ def test_collector_live_report_byte_identical_to_replay():
     assert "telemetry.samples" in series
     assert len(series["telemetry.samples"]) == len(samples)
 
+    # the commit-anatomy section folded on the same sorted barrier
+    # flush: per-block phase chains assembled, identical in the replay
+    anatomy = col.report()["anatomy"]
+    assert anatomy["blocks"] >= 4
+    assert anatomy["commit_p50_ms"] is not None
+    assert anatomy["commit_p99_ms"] >= anatomy["commit_p50_ms"]
+    assert anatomy["phases"]  # election/ack/propagation attribution
+    assert anatomy == replay.report()["anatomy"]
+    for rec in anatomy["per_block"]:
+        assert rec["critical_path"], rec
+        assert all(v >= 0.0 for v in rec["phases"].values())
+        durs = [rec["phases"][p] for p in rec["critical_path"]]
+        assert durs == sorted(durs, reverse=True)
+    # the firing-alert phase hint is wired (calm run: no firing, but
+    # the hook itself must point at the collector's own assembler)
+    assert col.slo.phase_hint == col.anatomy.dominant
+    assert col.anatomy.dominant() is not None
+
 
 def test_collector_server_socket_ingest():
     from harness.collector import ClusterCollector, CollectorServer
@@ -286,6 +304,13 @@ def test_observatory_empty_series_and_slo_sections():
     assert "p50 - ms" in text and "None" not in text
     assert empty["election"]["p50_ms"] is None
     assert empty["commit_lag"] == {} and empty["stalls"] == []
+    # the anatomy section degrades the same way: zero blocks renders a
+    # placeholder line, never a crash or a None
+    assert empty["anatomy"]["blocks"] == 0
+    assert empty["anatomy"]["commit_p99_ms"] is None
+    assert "no committed blocks assembled" in text
+    assert "no committed blocks assembled" in observatory.render_anatomy(
+        empty["anatomy"])
 
     # SLO transitions and telemetry heartbeats land in the summary
     evs = [
@@ -317,3 +342,33 @@ def test_observatory_empty_series_and_slo_sections():
         + [{"device": 2, "diverted": True, "total_ms": 1.0}])
     assert observatory.flight_straggler_lanes(flights) == [1, 2]
     assert observatory.flight_straggler_lanes([]) == []
+
+
+def test_observatory_skips_and_counts_unknown_event_types():
+    """Forward compatibility: journals written by a newer build carry
+    event types this parser has never heard of — they are counted and
+    skipped, never parsed (so a missing attr cannot crash the report),
+    and known events around them still land."""
+    evs = [
+        {"type": "block_committed", "ts": 1.0, "node": "n0", "seq": 0,
+         "blk": 1},
+        # future event: no attrs a per-type branch could expect
+        {"type": "quantum_entangled_commit", "ts": 1.5, "node": "n0",
+         "seq": 1},
+        {"type": "quantum_entangled_commit", "ts": 1.6, "node": "n0",
+         "seq": 2, "blk": None},
+        {"type": None, "ts": 1.7, "node": "n0", "seq": 3},
+        {"type": "block_committed", "ts": 2.0, "node": "n0", "seq": 4,
+         "blk": 2},
+    ]
+    s = observatory.summarize({"n0": evs})
+    assert s["blocks"] == 2
+    assert s["unknown_events"] == {"None": 1,
+                                   "quantum_entangled_commit": 2}
+    text = observatory.render(s)
+    assert "unknown event types (skipped): " in text
+    assert "quantum_entangled_commit 2" in text
+    # a fully-known stream reports the section empty and renders no line
+    clean = observatory.summarize({"n0": evs[:1]})
+    assert clean["unknown_events"] == {}
+    assert "unknown event types" not in observatory.render(clean)
