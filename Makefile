@@ -3,9 +3,9 @@
 # a fresh clone with no remote), then the fast test suite.
 BASE := $(shell git rev-parse --verify -q origin/main || echo HEAD)
 
-.PHONY: check analyze test anatomy-smoke
+.PHONY: check analyze test anatomy-smoke ledger-smoke
 
-check: analyze test anatomy-smoke
+check: analyze test anatomy-smoke ledger-smoke
 
 analyze:
 	python -m harness.analysis --github --diff $(BASE)
@@ -17,3 +17,9 @@ test:
 # same sim journals must byte-match (harness/anatomy.py --selftest)
 anatomy-smoke:
 	JAX_PLATFORMS=cpu python -m harness.anatomy --selftest
+
+# fast determinism smoke: two ingress-ledger assembler passes over the
+# same flood-sim journals must byte-match, with the injected client's
+# rejects attributed (eges_tpu/utils/ledger.py --selftest)
+ledger-smoke:
+	JAX_PLATFORMS=cpu python -m eges_tpu.utils.ledger --selftest

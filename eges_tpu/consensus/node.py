@@ -47,6 +47,7 @@ from eges_tpu.consensus.working_block import (
     WB_CURRENT, WB_FUTURE, WB_PASSED,
 )
 from eges_tpu.core.chain import BlockChain
+from eges_tpu.utils import ledger
 from eges_tpu.utils import tracing
 from eges_tpu.core.types import (
     Block, ConfirmBlockMsg, Header, QueryBlockMsg, Registration, Transaction,
@@ -93,6 +94,11 @@ class GeecNode:
         from eges_tpu.utils.journal import Journal
         self.journal = Journal(node=self.coinbase.hex()[:8],
                                clock=clock.now)
+        # ingress provenance ledger (utils/ledger.py): per-origin decayed
+        # cost counters charged by every layer this node drives — the
+        # entry points below bind it as the ambient charge target, and
+        # each committed block journals one ingress_ledger snapshot
+        self.ledger = ledger.IngressLedger(clock=clock.now)
         # a VerifierScheduler (crypto/scheduler.py) journals its flush
         # decisions; a cluster-shared scheduler lands in the stream of
         # the FIRST node that adopts it (the device owner's view)
@@ -333,7 +339,12 @@ class GeecNode:
 
     def on_gossip(self, data: bytes) -> None:
         ctx, data = tracing.extract(data)
-        with self._lock, tracing.DEFAULT.activate(ctx):
+        # ingress provenance: every cost this datagram incurs (pool
+        # admits/rejects, verifier rows, deferred/duplicate drops) bills
+        # to the delivering peer stamped by the transport fabric
+        src = ledger.current_peer()
+        with self._lock, tracing.DEFAULT.activate(ctx), \
+                ledger.bind(self.ledger, f"peer:{src}" if src else "net"):
             self._on_gossip(data)
 
     def _on_gossip(self, data: bytes) -> None:
@@ -377,7 +388,9 @@ class GeecNode:
 
     def on_direct(self, data: bytes) -> None:
         ctx, data = tracing.extract(data)
-        with self._lock, tracing.DEFAULT.activate(ctx):
+        src = ledger.current_peer()
+        with self._lock, tracing.DEFAULT.activate(ctx), \
+                ledger.bind(self.ledger, f"peer:{src}" if src else "net"):
             self._on_direct(data)
 
     def _on_direct(self, data: bytes) -> None:
@@ -423,6 +436,9 @@ class GeecNode:
     # defer a thunk until the working block reaches ``blk`` (Wait analogue)
     def _defer(self, blk: int, thunk) -> None:
         self._deferred.append((blk, thunk))
+        # a deferred message is buffered work the sender imposed on us —
+        # billed to the ambient ingress origin (no-op on internal paths)
+        ledger.charge(deferred=1)
         from eges_tpu.utils.metrics import DEFAULT as metrics
         metrics.gauge("consensus.deferred_depth").set(len(self._deferred))
 
@@ -1096,7 +1112,7 @@ class GeecNode:
         core/tx_pool.go journal); admitted txns are broadcast via the
         pool's admission hook."""
         txns = list(txns)
-        with self._lock:
+        with self._lock, ledger.bind(self.ledger, "rpc"):
             if self.txpool is not None:
                 self._ensure_pool_relay()
                 self.txpool.add_locals(txns)
@@ -1115,6 +1131,11 @@ class GeecNode:
 
     def _handle_txns(self, msg: M.TxnsMsg) -> None:
         fresh = [t for t in msg.txns if t.hash not in self._txn_seen]
+        dupes = len(msg.txns) - len(fresh)
+        if dupes:
+            # relay-once dedup drops: re-gossiped txns billed to the
+            # peer that delivered this redundant copy
+            ledger.charge(drops=dupes)
         if not fresh:
             return
         if self.txpool is not None:
@@ -1788,6 +1809,10 @@ class GeecNode:
         self.unconfirmed.append(blk)
         if not replay:
             self._last_commit_t = self.clock.now()
+            # per-block ingress provenance snapshot: one ingress_ledger
+            # event when anything was charged since the last block —
+            # the SLO engine keys on its admit/reject deltas
+            self.ledger.journal_snapshot(self.journal, blk=blk.number)
         confidence = blk.confirm.confidence if blk.confirm else 0
         if confidence > CONFIDENCE_THRESHOLD:
             self._handle_confirmed_tail(blk)

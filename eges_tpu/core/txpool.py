@@ -19,6 +19,7 @@ from __future__ import annotations
 import threading
 
 from eges_tpu.core.types import Transaction
+from eges_tpu.utils import ledger
 from eges_tpu.utils import tracing
 
 
@@ -72,6 +73,12 @@ class TxPool:
         # ``_ingest_ctx``: entries die at eviction.
         self._ingest_t: dict[bytes, float] = {}
         self._admit_t: dict[bytes, float] = {}
+        # ingress-provenance linkage: per-txn (ledger, origin) captured
+        # at ingest (utils/ledger.py ambient context) — the window flush
+        # runs on a clock callback where the ambient binding is gone, so
+        # admit/reject outcomes charge the captured pair.  Same cap
+        # discipline as ``_ingest_ctx``; entries pop at their outcome.
+        self._ingest_origin: dict[bytes, tuple] = {}
         # consensus event journal (utils/journal.py), attached by the
         # owning GeecNode; distinct from the RLP txn journal above
         self.event_journal = None
@@ -95,6 +102,9 @@ class TxPool:
                 h = t.hash
                 if h in self._known:
                     self.stats["duplicate"] += 1
+                    # ambient charge: a re-delivered txn is pure waste
+                    # billed to whoever delivered THIS copy
+                    ledger.charge(drops=1)
                     continue
                 self._known.add(h)
                 self._queue.append(t)
@@ -102,6 +112,10 @@ class TxPool:
                     self._ingest_ctx[h] = ctx
                 if len(self._ingest_t) < self._INGEST_CTX_CAP:
                     self._ingest_t[h] = self.clock.now()
+                rec = ledger.current()
+                if rec is not None and \
+                        len(self._ingest_origin) < self._INGEST_CTX_CAP:
+                    self._ingest_origin[h] = rec
                 fresh += 1
             sp.set_attr("fresh", fresh)
             if len(self._queue) >= self.max_batch:
@@ -141,10 +155,22 @@ class TxPool:
         for t, sender in zip(batch, senders):
             if sender is None:
                 self.stats["rejected"] += 1
+                # invalid signature: the cheap-reject path an ingress
+                # flood rides — billed to the captured ingest origin
+                self._ledger_charge(t.hash, rejects=1)
                 continue
             self._admit(t, sender)
         if self._queue:
             self._flush()
+
+    def _ledger_charge(self, h: bytes, **counts) -> None:
+        """Charge a flush outcome to the origin captured at ingest (the
+        flush runs on a clock callback with no ambient binding); falls
+        back to the ambient pair, no-op when neither exists."""
+        rec = self._ingest_origin.pop(h, None) or ledger.current()
+        if rec is not None:
+            led, origin = rec
+            led.charge(origin, **counts)
 
     # a replacement for a (sender, nonce) slot must bid >= 10% more gas
     # price (ref: core/tx_pool.go PriceBump default 10)
@@ -168,6 +194,7 @@ class TxPool:
             # keeps the pool size constant and must stay possible even
             # when full (ref: core/tx_pool.go admits replacements)
             self.stats["rejected"] += 1
+            self._ledger_charge(t.hash, rejects=1, sender=sender)
             sp.set_attr("outcome", "rejected")
             if not by_nonce:
                 del self.pending[sender]
@@ -176,6 +203,7 @@ class TxPool:
             # price-bump replacement (ref: core/tx_pool.go:571+)
             if t.gas_price * 100 < old.gas_price * (100 + self.PRICE_BUMP_PCT):
                 self.stats["duplicate"] += 1
+                self._ledger_charge(t.hash, drops=1, sender=sender)
                 sp.set_attr("outcome", "duplicate")
                 return
             self._by_hash.pop(old.hash, None)
@@ -188,6 +216,7 @@ class TxPool:
             self._admit_t[t.hash] = self.clock.now()
         self._maybe_compact()
         self.stats["admitted"] += 1
+        self._ledger_charge(t.hash, admits=1, sender=sender)
         self._depth_gauge()
         sp.set_attr("outcome", "admitted")
         if self.on_admitted is not None:
@@ -278,6 +307,7 @@ class TxPool:
             self._ingest_ctx.pop(t.hash, None)
             self._ingest_t.pop(t.hash, None)
             self._admit_t.pop(t.hash, None)
+            self._ingest_origin.pop(t.hash, None)
         self._maybe_compact()
         self._depth_gauge()
 

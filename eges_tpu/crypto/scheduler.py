@@ -83,6 +83,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from eges_tpu.crypto.bucketing import bucket_round
+from eges_tpu.utils import ledger
 
 # sentinel distinguishing "cached None" (a signature that verifiably
 # fails recovery) from "not cached"
@@ -217,12 +218,25 @@ class VerifierScheduler:
         # the ingest-context map; entries pop when their window records.
         self._pending_trace: dict[tuple, str] = {}
         self._PENDING_TRACE_CAP = 8192
+        # key -> (ledger, origin) captured at submit (utils/ledger.py):
+        # the window executes on the dispatch/lane thread where the
+        # submitter's ambient binding is gone, so each row's share of
+        # the window cost charges the captured pair when it records.
+        # Same cap discipline as the trace map; entries pop with their
+        # window (in-flight dedup keeps the FIRST submitter's origin).
+        self._pending_origin: dict[tuple, tuple] = {}
+        # cache-served rows since the last recorded window: cache hits
+        # never reach a window, so without this the flight rows (and the
+        # cheap-reject cost math over them) under-count a warm-cache
+        # flood as free — drained into flight["cache_rows"]
+        self._cache_rows_pending = 0
         self._kick = False
         self._closed = False
         self._admission_done = False  # set once the dispatch loop exits
         self._thread: threading.Thread | None = None
         self._stats = {
-            "cache_hits": 0, "cache_misses": 0, "coalesced_rows": 0,
+            "cache_hits": 0, "cache_misses": 0, "cache_served_rows": 0,
+            "coalesced_rows": 0,
             "batches": 0, "rows": 0, "bucket_rows": 0, "host_diverted": 0,
             "kicks": 0, "flush_full": 0, "flush_deadline": 0,
             "flush_kick": 0, "flush_close": 0, "invalid": 0,
@@ -262,6 +276,10 @@ class VerifierScheduler:
             # same observable result, no batch slot burned)
             with self._lock:
                 self._stats["invalid"] += 1
+            # invalid-sig early-out: billed to the ambient ingress
+            # origin (utils/ledger.py) — the cheapest reject there is,
+            # which is exactly why a flood of them must stay attributed
+            ledger.charge(rejects=1)
             fut.set_result(None)
             return fut
         key = (bytes(sighash), bytes(sig))
@@ -271,6 +289,12 @@ class VerifierScheduler:
             if hit is not _MISS:
                 self._cache.move_to_end(key)
                 self._stats["cache_hits"] += 1
+                # a cache-served row is still a served row: without this
+                # accounting a 100% warm-cache flood looks free in
+                # stats()/flight rows (drained into the next window's
+                # flight entry as cache_rows)
+                self._stats["cache_served_rows"] += 1
+                self._cache_rows_pending += 1
                 resolve = hit
             elif self._closed:
                 # post-close stragglers execute inline on the caller —
@@ -294,6 +318,10 @@ class VerifierScheduler:
                     if (ctx is not None and len(self._pending_trace)
                             < self._PENDING_TRACE_CAP):
                         self._pending_trace[key] = ctx.trace_id
+                    rec = ledger.current()
+                    if (rec is not None and len(self._pending_origin)
+                            < self._PENDING_TRACE_CAP):
+                        self._pending_origin[key] = rec
                     self._ensure_thread()
                 if len(self._pending) >= self.max_batch:
                     self._kick = True
@@ -301,9 +329,12 @@ class VerifierScheduler:
         if resolve is not _MISS:
             metrics.counter("verifier.cache_hits" if hit is not _MISS
                             else "verifier.cache_misses").inc()
+            ledger.charge(cache_hits=1 if hit is not _MISS else 0,
+                          cache_misses=0 if hit is not _MISS else 1)
             fut.set_result(resolve)
             return fut
         metrics.counter("verifier.cache_misses").inc()
+        ledger.charge(cache_misses=1)
         return fut
 
     def kick(self) -> None:  # thread-entry hot-path-entry
@@ -419,6 +450,7 @@ class VerifierScheduler:
             leftovers.extend(self._pending.values())
             self._pending.clear()
             self._pending_trace.clear()
+            self._pending_origin.clear()
         for futs, _t in leftovers:
             for f in futs:
                 if not f.done():
@@ -1005,6 +1037,16 @@ class VerifierScheduler:
             # so the map never outlives its window
             traces = sorted({t for t in (self._pending_trace.pop(k, None)
                                          for k in keys) if t})
+            # ingress provenance: rows per captured (ledger, origin) —
+            # tallied under the lock, charged after release (the ledger
+            # emits metrics; fail-under-lock hygiene)
+            origin_rows: dict[tuple, int] = {}
+            for k in keys:
+                rec = self._pending_origin.pop(k, None)
+                if rec is not None:
+                    origin_rows[rec] = origin_rows.get(rec, 0) + 1
+            cache_rows = self._cache_rows_pending
+            self._cache_rows_pending = 0
             for k, r in zip(keys, p.results):
                 self._cache_put(k, r)
             self._stats["batches"] += 1
@@ -1020,9 +1062,25 @@ class VerifierScheduler:
             overlapped = self._stats["pipeline_overlapped"]
             flight["traces"] = traces[:4]
             flight["trace_count"] = len(traces)
+            # cache-served rows since the previous window: the warm-path
+            # volume that never forms a window of its own (the
+            # under-count bug this field closes)
+            flight["cache_rows"] = cache_rows
             flight["window"] = self._flight_seq
             self._flight_seq += 1
             self._flights.append(flight)
+        # per-origin window cost: each captured origin gets its row
+        # count plus its row-share of the window's wall-clock interior,
+        # booked as host-ms when the rows were host-served (singleton
+        # or breaker/straggler divert) and device-ms otherwise
+        if origin_rows:
+            win_ms = (done - p.t0) * 1e3
+            host_served = p.diverted or rows == 1
+            for (led, origin), n in origin_rows.items():
+                ms = win_ms * (n / rows)
+                led.charge(origin, rows=n,
+                           host_ms=ms if host_served else 0.0,
+                           device_ms=0.0 if host_served else ms)
         metrics.counter("verifier.flight_windows").inc()
         for _, (_, t_sub) in batch:
             metrics.histogram("verifier.sched_queue_wait_seconds") \

@@ -18,7 +18,7 @@ Methods:
   eth_getFilterChanges, eth_uninstallFilter, eth_sendRawTransaction,
   net_version, web3_clientVersion,
   thw_register, thw_membership, thw_status, thw_pendingGeecTxns,
-  thw_metrics, thw_traces, thw_health, thw_journal,
+  thw_metrics, thw_traces, thw_health, thw_journal, thw_ledger,
   debug_startProfile, debug_stopProfile, debug_stacks, debug_stats
 
 Plain HTTP ``GET /metrics`` on the same port serves the whole metrics
@@ -49,8 +49,9 @@ RPC_METHODS = frozenset({
     "eth_newBlockFilter", "eth_newFilter", "eth_sendRawTransaction",
     "eth_subscribe", "eth_uninstallFilter", "eth_unsubscribe",
     "net_version", "thw_flight", "thw_health", "thw_journal",
-    "thw_membership", "thw_metrics", "thw_pendingGeecTxns",
-    "thw_register", "thw_status", "thw_traces", "web3_clientVersion",
+    "thw_ledger", "thw_membership", "thw_metrics",
+    "thw_pendingGeecTxns", "thw_register", "thw_status", "thw_traces",
+    "web3_clientVersion",
 })
 
 
@@ -349,6 +350,28 @@ class RpcServer:
                     limit = int(p)
             limit = clamp_rpc_limit(limit)
             return self.node.journal.events(limit=limit, since=since)
+        if method == "thw_ledger":
+            # ingress provenance snapshots (eges_tpu/utils/ledger.py),
+            # NEWEST FIRST like thw_traces; params: [] | [limit] |
+            # [{"limit": n, "since_seq": seq}].  ``limit`` is clamped
+            # to [1, 4096]; ``since_seq`` is the incremental-polling
+            # cursor thw_journal uses (events with seq >= since_seq).
+            if self.node is None:
+                raise RpcError(-32000, "no consensus node")
+            limit, since = 256, 0
+            if params:
+                p = params[0]
+                if isinstance(p, dict):
+                    limit = int(p.get("limit", limit))
+                    since = int(p.get("since_seq", since))
+                else:
+                    limit = int(p)
+            limit = clamp_rpc_limit(limit)
+            evs = [e for e in self.node.journal.events(since=since)
+                   if e.get("type") == "ingress_ledger"]
+            evs = evs[-limit:]
+            evs.reverse()
+            return evs
         if method == "thw_flight":
             # verifier window flight recorder (crypto/scheduler.py),
             # NEWEST FIRST like thw_traces; params: [] | [limit] |

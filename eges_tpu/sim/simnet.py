@@ -24,6 +24,8 @@ import itertools
 import random
 from dataclasses import dataclass
 
+from eges_tpu.utils import ledger
+
 
 class _Timer:
     __slots__ = ("fn", "cancelled")
@@ -259,17 +261,22 @@ class SimNet:
             if node_id == sender_id or node_id in self._partitioned:
                 continue
             self._send(sender_id, node_id, data, "gossip",
-                       (lambda nid: lambda d: self._fire_gossip(nid, d))
-                       (node_id))
+                       (lambda nid, src:
+                        lambda d: self._fire_gossip(nid, d, src))
+                       (node_id, sender_id))
 
-    def _fire_gossip(self, node_id: str, data: bytes) -> None:
+    def _fire_gossip(self, node_id: str, data: bytes,
+                     sender_id: str = "") -> None:
         # delivery-time lookup: the receiver may have crashed (left the
         # net) while this datagram was in flight
         sink = self._gossip_sinks.get(node_id)
         if sink is None:
             self.stats["dropped"] += 1
             return
-        sink(data)
+        # provenance stamp: the receiving node's entry point reads the
+        # delivering peer (utils/ledger.py) to tag ingress cost
+        with ledger.peer(sender_id):
+            sink(data)
 
     def deliver_direct(self, sender_id: str, addr: tuple, data: bytes) -> None:
         if sender_id in self._partitioned:
@@ -288,13 +295,16 @@ class SimNet:
             self.stats["dropped"] += 1
             return
         self._send(sender_id, node_id, data, "direct",
-                   (lambda a: lambda d: self._fire_direct(a, d))(addr))
+                   (lambda a, src: lambda d: self._fire_direct(a, d, src))
+                   (addr, sender_id))
 
-    def _fire_direct(self, addr: tuple, data: bytes) -> None:
+    def _fire_direct(self, addr: tuple, data: bytes,
+                     sender_id: str = "") -> None:
         entry = self._direct_sinks.get(addr)
         if entry is None:
             self.stats["dead_letter"] += 1
             from eges_tpu.utils.metrics import DEFAULT as metrics
             metrics.counter("net.dead_letters").inc()
             return
-        entry[1](data)
+        with ledger.peer(sender_id):
+            entry[1](data)

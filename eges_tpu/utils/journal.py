@@ -91,6 +91,11 @@ EVENT_TYPES = frozenset({
     # (stage="verify_window", wall-clock ms + lane; those attrs are
     # volatile-stripped by the chaos canonical dump)
     "commit_anatomy",
+    # ingress provenance ledger (eges_tpu/utils/ledger.py): one
+    # per-origin decayed cost snapshot journaled at each block commit
+    # when anything was charged — deterministic counts/deltas plus the
+    # wall-clock "costs" account the chaos canonical dump strips
+    "ingress_ledger",
 })
 
 # The registered ``_breakdown`` phase vocabulary (consensus/node.py);

@@ -83,6 +83,9 @@ METRIC_FAMILIES = frozenset({
     "slo.alerts_firing", "slo.transitions",
     # harness/anatomy.py — commit critical-path assembler
     "anatomy.blocks",
+    # eges_tpu/utils/ledger.py — ingress provenance ledger
+    "ledger.evictions", "ledger.origins", "ledger.rejects",
+    "ledger.rows", "ledger.snapshots",
 })
 
 # One-line help string per registered family, emitted as ``# HELP``
@@ -165,6 +168,11 @@ METRIC_HELP = {
     "slo.alerts_firing": "SLO objectives currently in the firing state.",
     "slo.transitions": "SLO alert state-machine transitions journaled.",
     "anatomy.blocks": "Committed blocks assembled by the anatomy profiler.",
+    "ledger.evictions": "Origins evicted by space-saving top-K tracking.",
+    "ledger.origins": "Origins currently tracked by the ingress ledger.",
+    "ledger.rejects": "Ingress rejects booked to origins by the ledger.",
+    "ledger.rows": "Verifier rows booked to origins by the ledger.",
+    "ledger.snapshots": "Per-block ingress_ledger snapshots journaled.",
 }
 
 

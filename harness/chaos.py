@@ -76,6 +76,10 @@ VOLATILE_KEYS = {
     # under mesh dispatch) and a share derived from wall-clock-adjacent
     # aggregates — the FIRING itself is the protocol content
     "slo_firing": ("phase", "phase_share", "lane"),
+    # the ingress ledger keeps every wall-clock account (per-origin
+    # device/host ms) under this ONE top-level key by design; the
+    # decayed counts and deltas are virtual-time deterministic
+    "ingress_ledger": ("costs",),
 }
 
 
@@ -561,6 +565,113 @@ def _scn_commit_attribution(seed: int, fast: bool) -> dict:
     return res
 
 
+def _scn_ingress_flood_attribution(seed: int, fast: bool) -> dict:
+    """An injected peer floods the cluster with invalid-signature
+    transactions: the ingress ledger must name it the dominant offender
+    (honest origins keep zero rejects), the invalid_sig_reject_ratio
+    SLO must fire while the flood runs and resolve after it stops —
+    all byte-deterministic across same-seed runs."""
+    from eges_tpu.core.types import Transaction
+    from eges_tpu.utils import ledger as ledger_mod
+    import eges_tpu.consensus.messages as M
+
+    cluster = SimCluster(4, seed=seed, txn_per_block=4, txpool=True)
+    inj = FaultInjector(cluster)     # journals the (empty) fault plan
+    col = _enable_slo(cluster)
+    cluster.net.join("flooder", "10.0.0.99", 9999,
+                     lambda d: None, lambda d: None)
+    cluster.net.join("client", "10.0.0.98", 9998,
+                     lambda d: None, lambda d: None)
+
+    # a little honest traffic so attribution has someone NOT to blame:
+    # a well-behaved client gossips a few valid-signed transactions
+    priv = bytes([7]) * 32
+    good = tuple(Transaction(nonce=i, gas_price=1, gas_limit=21000,
+                             to=bytes(20), value=0).signed(priv)
+                 for i in range(4))
+
+    def honest():
+        cluster.net.deliver_gossip("client", M.pack_gossip(
+            M.GOSSIP_TXNS, M.TxnsMsg(txns=good)))
+
+    # the flood: waves of unique-nonce junk whose r=0 signature fails
+    # the pool's range check — cheap rejects, never device rows.
+    # Unique nonces per wave keep every row a REJECT (fresh hash), not
+    # a duplicate drop, so the abuse signal is unambiguous.
+    flooding = [True]
+    wave = [0]
+
+    def flood():
+        if not flooding[0]:
+            return
+        base = 1000 + wave[0] * 100
+        wave[0] += 1
+        bad = tuple(Transaction(nonce=base + i, gas_price=1,
+                                gas_limit=21000, to=bytes(20), value=0,
+                                v=27, r=0, s=1) for i in range(8))
+        cluster.net.deliver_gossip("flooder", M.pack_gossip(
+            M.GOSSIP_TXNS, M.TxnsMsg(txns=bad)))
+        cluster.clock.call_later(2.0, flood)
+
+    cluster.clock.call_later(0.5, honest)
+    cluster.clock.call_later(1.0, flood)
+    cluster.start()
+
+    def _fired() -> bool:
+        return any(e["type"] == "slo_firing"
+                   and e["objective"] == "invalid_sig_reject_ratio"
+                   for e in col.slo.journal.events())
+
+    cluster.run(600.0, stop_condition=_fired)
+    fired = _fired()
+    # heal: the flood stops; with no further high-reject snapshots the
+    # bad observations age out of the burn windows and the alert must
+    # resolve on its own
+    flooding[0] = False
+
+    def _cycled() -> bool:
+        return fired and any(
+            e["type"] == "slo_resolved"
+            and e["objective"] == "invalid_sig_reject_ratio"
+            for e in col.slo.journal.events())
+
+    cluster.run(600.0, stop_condition=_cycled)
+    res = _finish("ingress_flood_attribution", seed, cluster,
+                  extra_blocks=2, bound_s=240.0,
+                  checks={"flood_waves_sent": wave[0] > 0})
+    res = _slo_checks(res, cluster, col, lambda: {
+        "slo_invalid_sig_fired": any(
+            e["type"] == "slo_firing"
+            and e["objective"] == "invalid_sig_reject_ratio"
+            for e in col.slo.alerts()),
+        "slo_invalid_sig_resolved": any(
+            e["type"] == "slo_resolved"
+            and e["objective"] == "invalid_sig_reject_ratio"
+            for e in col.slo.alerts())})
+    # forensics over the FINAL journals (_slo_checks re-collected them):
+    # the assembler must name the flooder, and no honest origin may
+    # carry a single reject
+    rep = ledger_mod.assemble(res["journals"])
+    dom = rep.get("dominant") or {}
+    honest_rows = [o for o in rep.get("origins", [])
+                   if o["origin"] != "peer:flooder"]
+    checks = {
+        "flooder_named_dominant": dom.get("origin") == "peer:flooder",
+        "flooder_abuse_majority": dom.get("share", 0.0) >= 0.5,
+        "honest_origins_unblamed": all(
+            o.get("rejects", 0.0) <= 0.0 for o in honest_rows),
+        "honest_client_admitted": any(
+            o["origin"] == "peer:client" and o.get("admits", 0.0) > 0
+            for o in rep.get("origins", [])),
+    }
+    res["ledger"] = {"dominant": dom,
+                     "origins": len(rep.get("origins", [])),
+                     "snapshots": rep.get("snapshots", 0)}
+    res["checks"].update(checks)
+    res["ok"] = bool(res["ok"] and all(checks.values()))
+    return res
+
+
 def _scn_combo(seed: int, fast: bool) -> dict:
     """The acceptance storm: leader-kill + 20% loss + an asymmetric
     partition, all at once, then heal everything.  Live nodes must
@@ -598,6 +709,7 @@ SCENARIOS = {
     "mesh_device_blackout": _scn_mesh_device_blackout,
     "calm_baseline": _scn_calm_baseline,
     "commit_attribution": _scn_commit_attribution,
+    "ingress_flood_attribution": _scn_ingress_flood_attribution,
     "combo": _scn_combo,
 }
 
@@ -658,6 +770,14 @@ def render_result(res: dict) -> str:
                        a["partition_dominant"].get("share", 0.0) * 100.0,
                        a["blackout_dominant"].get("phase", "?"),
                        a["blackout_divert_share"]))
+    if "ledger" in res:
+        led = res["ledger"]
+        dom = led.get("dominant") or {}
+        out.append("  ledger: %d snapshot(s), %d origin(s)  "
+                   "dominant=%s (%.2f%% of discarded work)" % (
+                       led.get("snapshots", 0), led.get("origins", 0),
+                       dom.get("origin", "-"),
+                       dom.get("share", 0.0) * 100.0))
     if "flight_stragglers" in res:
         out.append("  flight stragglers: %s" % (
             ", ".join(str(d) for d in res["flight_stragglers"])

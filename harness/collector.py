@@ -28,6 +28,7 @@ import threading
 
 from eges_tpu.utils.metrics import DEFAULT as metrics
 from eges_tpu.utils.timeseries import SeriesStore, fold_payload
+from eges_tpu.utils.ledger import LedgerAssembler
 from harness.anatomy import AnatomyAssembler
 from harness.slo import SLOEngine
 
@@ -60,6 +61,9 @@ class ClusterCollector:
         # phase from the state folded so far
         self.anatomy = AnatomyAssembler()
         self.slo.phase_hint = self.anatomy.dominant
+        # ingress-provenance fold: same sorted barrier flush, same
+        # live/replay byte-identity contract as the anatomy section
+        self.ledger = LedgerAssembler()
         self._buffer: list[dict] = []
         self._event_counts: dict[str, int] = {}
         self.envelopes = 0
@@ -105,6 +109,7 @@ class ClusterCollector:
                             if float(e.get("ts", 0.0)) >= before_ts]
         for ev in sorted(ready, key=_order_key):
             self.anatomy.ingest(ev)
+            self.ledger.ingest(ev)
             self.slo.ingest(ev)
 
     def _step(self, sample: dict, ts: float) -> None:
@@ -141,6 +146,7 @@ class ClusterCollector:
             "compliance_ratio": round(self.slo.compliance_ratio, 6),
             "alerts_fired": self.slo.fired_total,
             "anatomy": self.anatomy.report(),
+            "ledger": self.ledger.report(),
         }
 
     def report_json(self) -> str:

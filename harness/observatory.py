@@ -40,6 +40,7 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 from eges_tpu.utils import journal as journal_mod
+from eges_tpu.utils import ledger as ledger_mod
 from eges_tpu.utils.metrics import percentile
 from harness import anatomy as anatomy_mod
 
@@ -47,7 +48,7 @@ from harness import anatomy as anatomy_mod
 # subset of journal.EVENT_TYPES so parser and emit sites cannot drift.
 CONSUMED = ("election_started", "election_won", "election_lost",
             "validate_quorum", "version_bump", "block_committed",
-            "block_confirmed", "commit_anatomy",
+            "block_confirmed", "commit_anatomy", "ingress_ledger",
             "fault_crash", "fault_restart", "fault_partition",
             "fault_heal", "fault_link", "fault_net", "fault_skew",
             "fault_trigger", "fault_breaker", "verifier_mesh_dispatch",
@@ -268,6 +269,7 @@ def summarize(by_node: dict[str, list[dict]],
         "unknown_events": {
             typ: unknown_events[typ] for typ in sorted(unknown_events)},
         "anatomy": anatomy_mod.assemble(by_node),
+        "ledger": ledger_mod.assemble(by_node),
     }
 
 
@@ -416,6 +418,62 @@ def render_anatomy(rep: dict, width: int = 40,
     return "\n".join(out)
 
 
+# -- ingress provenance ledger --------------------------------------------
+
+def render_ledger(rep: dict) -> str:
+    """Text view of a ledger report (``LedgerAssembler.report`` /
+    ``ledger.assemble``): per-origin cost table, reject-ratio ranking,
+    and the dominant-offender verdict line."""
+    out = ["ingress provenance ledger — %d snapshot(s), %d node(s)" % (
+        rep.get("snapshots", 0), rep.get("nodes", 0))]
+    origins = rep.get("origins") or []
+    if not origins:
+        out.append("  (no ingress activity recorded)")
+        return "\n".join(out)
+    out.append("  cumulative deltas: rows %d  admits %d  rejects %d  "
+               "drops %d" % (
+                   rep.get("rows_delta_total", 0),
+                   rep.get("admits_total", 0),
+                   rep.get("rejects_total", 0),
+                   rep.get("drops_total", 0)))
+    out.append("  per-origin decayed cost (cluster-merged, heaviest "
+               "first):")
+    out.append("    %-14s %8s %8s %8s %7s %6s %6s %9s %9s %5s" % (
+        "origin", "rows", "admits", "rejects", "drops", "defer",
+        "hit%", "device", "host", "snd"))
+    for row in origins:
+        hits = float(row.get("cache_hits", 0.0))
+        misses = float(row.get("cache_misses", 0.0))
+        hit_pct = (100.0 * hits / (hits + misses)
+                   if hits + misses > 0 else 0.0)
+        out.append(
+            "    %-14s %8.1f %8.1f %8.1f %7.1f %6.1f %5.1f%% "
+            "%7.2fms %7.2fms %5d" % (
+                str(row.get("origin", "?"))[:14], row.get("rows", 0.0),
+                row.get("admits", 0.0), row.get("rejects", 0.0),
+                row.get("drops", 0.0), row.get("deferred", 0.0),
+                hit_pct, row.get("device_ms", 0.0),
+                row.get("host_ms", 0.0), row.get("senders", 0)))
+    ranked = sorted(
+        (r for r in origins if r.get("reject_ratio", 0.0) > 0.0),
+        key=lambda r: (-float(r.get("reject_ratio", 0.0)),
+                       str(r.get("origin", ""))))
+    if ranked:
+        out.append("  reject-ratio ranking: " + "  ".join(
+            "%s %.2f" % (r["origin"], r["reject_ratio"])
+            for r in ranked[:5]))
+    dom = rep.get("dominant")
+    if dom:
+        out.append(
+            "  dominant offender: %s at %.2f%% of discarded work "
+            "(rejects %.1f, drops %.1f)" % (
+                dom["origin"], dom["share"] * 100.0, dom["rejects"],
+                dom["drops"]))
+    else:
+        out.append("  dominant offender: - (abuse below floor)")
+    return "\n".join(out)
+
+
 # -- collection -----------------------------------------------------------
 
 def collect_live(cluster) -> dict[str, list[dict]]:
@@ -538,6 +596,8 @@ def render(summary: dict, net: dict | None = None) -> str:
             for typ, n in summary["unknown_events"].items()))
     if summary.get("anatomy") is not None:
         out.append(render_anatomy(summary["anatomy"]))
+    if summary.get("ledger") is not None:
+        out.append(render_ledger(summary["ledger"]))
     return "\n".join(out)
 
 

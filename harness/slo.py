@@ -36,6 +36,8 @@ COMMIT_GAP_BAD_S = 60.0       # a new height this long after the last
 OCCUPANCY_FLOOR = 0.02        # dispatched/padded rows below this
 QUEUE_WAIT_BAD_MS = 500.0     # coalescing window wait above this
 COLD_START_BAD_S = 30.0       # AOT prewarm slower than this
+INVALID_SIG_RATIO_BAD = 0.5   # rejects dominate admits in a snapshot
+INGRESS_MIN_ATTEMPTS = 4      # snapshots with fewer attempts abstain
 
 
 @dataclass(frozen=True)
@@ -75,6 +77,9 @@ DEFAULT_OBJECTIVES = (
               "AOT prewarm restores the verifier quickly",
               budget=0.5, fast_window_s=300.0, slow_window_s=600.0,
               pending_for_s=0.0),
+    Objective("invalid_sig_reject_ratio",
+              "ingest rejects stay a small share of pool admissions",
+              budget=0.25, fast_window_s=60.0, slow_window_s=240.0),
 )
 
 
@@ -151,6 +156,19 @@ class SLOEngine:
             cold = ev.get("cold_start_s")
             if isinstance(cold, (int, float)):
                 self.observe("cold_start", ts, cold > COLD_START_BAD_S)
+        elif etype == "ingress_ledger":
+            # per-block ingest snapshot (eges_tpu/utils/ledger.py):
+            # bad when signature-invalid rejects dominate the block's
+            # admission attempts.  Low-traffic snapshots abstain so a
+            # lone stray txn cannot burn the budget.
+            rejects = ev.get("rejects_delta")
+            admits = ev.get("admits_delta")
+            if isinstance(rejects, int) and isinstance(admits, int):
+                attempts = rejects + admits
+                if attempts >= INGRESS_MIN_ATTEMPTS:
+                    self.observe("invalid_sig_reject_ratio", ts,
+                                 rejects / attempts
+                                 > INVALID_SIG_RATIO_BAD)
         elif etype == "telemetry_sample":
             payload = ev.get("metrics")
             if isinstance(payload, dict):
