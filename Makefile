@@ -3,12 +3,18 @@
 # a fresh clone with no remote), then the fast test suite.
 BASE := $(shell git rev-parse --verify -q origin/main || echo HEAD)
 
-.PHONY: check analyze test anatomy-smoke ledger-smoke
+.PHONY: check analyze race test anatomy-smoke ledger-smoke
 
-check: analyze test anatomy-smoke ledger-smoke
+check: analyze race test anatomy-smoke ledger-smoke
 
 analyze:
 	python -m harness.analysis --github --diff $(BASE)
+
+# race-only slice: the lockset rules over the WHOLE tree (no diff
+# scoping — a new thread role in one file can race code in another)
+race:
+	python -m harness.analysis --github --no-baseline \
+		--rules lockset-race,check-then-act,escape,waiver-expired
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'

@@ -49,21 +49,22 @@ class TxPool:
         self._journal_count = 0
         # sender -> {nonce -> txn}; admission order preserved separately
         # as (sender, txn) so selection never rescans the whole pool
-        self.pending: dict[bytes, dict[int, Transaction]] = {}
-        self._order: list[tuple[bytes, Transaction]] = []
-        self._by_hash: dict[bytes, tuple[bytes, int]] = {}  # hash -> (sender, nonce)
-        self._dead: set[bytes] = set()
-        self._known: set[bytes] = set()
-        self._queue: list[Transaction] = []
+        self.pending: dict[bytes, dict[int, Transaction]] = {}  # guarded-by: _lock
+        self._order: list[tuple[bytes, Transaction]] = []  # guarded-by: _lock
+        # hash -> (sender, nonce)
+        self._by_hash: dict[bytes, tuple[bytes, int]] = {}  # guarded-by: _lock
+        self._dead: set[bytes] = set()  # guarded-by: _lock
+        self._known: set[bytes] = set()  # guarded-by: _lock
+        self._queue: list[Transaction] = []  # guarded-by: _lock
         self._timer = None
-        self.stats = {"admitted": 0, "rejected": 0, "duplicate": 0,
+        self.stats = {"admitted": 0, "rejected": 0, "duplicate": 0,  # guarded-by: _lock
                       "batches": 0}
         # distributed-tracing linkage: per-txn SpanContext captured at
         # ingest.  The flush runs on a clock callback where contextvars
         # don't survive, so the context is carried here explicitly and
         # re-parented at admit / commit time.
         self.owner = ""  # identifies this pool's node in span attrs
-        self._ingest_ctx: dict[bytes, tracing.SpanContext] = {}
+        self._ingest_ctx: dict[bytes, tracing.SpanContext] = {}  # guarded-by: _lock
         self._INGEST_CTX_CAP = 8192
         # commit-anatomy linkage: per-txn ingest/admit timestamps on the
         # node clock (virtual under the simulator), emitted as one
@@ -71,8 +72,8 @@ class TxPool:
         # the txns — the ingest->admission leg of the per-block
         # critical path (harness/anatomy.py).  Same cap discipline as
         # ``_ingest_ctx``: entries die at eviction.
-        self._ingest_t: dict[bytes, float] = {}
-        self._admit_t: dict[bytes, float] = {}
+        self._ingest_t: dict[bytes, float] = {}  # guarded-by: _lock
+        self._admit_t: dict[bytes, float] = {}  # guarded-by: _lock
         # ingress-provenance linkage: per-txn (ledger, origin) captured
         # at ingest (utils/ledger.py ambient context) — the window flush
         # runs on a clock callback where the ambient binding is gone, so

@@ -208,15 +208,15 @@ class VerifierScheduler:
         # LRU recovery cache: (sighash, sig) -> 20-byte address or None
         # (a deterministic recovery failure is cached too — re-gossiped
         # garbage must not re-reach the device either)
-        self._cache: OrderedDict[tuple, object] = OrderedDict()
+        self._cache: OrderedDict[tuple, object] = OrderedDict()  # guarded-by: _lock
         # key -> ([futures], t_submit): identical in-flight keys share
         # one row (in-batch dedup), arrival order preserved
-        self._pending: OrderedDict[tuple, list] = OrderedDict()
+        self._pending: OrderedDict[tuple, list] = OrderedDict()  # guarded-by: _lock
         # key -> trace id of the submitter's active span (txpool ingest,
         # quorum verify): commit-anatomy linkage tying flight-recorder
         # windows back to the transactions that rode them.  Bounded like
         # the ingest-context map; entries pop when their window records.
-        self._pending_trace: dict[tuple, str] = {}
+        self._pending_trace: dict[tuple, str] = {}  # guarded-by: _lock
         self._PENDING_TRACE_CAP = 8192
         # key -> (ledger, origin) captured at submit (utils/ledger.py):
         # the window executes on the dispatch/lane thread where the
@@ -224,17 +224,18 @@ class VerifierScheduler:
         # the window cost charges the captured pair when it records.
         # Same cap discipline as the trace map; entries pop with their
         # window (in-flight dedup keeps the FIRST submitter's origin).
-        self._pending_origin: dict[tuple, tuple] = {}
+        self._pending_origin: dict[tuple, tuple] = {}  # guarded-by: _lock
         # cache-served rows since the last recorded window: cache hits
         # never reach a window, so without this the flight rows (and the
         # cheap-reject cost math over them) under-count a warm-cache
         # flood as free — drained into flight["cache_rows"]
-        self._cache_rows_pending = 0
-        self._kick = False
+        self._cache_rows_pending = 0  # guarded-by: _lock
+        self._kick = False  # guarded-by: _lock
         self._closed = False
-        self._admission_done = False  # set once the dispatch loop exits
+        # set once the dispatch loop exits
+        self._admission_done = False  # guarded-by: _lock
         self._thread: threading.Thread | None = None
-        self._stats = {
+        self._stats = {  # guarded-by: _lock
             "cache_hits": 0, "cache_misses": 0, "cache_served_rows": 0,
             "coalesced_rows": 0,
             "batches": 0, "rows": 0, "bucket_rows": 0, "host_diverted": 0,
@@ -254,8 +255,8 @@ class VerifierScheduler:
         # thw_flight RPC and the observatory waterfall.  Wall-clock by
         # nature (it measures real phase durations) and never journaled,
         # so it stays outside the determinism contract.
-        self._flights: deque = deque(maxlen=256)
-        self._flight_seq = 0
+        self._flights: deque = deque(maxlen=256)  # guarded-by: _lock
+        self._flight_seq = 0  # guarded-by: _lock
         if len(self._lanes) > 1:
             from eges_tpu.utils.metrics import DEFAULT as metrics
             metrics.gauge("verifier.mesh_devices").set(len(self._lanes))

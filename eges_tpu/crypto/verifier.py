@@ -185,8 +185,10 @@ class BatchVerifier:
         # itself is keyed on shapes, which map 1:1 to buckets here);
         # the verify graph is a distinct executable, so its bucket set
         # is tracked separately (same bookkeeping, different jit cache)
-        self._compiled_buckets: set[int] = set()
-        self._verify_buckets: set[int] = set()
+        # grow-only int-set markers mutated GIL-atomically from prewarm
+        # threads and lanes; a lost add only staletens a 'cached' flag
+        self._compiled_buckets: set[int] = set()  # guarded-by: gil-monotone
+        self._verify_buckets: set[int] = set()  # guarded-by: gil-monotone
         # Transfer-split timing forces a block_until_ready between H2D
         # and compute, serializing upload against dispatch — keep the
         # split histograms behind a debug flag and let the runtime

@@ -118,7 +118,9 @@ class _TelemetryPusher:
         self.sampler = RegistrySampler(registry, clock=_t.time)
         # journal tail: the tap enqueues every event as it is recorded,
         # so a drain (journal.dump) between ticks cannot lose envelopes
-        self._pending = deque(maxlen=8192)
+        # bounded deque shared tap->tick: append/popleft are GIL-atomic,
+        # so the journal-writer and service-loop roles need no lock
+        self._pending = deque(maxlen=8192)  # guarded-by: gil-atomic-deque
         self._prev_tap = node.journal.on_record
         node.journal.on_record = self._tap
         self._sock = None
@@ -130,13 +132,13 @@ class _TelemetryPusher:
         except ImportError:
             self.engine = None  # deployed without the harness package
 
-    def _tap(self, ev: dict) -> None:
+    def _tap(self, ev: dict) -> None:  # thread-entry:journal-writer
         self._pending.append(ev)
         prev = self._prev_tap
         if prev is not None:
             prev(ev)
 
-    def tick(self) -> None:
+    def tick(self) -> None:  # thread-entry:service-loop
         """Sample, journal the sample, evaluate the local SLO engine,
         and push the journal tail as one envelope."""
         payload = self.sampler.sample()

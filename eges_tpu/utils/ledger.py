@@ -146,9 +146,9 @@ class IngressLedger:
         self.half_life_s = half_life_s
         # origin -> record; mutated only under the lock.  Metrics and
         # journal emits happen OUTSIDE it (fail-under-lock hygiene).
-        self._origins: dict[str, dict] = {}
+        self._origins: dict[str, dict] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._evictions = 0
+        self._evictions = 0  # guarded-by: _lock
         # raw monotonic totals (ints): per-snapshot deltas drive the
         # invalid_sig_reject_ratio SLO and guarantee post-heal resolution
         # (decayed values never reach exactly zero)

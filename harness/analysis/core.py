@@ -41,6 +41,7 @@ RULES = (
     "recompile-hazard",                                  # recompile
     "transfer-hygiene",                                  # transfer
     "dtype-promotion",                                   # dtypes
+    "lockset-race", "check-then-act", "escape",          # lockset
     "waiver-expired",                                    # core (runner)
 )
 
@@ -143,6 +144,17 @@ class SourceFile:
         """``# thread-entry`` annotation on a def line (declares the
         method is invoked from another thread, e.g. an RPC worker)."""
         return "thread-entry" in self.line_comment(line)
+
+    def thread_role(self, line: int) -> str | None:
+        """The role named by a ``# thread-entry:<role>`` annotation,
+        ``''`` for a bare ``# thread-entry`` (the caller picks a
+        default, conventionally the method name), ``None`` when the
+        line carries no mark at all."""
+        m = re.search(r"thread-entry(?::([A-Za-z0-9_-]+))?",
+                      self.line_comment(line))
+        if m is None:
+            return None
+        return m.group(1) or ""
 
 
 class Project:
@@ -252,7 +264,8 @@ class Report:
     def __init__(self, findings: list[Finding], files: int,
                  elapsed_s: float, stale_baseline: list[dict],
                  errors: list[str],
-                 expiring_waivers: list[dict] | None = None):
+                 expiring_waivers: list[dict] | None = None,
+                 guarded_by: int = 0):
         self.findings = findings
         self.files = files
         self.elapsed_s = elapsed_s
@@ -261,6 +274,9 @@ class Report:
         # waivers whose until= date falls within the next 30 days —
         # advance warning before they flip into waiver-expired findings
         self.expiring_waivers = expiring_waivers or []
+        # `# guarded-by:` annotations in the scanned tree — the durable
+        # locking contracts; trendable so coverage only grows
+        self.guarded_by = guarded_by
 
     @property
     def unsuppressed(self) -> list[Finding]:
@@ -290,6 +306,7 @@ class Report:
             "findings_by_rule": self.findings_by_rule(),
             "unsuppressed_by_rule": self.unsuppressed_by_rule(),
             "waivers_expiring_30d": self.expiring_waivers,
+            "guarded_by_annotations": self.guarded_by,
         }
 
 
@@ -298,8 +315,8 @@ def run(root: str, paths: tuple[str, ...] = DEFAULT_PATHS,
         baseline_path: str | None = DEFAULT_BASELINE) -> Report:
     from harness.analysis import (
         determinism, dtypes, future_lifecycle, host_sync, jit_purity,
-        lock_discipline, lock_order, recompile, robustness, transfer,
-        vocabulary,
+        lock_discipline, lock_order, lockset, recompile, robustness,
+        transfer, vocabulary,
     )
 
     t0 = time.monotonic()
@@ -307,7 +324,7 @@ def run(root: str, paths: tuple[str, ...] = DEFAULT_PATHS,
     findings: list[Finding] = []
     for checker in (lock_discipline, lock_order, future_lifecycle,
                     determinism, jit_purity, vocabulary, robustness,
-                    host_sync, recompile, transfer, dtypes):
+                    host_sync, recompile, transfer, dtypes, lockset):
         findings.extend(checker.check(project))
 
     # waiver expiry: the clock is overridable so tests stay
@@ -373,8 +390,11 @@ def run(root: str, paths: tuple[str, ...] = DEFAULT_PATHS,
                 budget[key] -= 1
                 stale.append(e)
 
+    guarded = sum(
+        1 for src in project.files for ln in src.lines
+        if "guarded-by:" in ln.partition("#")[2])
     return Report(findings, len(project.files), time.monotonic() - t0,
-                  stale, project.errors, expiring)
+                  stale, project.errors, expiring, guarded)
 
 
 def _plus_days(day: str, days: int) -> str:

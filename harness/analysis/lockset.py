@@ -1,0 +1,824 @@
+"""lockset-race / check-then-act / escape: static race analysis.
+
+A RacerD-style lockset pass over the threaded verifier plane.  The
+existing concurrency rules check lock *ordering* (lock-order) and
+*some-lock-held* mutation discipline (lock-discipline); this pass
+checks the stronger property that concurrent roles agree on WHICH lock
+guards each shared field — and it is interprocedural: a lock taken in
+``GeecNode.on_gossip`` still counts when the call chain bottoms out in
+a helper three classes away.
+
+**Thread-role inference.**  A *role* is a label for one concurrent
+execution context.  Two sites labeled with different roles may run in
+parallel; sites sharing a single role are assumed serialized (the
+asyncio event loop, one timer callback).  Roles are seeded from:
+
+* ``threading.Thread(target=...)`` — role is the thread's ``name=``
+  literal when given, else ``thread:<target>``;
+* ``threading.Timer(delay, cb)`` — ``timer:<cb>`` (each Timer fires on
+  its own thread);
+* loop schedulers (``call_later`` / ``call_soon*`` / ``call_at`` /
+  ``create_task`` / ``ensure_future``) — the single ``event-loop``
+  role: loop callbacks never race each other;
+* executor hand-offs (``submit`` / ``run_in_executor``) —
+  ``executor:<fn>``;
+* ``# thread-entry:<role>`` on a ``def`` line — the named role (a bare
+  ``# thread-entry`` defaults the role to the method name);
+* asyncio protocol overrides on ``*Protocol`` classes, and any
+  ``async def`` handed over by reference — ``event-loop`` (a coroutine
+  can only run on the loop).
+
+A *sync* method passed by reference is deliberately NOT a role seed:
+it runs in its registrar's context, and inventing a fresh role for it
+manufactures phantom races (lock-discipline already treats it as an
+entry point for the weaker some-lock rule).
+
+**Interprocedural lockset propagation.**  Roles and held locksets flow
+together over the PR 10 call-graph resolution (``hotpath._Module``
+symbol tables): the BFS state is (function, lockset) -> roles, so a
+callee entered both with and without a lock is analyzed under both.
+Lock identity is the PR 8 scheme — ``Class.attr`` for
+``self.X = threading.Lock()/RLock()/Condition()/Semaphore()``,
+``module.NAME`` for module-level locks — tracked through lexical
+``with`` blocks and sequential ``.acquire()``/``.release()`` pairs.
+
+**lockset-race** — scoped to classes that own at least one lock (a
+class that never locks is lock-discipline's territory).  A field
+written from >= 2 distinct roles where two write sites hold no lock in
+common can tear; the finding names both access paths, their roles, and
+the candidate guard.  A ``# guarded-by: <lock>`` annotation on an
+assignment to the field turns the contract hard: ANY role-reachable
+access without that lock is a finding, regardless of role count.  A
+guard that names something other than a known lock (``event-loop``,
+``single-thread``) asserts the discipline is upheld by other means and
+exempts the field (the transports.py convention).
+
+**check-then-act** — ``if k in self._d: ... self._d[k]`` (or the
+``not in`` insert twin) with no lock held, on a dict another role
+mutates: the gap between the membership test and the dependent access
+is a TOCTOU window; hold the guard across both or use
+``setdefault()`` / ``pop(k, default)``.
+
+**escape** — in ``__init__``, a field assigned AFTER ``self`` was
+published to another role (a thread/timer started, a callback
+scheduled): the new role can observe a partially constructed object.
+Publish last.
+
+Suppression: the generic per-line waiver / baseline layers, plus a
+class-line waiver (``# analysis: allow-lockset-race(...)`` on the
+``class`` statement) exempting the whole class, mirroring
+lock-discipline.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from harness.analysis import hotpath
+from harness.analysis.core import Finding, Project
+from harness.analysis.lock_discipline import (
+    LOCK_FACTORIES, MUTATORS, PROTOCOL_OVERRIDES,
+)
+
+# scheduler callees whose callback runs on the event loop (serialized)
+LOOP_SCHEDULERS = frozenset({
+    "call_later", "call_soon", "call_soon_threadsafe", "call_at",
+    "create_task", "ensure_future",
+})
+
+# callees that hand their callback to a worker thread
+EXECUTORS = frozenset({"submit", "run_in_executor"})
+
+_GENERIC = hotpath._GENERIC_METHODS
+_UNIQUE_LIMIT = hotpath._UNIQUE_LIMIT
+
+
+def _leaf_name(node: ast.expr) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _self_attr(node: ast.expr) -> str | None:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _shallow_walk(node: ast.AST):
+    """ast.walk that does not descend into nested defs/lambdas (their
+    bodies run later, in a different dynamic context)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _fn_node(modules: dict, path: str, qual: str):
+    mod = modules.get(path)
+    if mod is None:
+        return None
+    cls, _, mname = qual.rpartition(".")
+    if cls:
+        return mod.classes.get(cls, {}).get("methods", {}).get(mname)
+    return mod.defs.get(qual)
+
+
+# -- thread-role inference ----------------------------------------------
+
+
+def _resolve_ref(mod, cls: str | None, arg: ast.expr,
+                 by_method: dict) -> list[tuple[str, str]]:
+    """(path, qualname) targets a callback argument may invoke."""
+    out: list[tuple[str, str]] = []
+    attr = _self_attr(arg)
+    if attr is not None and cls is not None:
+        tab = mod.classes.get(cls, {})
+        name = tab.get("aliases", {}).get(attr, attr)
+        if name in tab.get("methods", {}):
+            out.append((mod.src.path, f"{cls}.{name}"))
+        return out
+    if isinstance(arg, ast.Name):
+        if arg.id in mod.defs:
+            out.append((mod.src.path, arg.id))
+        return out
+    if isinstance(arg, ast.Lambda):
+        for inner in ast.walk(arg.body):
+            if isinstance(inner, ast.Call):
+                out.extend(_resolve_ref(mod, cls, inner.func, by_method))
+        return out
+    # obj.method reference: near-unique names only
+    if isinstance(arg, ast.Attribute) and isinstance(arg.ctx, ast.Load):
+        if arg.attr not in _GENERIC and not arg.attr.startswith("__"):
+            owners = by_method.get(arg.attr, ())
+            if 0 < len(owners) <= _UNIQUE_LIMIT:
+                out.extend(owners)
+    return out
+
+
+def _seed_call(call: ast.Call, mod, cls: str | None, modules: dict,
+               by_method: dict,
+               seeds: dict[tuple[str, str], set[str]]) -> None:
+    fname = _leaf_name(call.func)
+    kw = {k.arg: k.value for k in call.keywords if k.arg}
+
+    def add(arg: ast.expr, role_of) -> None:
+        for path, qual in _resolve_ref(mod, cls, arg, by_method):
+            role = role_of(qual.rsplit(".", 1)[-1])
+            seeds.setdefault((path, qual), set()).add(role)
+
+    if fname == "Thread":
+        target = kw.get("target")
+        if target is None:
+            return
+        name_kw = kw.get("name")
+        label = (name_kw.value
+                 if isinstance(name_kw, ast.Constant)
+                 and isinstance(name_kw.value, str) else None)
+        add(target, lambda n: label or f"thread:{n}")
+        return
+    if fname == "Timer":
+        cb = kw.get("function") or (
+            call.args[1] if len(call.args) >= 2 else None)
+        if cb is not None:
+            add(cb, lambda n: f"timer:{n}")
+        return
+    if fname in LOOP_SCHEDULERS:
+        for arg in list(call.args) + list(kw.values()):
+            add(arg, lambda n: "event-loop")
+        return
+    if fname in EXECUTORS:
+        args = call.args[1:] if fname == "run_in_executor" else call.args
+        for arg in args[:1]:
+            add(arg, lambda n: f"executor:{n}")
+        return
+    # an async def handed over by reference can only ever run on the
+    # event loop, whatever registered it
+    for arg in list(call.args) + list(kw.values()):
+        if isinstance(arg, ast.Attribute) and isinstance(arg.ctx, ast.Load):
+            for path, qual in _resolve_ref(mod, cls, arg, by_method):
+                fn = _fn_node(modules, path, qual)
+                if isinstance(fn, ast.AsyncFunctionDef):
+                    seeds.setdefault((path, qual), set()).add("event-loop")
+
+
+def _role_seeds(project: Project, modules: dict,
+                by_method: dict) -> dict[tuple[str, str], set[str]]:
+    seeds: dict[tuple[str, str], set[str]] = {}
+    for path, mod in modules.items():
+        src = mod.src
+        proto_classes = {
+            node.name for node in src.tree.body
+            if isinstance(node, ast.ClassDef)
+            and any("Protocol" in ast.unparse(b) for b in node.bases)}
+        for cname, tab in mod.classes.items():
+            for mname, fn in tab["methods"].items():
+                role = src.thread_role(fn.lineno)
+                if role is not None:
+                    seeds.setdefault((path, f"{cname}.{mname}"),
+                                     set()).add(role or mname)
+                if (cname in proto_classes
+                        and mname in PROTOCOL_OVERRIDES):
+                    seeds.setdefault((path, f"{cname}.{mname}"),
+                                     set()).add("event-loop")
+                for call in ast.walk(fn):
+                    if isinstance(call, ast.Call):
+                        _seed_call(call, mod, cname, modules, by_method,
+                                   seeds)
+        for fname, fn in mod.defs.items():
+            role = src.thread_role(fn.lineno)
+            if role is not None:
+                seeds.setdefault((path, fname), set()).add(role or fname)
+            for call in ast.walk(fn):
+                if isinstance(call, ast.Call):
+                    _seed_call(call, mod, None, modules, by_method,
+                               seeds)
+    return seeds
+
+
+# -- per-function scan: accesses, calls, locksets -----------------------
+
+
+class _FnScan:
+    """One function's ``self.*`` accesses and outgoing calls, each with
+    the lexical lockset held at the site."""
+
+    def __init__(self, mod, cls_name: str | None,
+                 lock_attrs: dict[str, str], mod_locks: dict[str, str],
+                 modules: dict, by_method: dict):
+        self.mod = mod
+        self.cls = cls_name
+        self.lock_attrs = lock_attrs      # attr -> factory kind
+        self.mod_locks = mod_locks        # NAME -> lock id
+        self.modules = modules
+        self.by_method = by_method
+        self.accesses: list[tuple[str, int, bool, frozenset]] = []
+        self.checkacts: list[tuple[str, int, frozenset]] = []
+        # resolved outgoing edges: (callee path, callee qual, lockset)
+        self.calls: list[tuple[str, str, frozenset]] = []
+        self.acquires = False  # did this body take any known lock?
+
+    def _lock_of(self, expr: ast.expr) -> str | None:
+        attr = _self_attr(expr)
+        if attr is not None and attr in self.lock_attrs:
+            return f"{self.cls}.{attr}"
+        if isinstance(expr, ast.Name) and expr.id in self.mod_locks:
+            return self.mod_locks[expr.id]
+        return None
+
+    def _callees(self, call: ast.Call) -> list[tuple[str, str]]:
+        """hotpath's conservative per-call resolution."""
+        mod, modules = self.mod, self.modules
+        f = call.func
+        out: list[tuple[str, str]] = []
+        if isinstance(f, ast.Name):
+            if f.id in mod.defs:
+                out.append((mod.src.path, f.id))
+            elif f.id in mod.from_imports:
+                dotted, orig = mod.from_imports[f.id]
+                for path in hotpath._mod_paths(dotted):
+                    if path in modules and orig in modules[path].defs:
+                        out.append((path, orig))
+                        break
+            return out
+        if not isinstance(f, ast.Attribute):
+            return out
+        recv = f.value
+        cls_tab = mod.classes.get(self.cls or "", {})
+        if (isinstance(recv, ast.Name) and recv.id == "self"
+                and self.cls):
+            name = cls_tab.get("aliases", {}).get(f.attr, f.attr)
+            if name in cls_tab.get("methods", {}):
+                out.append((mod.src.path, f"{self.cls}.{name}"))
+            # self.<field>(...) — a stored callback.  The field NAME
+            # says nothing reliable about the target (GossipPlane's
+            # self._on_gossip holds node.on_gossip, the lock-taking
+            # wrapper, not GeecNode._on_gossip) — never name-match it.
+            return out
+        if isinstance(recv, ast.Name):
+            dotted = mod.imports.get(recv.id)
+            if dotted is None and recv.id in mod.from_imports:
+                base, orig = mod.from_imports[recv.id]
+                dotted = f"{base}.{orig}" if base else orig
+            if dotted:
+                for path in hotpath._mod_paths(dotted):
+                    if path in modules and f.attr in modules[path].defs:
+                        out.append((path, f.attr))
+                        return out
+        if f.attr not in _GENERIC and not f.attr.startswith("__"):
+            owners = self.by_method.get(f.attr, ())
+            if 0 < len(owners) <= _UNIQUE_LIMIT:
+                out.extend(owners)
+        return out
+
+    def scan(self, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        self._stmts(fn.body, frozenset())
+
+    def _stmts(self, stmts: list[ast.stmt], held: frozenset) -> frozenset:
+        for s in stmts:
+            held = self._stmt(s, held)
+        return held
+
+    def _stmt(self, s: ast.stmt, held: frozenset) -> frozenset:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            return held  # nested defs run later, outside this scope
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            taken = held
+            for item in s.items:
+                lk = self._lock_of(item.context_expr)
+                if lk is not None:
+                    taken = taken | {lk}
+                    self.acquires = True
+                else:
+                    self._expr(item.context_expr, held)
+            self._stmts(s.body, taken)
+            return held
+        # sequential lock.acquire() / lock.release() statements
+        if isinstance(s, ast.Expr) and isinstance(s.value, ast.Call):
+            f = s.value.func
+            if isinstance(f, ast.Attribute) and f.attr in ("acquire",
+                                                           "release"):
+                lk = self._lock_of(f.value)
+                if lk is not None:
+                    if f.attr == "acquire":
+                        self.acquires = True
+                        return held | {lk}
+                    return held - {lk}
+        if isinstance(s, ast.If):
+            self._check_then_act(s, held)
+            self._expr(s.test, held)
+            self._stmts(s.body, held)
+            self._stmts(s.orelse, held)
+            return held
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            self._expr(s.target, held)
+            self._expr(s.iter, held)
+            self._stmts(s.body, held)
+            self._stmts(s.orelse, held)
+            return held
+        if isinstance(s, ast.While):
+            self._expr(s.test, held)
+            self._stmts(s.body, held)
+            self._stmts(s.orelse, held)
+            return held
+        if isinstance(s, ast.Try):
+            inner = self._stmts(s.body, held)
+            for h in s.handlers:
+                self._stmts(h.body, inner)
+            self._stmts(s.orelse, inner)
+            self._stmts(s.finalbody, inner)
+            return inner
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.expr):
+                self._expr(child, held)
+        return held
+
+    def _check_then_act(self, s: ast.If, held: frozenset) -> None:
+        t = s.test
+        if not (isinstance(t, ast.Compare) and len(t.ops) == 1
+                and isinstance(t.ops[0], (ast.In, ast.NotIn))):
+            return
+        attr = _self_attr(t.comparators[0])
+        if attr is None or attr in self.lock_attrs:
+            return
+        for node in _shallow_walk(ast.Module(body=s.body,
+                                             type_ignores=[])):
+            acts = (isinstance(node, ast.Subscript)
+                    and _self_attr(node.value) == attr)
+            if not acts and isinstance(node, ast.Call):
+                f = node.func
+                acts = (isinstance(f, ast.Attribute)
+                        and _self_attr(f.value) == attr
+                        and f.attr in MUTATORS)
+            if acts:
+                self.checkacts.append((attr, t.lineno, held))
+                return
+
+    def _access(self, attr: str, line: int, write: bool,
+                held: frozenset) -> None:
+        if attr not in self.lock_attrs:
+            self.accesses.append((attr, line, write, held))
+
+    def _expr(self, node, held: frozenset) -> None:
+        if node is None or isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.Call):
+            f = node.func
+            handled = False
+            if isinstance(f, ast.Attribute):
+                recv_attr = _self_attr(f)
+                if recv_attr is not None:
+                    # self.m(...) — method call or callable field
+                    tab = self.mod.classes.get(self.cls or "", {})
+                    name = tab.get("aliases", {}).get(recv_attr,
+                                                      recv_attr)
+                    if name not in tab.get("methods", {}):
+                        self._access(recv_attr, node.lineno, False, held)
+                    handled = True
+                else:
+                    inner = _self_attr(f.value)
+                    if inner is not None:
+                        # self.X.meth(...): mutator => write, else read
+                        if not (inner in self.lock_attrs
+                                and f.attr in ("acquire", "release",
+                                               "locked")):
+                            self._access(inner, node.lineno,
+                                         f.attr in MUTATORS, held)
+                        handled = True
+            for cpath, cqual in self._callees(node):
+                self.calls.append((cpath, cqual, held))
+            if not handled:
+                self._expr(f, held)
+            for a in node.args:
+                self._expr(a, held)
+            for k in node.keywords:
+                self._expr(k.value, held)
+            return
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is not None:
+                self._access(attr, node.lineno,
+                             isinstance(node.ctx, (ast.Store, ast.Del)),
+                             held)
+                return
+            self._expr(node.value, held)
+            return
+        if isinstance(node, ast.Subscript):
+            attr = _self_attr(node.value)
+            if attr is not None:
+                self._access(attr, node.lineno,
+                             isinstance(node.ctx, (ast.Store, ast.Del)),
+                             held)
+                self._expr(node.slice, held)
+                return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.expr, ast.comprehension)):
+                self._expr(child, held)
+
+
+# -- interprocedural (role, lockset) propagation ------------------------
+
+
+def _propagate(modules: dict, scans: dict,
+               seeds: dict[tuple[str, str], set[str]]):
+    """BFS (function, entry-lockset) -> roles over the call graph."""
+    states: dict[tuple[str, str], dict[frozenset, set[str]]] = {}
+    work: list[tuple[str, str, frozenset]] = []
+    for (path, qual), rls in sorted(seeds.items()):
+        states.setdefault((path, qual), {}).setdefault(
+            frozenset(), set()).update(rls)
+        work.append((path, qual, frozenset()))
+    while work:
+        path, qual, held = work.pop()
+        scan = scans.get((path, qual))
+        if scan is None:
+            continue
+        roles = states[(path, qual)][held]
+        for cpath, cqual, site in scan.calls:
+            if (cpath, cqual) not in scans:
+                continue
+            eff = held | site
+            tgt = states.setdefault((cpath, cqual), {}).setdefault(
+                eff, set())
+            if not roles <= tgt:
+                tgt.update(roles)
+                work.append((cpath, cqual, eff))
+    return states
+
+
+# -- escape: publication before __init__ completes ----------------------
+
+# calls that hand self to another role mid-construction; a Timer/Thread
+# merely CONSTRUCTED is inert — publication is its .start()
+_PUBLISHERS = LOOP_SCHEDULERS | EXECUTORS
+
+
+def _escape_findings(src, cls: ast.ClassDef) -> list[Finding]:
+    init = next((n for n in cls.body
+                 if isinstance(n, ast.FunctionDef)
+                 and n.name == "__init__"), None)
+    if init is None:
+        return []
+
+    def binds_self(call: ast.Call) -> bool:
+        for sub in ast.walk(call):
+            if isinstance(sub, ast.Name) and sub.id == "self":
+                return True
+        return False
+
+    # pass 1: variables bound to a Thread/Timer that captures self
+    thread_vars: set[str] = set()
+    for node in _shallow_walk(init):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _leaf_name(node.value.func) in ("Thread", "Timer")
+                and binds_self(node.value)):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    thread_vars.add(t.id)
+                at = _self_attr(t)
+                if at is not None:
+                    thread_vars.add(f"self.{at}")
+
+    # pass 2: the earliest publication of self to another role
+    pub: tuple[int, str] | None = None  # (line, role description)
+    for node in _shallow_walk(init):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        fname = _leaf_name(f)
+        site: tuple[int, str] | None = None
+        if fname == "start" and isinstance(f, ast.Attribute):
+            recv = f.value
+            if (isinstance(recv, ast.Call)
+                    and _leaf_name(recv.func) in ("Thread", "Timer")
+                    and binds_self(recv)):
+                site = (node.lineno, "a new thread")
+            elif isinstance(recv, ast.Name) and recv.id in thread_vars:
+                site = (node.lineno, "a new thread")
+            elif (_self_attr(recv) is not None
+                  and f"self.{_self_attr(recv)}" in thread_vars):
+                site = (node.lineno, "a new thread")
+        elif fname in _PUBLISHERS and binds_self(node):
+            site = (node.lineno, f"a {fname}() callback")
+        if site is not None and (pub is None or site[0] < pub[0]):
+            pub = site
+    if pub is None:
+        return []
+
+    # pass 3: fields assigned after the new role could already be live
+    findings: list[Finding] = []
+    seen: set[str] = set()
+    for node in _shallow_walk(init):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            continue
+        if node.lineno <= pub[0]:
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is None or attr in seen:
+                continue
+            seen.add(attr)
+            findings.append(Finding(
+                rule="escape", path=src.path, line=node.lineno,
+                symbol=f"{cls.name}.{attr}",
+                message=(f"self.{attr} is assigned after self escaped "
+                         f"to {pub[1]} at line {pub[0]} in __init__ — "
+                         f"the new role can observe a partially "
+                         f"constructed object; publish self last")))
+    return findings
+
+
+# -- the lockset intersection rules -------------------------------------
+
+
+def _fmt_locks(locks: frozenset) -> str:
+    return ("{" + ", ".join(sorted(locks)) + "}") if locks else "no lock"
+
+
+def _class_lock_attrs(cls: ast.ClassDef) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        fn = node.value.func if isinstance(node.value, ast.Call) else None
+        name = (fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else "")
+        if name not in LOCK_FACTORIES:
+            continue
+        for t in node.targets:
+            attr = _self_attr(t)
+            if attr is not None:
+                out[attr] = name
+    return out
+
+
+def _guard_id(cls: ast.ClassDef, guard: str,
+              lock_attrs: dict[str, str],
+              mod_locks: dict[str, str]) -> str | None:
+    """Resolve a guarded-by name to a lock id; None = not a known lock
+    (discipline upheld by other means — exempt, not enforced)."""
+    name = guard.rsplit(".", 1)[-1]
+    if name in lock_attrs:
+        return f"{cls.name}.{name}"
+    if guard in mod_locks:
+        return mod_locks[guard]
+    for lid in mod_locks.values():
+        if lid == guard:
+            return lid
+    return None
+
+
+def _scan_class(src, cls: ast.ClassDef, lock_attrs: dict[str, str],
+                mod_locks: dict[str, str], scans: dict,
+                states: dict) -> list[Finding]:
+    # collect (roles, method, line, write, effective lockset) per attr
+    accesses: dict[str, list] = {}
+    checkacts: list[tuple[str, int, str, frozenset, frozenset]] = []
+    locked_class = bool(lock_attrs)
+    for mname in sorted(m.name for m in cls.body
+                        if isinstance(m, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))):
+        key = (src.path, f"{cls.name}.{mname}")
+        scan = scans.get(key)
+        fn_states = states.get(key)
+        if scan is None or not fn_states:
+            continue
+        locked_class = locked_class or scan.acquires
+        for entry_held, roles in sorted(
+                fn_states.items(), key=lambda kv: sorted(kv[0])):
+            rtup = tuple(sorted(roles))
+            for attr, line, write, site in scan.accesses:
+                accesses.setdefault(attr, []).append(
+                    (rtup, mname, line, write, entry_held | site))
+            for attr, line, site in scan.checkacts:
+                checkacts.append((attr, line, mname,
+                                  entry_held | site, rtup))
+
+    findings: list[Finding] = []
+
+    # guarded-by annotations on assignments to the attribute
+    guarded: dict[str, str] = {}
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    g = src.guarded_by(t.lineno)
+                    if g:
+                        guarded.setdefault(attr, g)
+
+    # -- guarded-by hard contract (and other-means exemption set)
+    exempt: set[str] = set()
+    for attr, guard in sorted(guarded.items()):
+        gid = _guard_id(cls, guard, lock_attrs, mod_locks)
+        exempt.add(attr)  # the explicit contract supersedes inference
+        if gid is None:
+            continue
+        for roles, mname, line, write, held in sorted(
+                accesses.get(attr, []), key=lambda a: (a[2], a[1])):
+            if gid not in held:
+                kind = "writes" if write else "reads"
+                findings.append(Finding(
+                    rule="lockset-race", path=src.path, line=line,
+                    symbol=f"{cls.name}.{attr}",
+                    message=(f"self.{attr} is annotated '# guarded-by: "
+                             f"{guard}' but {cls.name}.{mname} {kind} "
+                             f"it holding {_fmt_locks(held)} (roles: "
+                             f"{', '.join(roles)}) — every access must "
+                             f"hold {gid}")))
+                break  # one violation per field is enough to act on
+
+    if not locked_class:
+        # a class that never locks anything has no locksets to
+        # intersect — the weaker some-lock rule (lock-discipline)
+        # owns that territory
+        return findings
+
+    # -- lockset intersection over write sites
+    for attr in sorted(accesses):
+        if attr in exempt:
+            continue
+        writes = sorted((a for a in accesses[attr] if a[3]),
+                        key=lambda a: (a[2], a[1], sorted(a[4])))
+        write_roles = set()
+        for roles, *_ in writes:
+            write_roles.update(roles)
+        if len(write_roles) < 2:
+            continue
+        hit = None
+        for i, (r1, m1, l1, _, h1) in enumerate(writes):
+            for r2, m2, l2, _, h2 in writes[i:]:
+                if set(r1) == set(r2) and len(r1) < 2:
+                    continue  # same single role: serialized
+                if h1 & h2:
+                    continue  # a common guard serializes them
+                hit = (r1, m1, l1, h1, r2, m2, l2, h2)
+                break
+            if hit:
+                break
+        if hit is None:
+            continue
+        r1, m1, l1, h1, r2, m2, l2, h2 = hit
+        # anchor on the less-guarded site: that is the line to fix,
+        # and the line a waiver belongs on
+        anchor = l2 if len(h2) < len(h1) else l1
+        all_locks = sorted({lk for a in accesses[attr] for lk in a[4]})
+        candidate = (all_locks[0] if all_locks
+                     else (f"{cls.name}.{sorted(lock_attrs)[0]}"
+                           if lock_attrs else "a shared lock"))
+        roles_txt = ", ".join(sorted(set(r1) | set(r2)))
+        if (m1, l1) == (m2, l2):
+            detail = (f"{cls.name}.{m1}:{l1} holds {_fmt_locks(h1)} "
+                      f"and is reached by more than one of them")
+        else:
+            detail = (f"{cls.name}.{m1}:{l1} holds {_fmt_locks(h1)}, "
+                      f"{cls.name}.{m2}:{l2} holds {_fmt_locks(h2)}")
+        findings.append(Finding(
+            rule="lockset-race", path=src.path, line=anchor,
+            symbol=f"{cls.name}.{attr}",
+            message=(f"self.{attr} is written by roles {roles_txt} "
+                     f"with no common lock: {detail} — guard every "
+                     f"access with {candidate} or annotate "
+                     f"'# guarded-by:'")))
+
+    # -- check-then-act on role-shared dicts
+    reported: set[tuple[str, int]] = set()
+    for attr, line, mname, held, roles in sorted(
+            checkacts, key=lambda c: (c[1], c[0])):
+        if attr in exempt or held or (attr, line) in reported:
+            continue
+        all_roles = set()
+        wrote = False
+        for rls, _, _, write, _ in accesses.get(attr, []):
+            all_roles.update(rls)
+            wrote = wrote or write
+        if len(all_roles) < 2 or not wrote:
+            continue
+        reported.add((attr, line))
+        findings.append(Finding(
+            rule="check-then-act", path=src.path, line=line,
+            symbol=f"{cls.name}.{attr}",
+            message=(f"check-then-act on self.{attr} in "
+                     f"{cls.name}.{mname}: the membership test and the "
+                     f"dependent access run with no lock while roles "
+                     f"{', '.join(sorted(all_roles))} share the dict — "
+                     f"hold the guard across both or use setdefault()/"
+                     f"pop(k, default)")))
+
+    return findings
+
+
+def check(project: Project) -> list[Finding]:
+    graph = hotpath.hot_graph(project)
+    modules = graph.modules
+
+    by_method: dict[str, list[tuple[str, str]]] = {}
+    for path, mod in modules.items():
+        for cname, tab in mod.classes.items():
+            for mname in tab["methods"]:
+                by_method.setdefault(mname, []).append(
+                    (path, f"{cname}.{mname}"))
+
+    from harness.analysis.lock_order import _module_locks
+    per_file_mod_locks = {
+        src.path: {name: lk.id
+                   for name, lk in _module_locks(src).items()}
+        for src in project.files}
+
+    # one scan per function, shared by seeding and propagation
+    scans: dict[tuple[str, str], _FnScan] = {}
+    class_lock_attrs: dict[tuple[str, str], dict[str, str]] = {}
+    for src in project.files:
+        mod = modules.get(src.path)
+        if mod is None:
+            continue
+        mod_locks = per_file_mod_locks[src.path]
+        for cls in src.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            lock_attrs = _class_lock_attrs(cls)
+            class_lock_attrs[(src.path, cls.name)] = lock_attrs
+            for mname, meth in mod.classes.get(
+                    cls.name, {}).get("methods", {}).items():
+                scan = _FnScan(mod, cls.name, lock_attrs, mod_locks,
+                               modules, by_method)
+                scan.scan(meth)
+                scans[(src.path, f"{cls.name}.{mname}")] = scan
+        for fname, fn in mod.defs.items():
+            scan = _FnScan(mod, None, {}, mod_locks, modules, by_method)
+            scan.scan(fn)
+            scans[(src.path, fname)] = scan
+
+    seeds = _role_seeds(project, modules, by_method)
+    states = _propagate(modules, scans, seeds)
+
+    findings: list[Finding] = []
+    for src in project.files:
+        mod = modules.get(src.path)
+        if mod is None:
+            continue
+        mod_locks = per_file_mod_locks[src.path]
+        for cls in src.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if not src.waived("lockset-race", cls.lineno):
+                findings.extend(_scan_class(
+                    src, cls,
+                    class_lock_attrs.get((src.path, cls.name), {}),
+                    mod_locks, scans, states))
+            if not src.waived("escape", cls.lineno):
+                findings.extend(_escape_findings(src, cls))
+    return findings

@@ -30,7 +30,10 @@ against the previous one, and ANY rise in unsuppressed findings for any
 rule fails — zero tolerance, no threshold: suppressions are explicit
 (waiver/baseline), so a rise always means un-reviewed debt landed.
 Rules absent from the previous line count as zero, so a newly added
-rule gates from its first appearance.
+rule gates from its first appearance.  The reverse is NOT symmetric:
+a rule present in the previous line but missing from the newest one
+fails outright — a renamed or deleted rule would otherwise silently
+stop gating while its findings kept accumulating.
 
 Exit codes: 0 ok (or fewer than two comparable entries per metric),
 1 regression, 2 unreadable history.
@@ -164,7 +167,8 @@ def load_analysis_history(path: str) -> list[dict]:
 
 def check_analysis(entries: list[dict]) -> tuple[int, str]:
     """(exit_code, message): fail on ANY per-rule rise in unsuppressed
-    findings between the two newest summary lines."""
+    findings between the two newest summary lines, and on any rule
+    that disappears from the newest line entirely."""
     if len(entries) < 2:
         return 0, ("ok [analysis]: %d comparable entr%s — nothing to "
                    "compare" % (len(entries),
@@ -174,6 +178,13 @@ def check_analysis(entries: list[dict]) -> tuple[int, str]:
     lines, code = [], 0
     for rule in sorted(set(prev) | set(last)):
         before = int(prev.get(rule, 0))
+        if rule not in last:
+            code = 1
+            lines.append("REGRESSION [analysis:%s]: rule present in the "
+                         "previous line is missing from the newest one — "
+                         "a renamed or deleted rule silently stops "
+                         "gating; keep emitting it (0 is fine)" % rule)
+            continue
         after = int(last.get(rule, 0))
         if after > before:
             code = 1

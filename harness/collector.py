@@ -64,10 +64,10 @@ class ClusterCollector:
         # ingress-provenance fold: same sorted barrier flush, same
         # live/replay byte-identity contract as the anatomy section
         self.ledger = LedgerAssembler()
-        self._buffer: list[dict] = []
-        self._event_counts: dict[str, int] = {}
-        self.envelopes = 0
-        self._last_ts = 0.0
+        self._buffer: list[dict] = []  # guarded-by: _lock
+        self._event_counts: dict[str, int] = {}  # guarded-by: _lock
+        self.envelopes = 0  # guarded-by: _lock
+        self._last_ts = 0.0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     # -- ingestion ------------------------------------------------------

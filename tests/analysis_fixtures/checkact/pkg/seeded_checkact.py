@@ -1,0 +1,57 @@
+"""Seeded check-then-act fixtures: an unguarded membership test on a
+dict another role mutates, plus clean twins (test under the lock, or
+atomic ``setdefault``) that must stay quiet."""
+
+import threading
+
+
+class RacyCache:
+    """``get`` tests membership and then indexes with no lock while the
+    writer role mutates the dict: a TOCTOU window."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    def put(self, k, v):  # thread-entry:writer
+        with self._lock:
+            self._entries[k] = v
+
+    def get(self, k):  # thread-entry:reader
+        if k in self._entries:
+            return self._entries[k]
+        return None
+
+
+class LockedCache:
+    """Clean twin: the guard spans the test and the access."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    def put(self, k, v):  # thread-entry:writer
+        with self._lock:
+            self._entries[k] = v
+
+    def get(self, k):  # thread-entry:reader
+        with self._lock:
+            if k in self._entries:
+                return self._entries[k]
+        return None
+
+
+class SetdefaultCache:
+    """Clean twin: no test at all — the mutation is atomic."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    def put(self, k, v):  # thread-entry:writer
+        with self._lock:
+            self._entries[k] = v
+
+    def ensure(self, k):  # thread-entry:reader
+        with self._lock:
+            return self._entries.setdefault(k, 0)

@@ -1,0 +1,62 @@
+"""Seeded escape fixtures: ``self`` published to another role before
+``__init__`` finishes assigning fields, plus a clean twin that
+publishes last and a waived class."""
+
+import threading
+
+
+class LeakyInit:
+    """The poller thread starts two assignments early: it can observe
+    an object without ``interval`` or ``ready``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples = []
+        self._t = threading.Thread(target=self._poll, daemon=True)
+        self._t.start()
+        self.interval = 0.5
+        self.ready = True
+
+    def _poll(self):
+        while self.ready:
+            with self._lock:
+                self._samples.append(self.interval)
+
+
+class TimerLeak:
+    """A Timer holding a bound method is publication too."""
+
+    def __init__(self):
+        threading.Timer(0.5, self._expire).start()
+        self.deadline = 1.0
+
+    def _expire(self):
+        return self.deadline
+
+
+class CleanInit:
+    """Clean twin: every field lands before the thread starts."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples = []
+        self.interval = 0.5
+        self.ready = True
+        self._t = threading.Thread(target=self._poll, daemon=True)
+        self._t.start()
+
+    def _poll(self):
+        while self.ready:
+            with self._lock:
+                self._samples.append(self.interval)
+
+
+class WaivedLeak:  # analysis: allow-escape(the poller only reads fields set in the first line)
+    def __init__(self):
+        self.first = 1
+        self._t = threading.Thread(target=self._poll, daemon=True)
+        self._t.start()
+        self.second = 2
+
+    def _poll(self):
+        return self.first

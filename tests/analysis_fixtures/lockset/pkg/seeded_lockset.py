@@ -1,0 +1,126 @@
+"""Seeded lockset-race fixtures: a drain counter written with and
+without the class lock from two roles, a race hidden one helper level
+deep, and a broken ``# guarded-by:`` contract — plus clean twins (and
+an other-means exemption) that must stay quiet."""
+
+import threading
+
+
+class RacyStats:
+    """``_inflight`` is locked on the RPC side but bare on the drainer
+    thread: the locksets intersect to nothing."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight = 0
+
+    def start(self):
+        self._t = threading.Thread(target=self._drain, name="drainer",
+                                   daemon=True)
+        self._t.start()
+
+    def submit(self):  # thread-entry:rpc
+        with self._lock:
+            self._inflight += 1
+
+    def _drain(self):
+        self._inflight -= 1
+
+
+class HelperDepthRace:
+    """The bare write hides one call level deep: the timer callback
+    reaches ``_bump`` with no lock while the RPC side holds one."""
+
+    def __init__(self, clock):
+        self._lock = threading.Lock()
+        self._seen = 0
+        self._clock = clock
+
+    def start(self):
+        self._clock.call_later(1.0, self._on_tick)
+        threading.Timer(1.0, self._expire).start()
+
+    def record(self):  # thread-entry:rpc
+        with self._lock:
+            self._bump()
+
+    def _expire(self):
+        self._bump()
+
+    def _on_tick(self):
+        return self._seen
+
+    def _bump(self):
+        self._seen += 1
+
+
+class BrokenContract:
+    """The annotation promises ``_lock`` but the reader skips it: the
+    guarded-by hard rule fires even though only one role writes."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}  # guarded-by: _lock
+
+    def put(self, k, v):  # thread-entry:writer
+        with self._lock:
+            self._table[k] = v
+
+    def peek(self, k):  # thread-entry:reader
+        return self._table.get(k)
+
+
+class DisciplinedStats:
+    """Clean twin of RacyStats: both roles hold the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight = 0
+
+    def start(self):
+        self._t = threading.Thread(target=self._drain, name="drainer",
+                                   daemon=True)
+        self._t.start()
+
+    def submit(self):  # thread-entry:rpc
+        with self._lock:
+            self._inflight += 1
+
+    def _drain(self):
+        with self._lock:
+            self._inflight -= 1
+
+
+class OtherMeans:
+    """The annotation names a discipline, not a lock: the contract is
+    upheld by other means and the field is exempt."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._frames = 0  # guarded-by: event-loop
+
+    def poll(self):  # thread-entry:poller
+        self._frames += 1
+
+    def flush(self):  # thread-entry:flusher
+        self._frames = 0
+
+
+class ClassWaived:  # analysis: allow-lockset-race(torn reads are acceptable for this gauge)
+    """Same shape as RacyStats, silenced by the class-line waiver."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._gauge = 0
+
+    def start(self):
+        self._t = threading.Thread(target=self._drain, name="drainer",
+                                   daemon=True)
+        self._t.start()
+
+    def submit(self):  # thread-entry:rpc
+        with self._lock:
+            self._gauge += 1
+
+    def _drain(self):
+        self._gauge -= 1

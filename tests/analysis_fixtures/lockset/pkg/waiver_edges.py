@@ -1,0 +1,48 @@
+"""Waiver grammar over the lockset rules: a stacked standalone waiver
+directly above the bare write, and a dated waiver that flips to
+``waiver-expired`` once its ``until=`` date passes."""
+
+import threading
+
+
+class StackedWaiver:
+    """Same shape as RacyStats, silenced by a stacked standalone
+    waiver on the bare drain write."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._gauge = 0
+
+    def start(self):
+        self._t = threading.Thread(target=self._drain, name="drainer",
+                                   daemon=True)
+        self._t.start()
+
+    def submit(self):  # thread-entry:rpc
+        with self._lock:
+            self._gauge += 1
+
+    def _drain(self):
+        # analysis: allow-lockset-race(torn gauge reads are fine) allow-lock-discipline(same torn-read argument)
+        self._gauge -= 1
+
+
+class DatedWaiver:
+    """The race is waived until 2099-01-10; past that date the waiver
+    expires and the finding comes back unsuppressed."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._level = 0
+
+    def start(self):
+        self._t = threading.Thread(target=self._drain, name="drainer",
+                                   daemon=True)
+        self._t.start()
+
+    def submit(self):  # thread-entry:rpc
+        with self._lock:
+            self._level += 1
+
+    def _drain(self):
+        self._level -= 1  # analysis: allow-lockset-race(monitor migration in flight until=2099-01-10) allow-lock-discipline(same migration)

@@ -245,6 +245,93 @@ def test_dtype_exempts_typed_twins_and_host_numpy():
     assert lines == {6, 7, 8, 12}, [f.render() for f in rep.findings]
 
 
+# -- lockset-race ---------------------------------------------------------
+
+def test_lockset_race_catches_each_seeded_shape():
+    rep = _run_fixture("lockset", paths=("pkg",), rules=("lockset-race",))
+    by_symbol = {f.symbol: f for f in rep.unsuppressed}
+    assert set(by_symbol) == {"RacyStats._inflight",
+                              "HelperDepthRace._seen",
+                              "BrokenContract._table"}, [
+        f.render() for f in rep.unsuppressed]
+    # the report names both roles, both access paths, a candidate
+    # guard, and anchors on the bare write — the line to fix
+    race = by_symbol["RacyStats._inflight"]
+    assert race.line == 27
+    assert "roles drainer, rpc" in race.message
+    assert "RacyStats.submit:24 holds {RacyStats._lock}" in race.message
+    assert "guard every access with RacyStats._lock" in race.message
+    # the bare write hiding one helper level deep is still attributed
+    deep = by_symbol["HelperDepthRace._seen"]
+    assert "roles rpc, timer:_expire" in deep.message
+    assert "HelperDepthRace._bump" in deep.message
+
+
+def test_lockset_race_guarded_by_is_a_hard_contract():
+    rep = _run_fixture("lockset", paths=("pkg",), rules=("lockset-race",))
+    broken = [f for f in rep.unsuppressed
+              if f.symbol == "BrokenContract._table"]
+    assert len(broken) == 1
+    assert "annotated '# guarded-by: _lock'" in broken[0].message
+    assert ("every access must hold BrokenContract._lock"
+            in broken[0].message)
+
+
+def test_lockset_race_clean_twins_and_waivers_stay_quiet():
+    rep = _run_fixture("lockset", paths=("pkg",), rules=("lockset-race",))
+    syms = {f.symbol for f in rep.unsuppressed}
+    # locked twin, other-means guarded-by, and class-line waiver
+    assert not any(s.startswith(("DisciplinedStats.", "OtherMeans.",
+                                 "ClassWaived.")) for s in syms)
+    # stacked standalone waiver and in-date dated waiver both suppress
+    waived = {f.symbol for f in rep.findings if f.waived}
+    assert {"StackedWaiver._gauge", "DatedWaiver._level"} <= waived
+    assert not any(s.startswith(("StackedWaiver.", "DatedWaiver."))
+                   for s in syms)
+
+
+def test_lockset_dated_waiver_flips_past_its_deadline(monkeypatch):
+    monkeypatch.setenv("EGES_ANALYSIS_TODAY", "2142-01-01")
+    rep = _run_fixture("lockset", paths=("pkg",),
+                       rules=("lockset-race", "waiver-expired"))
+    un = {(f.rule, f.symbol) for f in rep.unsuppressed}
+    # the expired waiver stops suppressing AND becomes its own finding
+    assert ("lockset-race", "DatedWaiver._level") in un
+    assert ("waiver-expired", "lockset-race") in un
+    # the undated stacked waiver keeps suppressing
+    assert not any(sym.startswith("StackedWaiver.") for _, sym in un)
+
+
+# -- check-then-act -------------------------------------------------------
+
+def test_check_then_act_fires_once_and_names_the_fix():
+    rep = _run_fixture("checkact", paths=("pkg",))
+    un = rep.unsuppressed
+    # exactly one finding across ALL rules: the guard-spanning and
+    # setdefault twins stay quiet
+    assert [(f.rule, f.symbol, f.line) for f in un] == [
+        ("check-then-act", "RacyCache._entries", 21)], [
+        f.render() for f in un]
+    msg = un[0].message
+    assert "membership test and the dependent access" in msg
+    assert "roles reader, writer" in msg
+    assert "setdefault()" in msg
+
+
+# -- escape ---------------------------------------------------------------
+
+def test_escape_flags_each_post_publication_assign():
+    rep = _run_fixture("escape", paths=("pkg",), rules=("escape",))
+    got = {(f.symbol, f.line) for f in rep.unsuppressed}
+    assert got == {("LeakyInit.interval", 17), ("LeakyInit.ready", 18),
+                   ("TimerLeak.deadline", 31)}, [
+        f.render() for f in rep.unsuppressed]
+    assert all("publish self last" in f.message for f in rep.unsuppressed)
+    # the publish-last twin and the class-line waiver stay quiet
+    assert not any(f.symbol.startswith(("CleanInit.", "WaivedLeak."))
+                   for f in rep.findings)
+
+
 # -- waiver expiry --------------------------------------------------------
 
 def test_waiver_expiry_flips_and_warns(monkeypatch):
@@ -352,8 +439,13 @@ def test_cli_gate_exit_codes_and_summary(tmp_path):
                                              "recompile-hazard",
                                              "transfer-hygiene",
                                              "dtype-promotion",
+                                             "lockset-race",
+                                             "check-then-act", "escape",
                                              "waiver-expired"}
     assert line["waivers_expiring_30d"] == []
+    # the real tree carries explicit guarded-by contracts, and the
+    # trend line counts them so a mass deletion is visible
+    assert line["guarded_by_annotations"] > 0
 
     # seeded regression: the same CLI exits non-zero on a dirty tree
     proc = subprocess.run(
@@ -371,6 +463,9 @@ def test_cli_gate_exit_codes_and_summary(tmp_path):
     ("recompile", "pkg"),      # seeded per-call jit / unbucketed upload
     ("transfer", "pkg"),       # seeded loop upload / staging reuse
     ("dtypes", "eges_tpu"),    # seeded weak-type / 64-bit leaks
+    ("lockset", "pkg"),        # seeded empty-intersection write race
+    ("checkact", "pkg"),       # seeded unguarded check-then-act
+    ("escape", "pkg"),         # seeded self-escape from __init__
 ])
 def test_cli_exits_nonzero_on_each_seeded_concurrency_bug(tree, paths):
     proc = subprocess.run(
@@ -455,6 +550,42 @@ def test_cli_diff_scopes_findings_to_changed_files(tmp_path):
     assert proc.returncode == 2, proc.stdout + proc.stderr
 
 
+def test_cli_diff_scopes_lockset_findings(tmp_path):
+    import shutil
+    root = str(tmp_path / "tree")
+    shutil.copytree(os.path.join(FIXTURES, "lockset"), root)
+    _git(root, "init", "-q")
+    _git(root, "add", ".")
+    _git(root, "commit", "-qm", "seed")
+
+    def cli(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "harness.analysis", "--root", root,
+             "--no-baseline", *extra, "pkg"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+
+    # the seeded races fail an unscoped run, but nothing changed since
+    # HEAD so the scoped run passes
+    assert cli().returncode == 1
+    proc = cli("--diff", "HEAD")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # touching only the fully-waived file keeps the gate green
+    with open(os.path.join(root, "pkg", "waiver_edges.py"), "a") as fh:
+        fh.write("\n# touched\n")
+    proc = cli("--diff", "HEAD")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    _git(root, "commit", "-aqm", "touch waived file")
+
+    # touching the seeded file brings exactly its races back in scope
+    with open(os.path.join(root, "pkg", "seeded_lockset.py"), "a") as fh:
+        fh.write("\n# touched\n")
+    proc = cli("--diff", "HEAD")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "seeded_lockset.py" in proc.stdout
+    assert "waiver_edges.py" not in proc.stdout
+
+
 # -- the analysis trend gate (check_regression --analysis) ----------------
 
 def test_check_regression_analysis_gate(tmp_path):
@@ -482,6 +613,11 @@ def test_check_regression_analysis_gate(tmp_path):
     # a rule absent from the previous line counts as zero, so a freshly
     # added checker gates from its first unsuppressed finding
     write({"swallow": 0}, {"swallow": 0, "determinism": 1})
+    assert gate([hist, "--analysis"]) == 1
+
+    # a rule that DISAPPEARS from the newest line fails outright: a
+    # renamed or deleted checker must not silently stop gating
+    write({"swallow": 0, "lockset-race": 0}, {"swallow": 0})
     assert gate([hist, "--analysis"]) == 1
 
     # torn/non-summary lines are skipped, like the bench history loader

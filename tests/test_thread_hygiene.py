@@ -1,0 +1,170 @@
+"""Dynamic confirmation for the lockset analysis plane.
+
+The static checker (``harness/analysis/lockset.py``) proves the
+monitor discipline on paper; these tests prove it on silicon: every
+worker thread the components spawn is a daemon and is joined at
+``close()``, and an 8-thread hammer over TxPool + VerifierScheduler +
+IngressLedger reconciles every counter exactly — a torn update
+anywhere and the totals drift.  The hammer runs under a faulthandler
+watchdog so a deadlock dumps all stacks instead of wedging CI.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import json
+import secrets
+import socket
+import threading
+import time
+
+from eges_tpu.core.txpool import TxPool
+from eges_tpu.core.types import Transaction
+from eges_tpu.crypto import secp256k1 as host
+from eges_tpu.crypto.scheduler import scheduler_for
+from eges_tpu.crypto.verify_host import NativeBatchVerifier
+from eges_tpu.sim.simnet import SimClock
+from eges_tpu.utils import metrics
+from eges_tpu.utils.ledger import IngressLedger
+
+THREADS = 8
+
+
+# -- thread-shutdown stragglers -------------------------------------------
+
+def test_worker_threads_are_daemons_and_join_on_close():
+    from harness.collector import ClusterCollector, CollectorServer
+
+    base = set(threading.enumerate())
+    sched = scheduler_for(NativeBatchVerifier(), window_ms=2.0)
+    col = ClusterCollector()
+    srv = CollectorServer(col)
+    try:
+        # wake the scheduler's dispatch/lane workers with one real row
+        msg = (1).to_bytes(4, "big") * 8
+        sig = host.ecdsa_sign(msg, bytes([7]) * 32)
+        sched.recover_signers([(msg, sig)])
+        # and the collector's accept + per-connection workers
+        with socket.create_connection(srv.address, timeout=5.0) as s:
+            s.sendall(json.dumps(
+                {"node": "n0", "ts": 1.0, "events": []}).encode() + b"\n")
+            deadline = time.monotonic() + 10.0
+            while col.envelopes < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        assert col.envelopes == 1
+        spawned = [t for t in threading.enumerate() if t not in base]
+        assert spawned, "expected live worker threads"
+        # a non-daemon worker would wedge interpreter shutdown if a
+        # test (or a node crash) skips close()
+        assert all(t.daemon for t in spawned), [
+            t.name for t in spawned if not t.daemon]
+    finally:
+        sched.close()
+        srv.close()
+
+    # close() JOINS the workers — daemonhood alone is not enough, a
+    # still-running drain loop after close would race teardown
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        leftover = [t for t in threading.enumerate()
+                    if t not in base and t.is_alive()]
+        if not leftover:
+            break
+        time.sleep(0.02)
+    assert not leftover, [t.name for t in leftover]
+
+
+# -- 8-thread exact-reconciliation hammer ---------------------------------
+
+def _signed_batch(priv, n):
+    return [Transaction(nonce=i, gas_limit=21000, to=bytes(20),
+                        value=1).signed(priv, chain_id=1)
+            for i in range(n)]
+
+
+def _sign_entries(n):
+    from eges_tpu.crypto import native
+
+    out = []
+    for i in range(n):
+        msg = (900_000 + i + 1).to_bytes(4, "big") * 8
+        priv = bytes([(i % 200) + 7]) * 32
+        sig = (native.ec_sign(msg, priv) if native.available()
+               else host.ecdsa_sign(msg, priv))
+        out.append((msg, sig))
+    return out
+
+
+def test_eight_thread_hammer_reconciles_every_counter():
+    faulthandler.dump_traceback_later(120.0, exit=True)
+    try:
+        _hammer()
+    finally:
+        faulthandler.cancel_dump_traceback_later()
+
+
+def _hammer():
+    TXNS, CHARGES = 8, 400
+    # pre-sign on the main thread: signing cost is not the target
+    good = [_signed_batch(secrets.token_bytes(32), TXNS)
+            for _ in range(THREADS)]
+    bad = [Transaction(nonce=100 + k, v=29, r=1, s=1)
+           for k in range(THREADS)]
+    entries = _sign_entries(16)
+    expect = [host.recover_address(h, s) for h, s in entries]
+
+    clock = SimClock()
+    # max_batch=1 flushes inline under the pool lock on every ingest:
+    # the hammer never touches the (single-threaded) sim clock's timers
+    pool = TxPool(clock, verifier=None, window_ms=5, max_batch=1)
+    sched = scheduler_for(NativeBatchVerifier(), window_ms=2.0)
+    led = IngressLedger(clock=time.monotonic, k=64)
+    results: dict[int, list] = {}
+    errs: list = []
+
+    def worker(k: int) -> None:
+        try:
+            for t in good[k]:
+                pool.add_remotes([t])
+            pool.add_remotes([bad[k]])
+            pool.add_remotes(good[k])  # every one a duplicate now
+            for _ in range(CHARGES):
+                led.charge(f"origin-{k}", rows=1, admits=1)
+            rotated = entries[k:] + entries[:k]
+            results[k] = sched.recover_signers(rotated)
+        except Exception as e:  # pragma: no cover - surfaced via errs
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(k,), daemon=True)
+               for k in range(THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errs, errs
+    assert not any(t.is_alive() for t in threads)
+
+    # TxPool: every submitted txn lands in exactly one bucket, and the
+    # depth gauge agrees with the pool's own view
+    assert pool.stats["admitted"] == THREADS * TXNS
+    assert pool.stats["rejected"] == THREADS
+    assert pool.stats["duplicate"] == THREADS * TXNS
+    assert len(pool) == THREADS * TXNS
+    assert metrics.DEFAULT.gauge("txpool.pending").value == len(pool)
+
+    # Scheduler: every thread got exactly the host model's answers,
+    # and every submitted row either hit or missed the cache — no
+    # double counts, no lost rows
+    for k, got in results.items():
+        assert got == expect[k:] + expect[:k], f"thread {k} mismatch"
+    st = sched.stats()
+    assert (st["cache_hits"] + st["cache_misses"]
+            == THREADS * len(entries)), st
+    assert st["pending"] == 0
+    sched.close()
+
+    # Ledger: the raw monotonic totals (no decay) sum exactly, and no
+    # origin was evicted (k=64 > 8 writers)
+    assert led._totals["rows"] == THREADS * CHARGES
+    assert led._totals["admits"] == THREADS * CHARGES
+    assert len(led.snapshot()["origins"]) == THREADS
