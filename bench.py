@@ -689,6 +689,96 @@ def _ledger_stage() -> dict | None:
         return None
 
 
+def _adaptive_stage() -> dict | None:
+    """Adaptive-scheduler stage: the same bursty workload driven twice
+    — once under the static 2 ms flush deadline, once with the
+    closed-loop controller enabled under a ~2 ms p99 objective — and
+    the p99 window latency of each pass compared.  The adaptive pass's
+    ``sched_p99_window_ms`` plus the per-class queue waits
+    (``sched_queue_wait_p99_ms_consensus`` / ``_bulk``) are gated
+    lower-is-better by ``harness/check_regression.py``.
+
+    Runs in the PARENT like ``_coalesced_stage``: the scheduler and
+    native host verifier import no JAX.  The adaptive p99 is measured
+    AFTER the controller's warm-up windows (its first decisions see
+    static-era flights), so the series trends the converged policy, not
+    the ramp."""
+    try:
+        from eges_tpu.crypto import native
+        from eges_tpu.crypto import secp256k1 as host
+        from eges_tpu.crypto.scheduler import (SchedulerConfig,
+                                               VerifierScheduler)
+        from eges_tpu.crypto.verify_host import NativeBatchVerifier
+        from eges_tpu.utils.metrics import percentile
+
+        # burst size × gap chosen to NOT saturate the host verifier
+        # (~0.4 ms/row): each burst forms one window and the flush
+        # deadline — the policy under test — dominates its latency,
+        # instead of queueing behind the previous window's compute
+        n_bursts, rows, gap_s, warmup = 32, 8, 0.012, 8
+        entries = []
+        for i in range(n_bursts * rows):
+            msg = (i + 1).to_bytes(4, "big") * 8
+            priv = bytes([(i % 200) + 11]) * 32
+            sig = (native.ec_sign(msg, priv) if native.available()
+                   else host.ecdsa_sign(msg, priv))
+            entries.append((msg, sig))
+
+        def _pass(config: SchedulerConfig) -> dict:
+            sched = VerifierScheduler(NativeBatchVerifier(),
+                                      config=config)
+            futs = []
+            try:
+                for b in range(n_bursts):
+                    part = entries[b * rows:(b + 1) * rows]
+                    # every 8th burst is consensus-critical (the vote
+                    # quorum shape): it must preempt the bulk windows
+                    # at placement and show up in the class split
+                    pr = "consensus" if b % 8 == 7 else "bulk"
+                    futs.extend(sched.submit(h, s, priority=pr)
+                                for h, s in part)
+                    time.sleep(gap_s)
+                bad = sum(1 for f in futs if f.result(120) is None)
+                flights = sched.flights()
+                st = sched.stats()
+            finally:
+                sched.close()
+            steady = [f["total_ms"] for f in flights[warmup:]] \
+                or [f["total_ms"] for f in flights]
+            return {"p99_window_ms":
+                        round(percentile(sorted(steady), 99.0), 3),
+                    "windows": len(flights), "stats": st,
+                    "verify_failures": bad}
+
+        static = _pass(SchedulerConfig(window_ms=2.0, max_batch=256))
+        adaptive = _pass(SchedulerConfig(
+            window_ms=2.0, max_batch=256, adaptive=True,
+            slo_p99_ms=2.0, min_window_ms=0.25, min_target_rows=16,
+            adapt_recent=8))
+        cw = adaptive["stats"].get("class_wait_ms", {})
+        return {
+            "bursts": n_bursts, "burst_rows": rows,
+            "rows": adaptive["stats"]["rows"],
+            "p99_window_ms_static": static["p99_window_ms"],
+            "p99_window_ms_adaptive": adaptive["p99_window_ms"],
+            "adaptive_beats_static": (adaptive["p99_window_ms"]
+                                      < static["p99_window_ms"]),
+            "final_window_ms": adaptive["stats"]["window_ms"],
+            "final_target_rows": adaptive["stats"]["target_rows"],
+            "adapt_decisions":
+                adaptive["stats"]["adapt_decisions"],
+            "queue_wait_p99_ms_consensus":
+                cw.get("consensus", {}).get("p99_ms", 0.0),
+            "queue_wait_p99_ms_bulk":
+                cw.get("bulk", {}).get("p99_ms", 0.0),
+            "verify_failures": (static["verify_failures"]
+                                + adaptive["verify_failures"]),
+        }
+    # analysis: allow-swallow(optional bench stage; a failed leg reports null)
+    except Exception:
+        return None
+
+
 def _platform_detail(probe_state: dict, best: dict) -> dict:
     """Requested-vs-actual backend stamp for every history line: the
     bench always WANTS the accelerator, so when a line was measured on
@@ -792,6 +882,7 @@ def main() -> None:
     slo = _slo_stage()
     anatomy = _anatomy_stage()
     ledger_bench = _ledger_stage()
+    adaptive_bench = _adaptive_stage()
 
     best: dict = {}      # kind -> best stage result for that backend
     # kind -> {batch(str): {p50_ms, p99_ms}} — every stage's tails, not
@@ -1054,6 +1145,33 @@ def main() -> None:
         line.update(_provenance())
         print(json.dumps(line), flush=True)
         _append_history(line)
+    if adaptive_bench:
+        # parent-side stage: the closed-loop controller vs the static
+        # deadline over one bursty workload — all three series gated
+        # lower-is-better so a controller that stops shrinking under
+        # burn (or a priority queue that stops preempting) fails the
+        # round even when raw verifies/s holds
+        for metric, value in (
+                ("sched_p99_window_ms",
+                 adaptive_bench["p99_window_ms_adaptive"]),
+                ("sched_queue_wait_p99_ms_consensus",
+                 adaptive_bench["queue_wait_p99_ms_consensus"]),
+                ("sched_queue_wait_p99_ms_bulk",
+                 adaptive_bench["queue_wait_p99_ms_bulk"])):
+            line = {"metric": metric, "value": value, "unit": "ms",
+                    "static_p99_window_ms":
+                        adaptive_bench["p99_window_ms_static"],
+                    "adaptive_beats_static":
+                        adaptive_bench["adaptive_beats_static"],
+                    "final_window_ms":
+                        adaptive_bench["final_window_ms"],
+                    "final_target_rows":
+                        adaptive_bench["final_target_rows"],
+                    "platform_detail":
+                        _platform_detail(probe_state, best)}
+            line.update(_provenance())
+            print(json.dumps(line), flush=True)
+            _append_history(line)
 
     # trend the static-analysis counts alongside the perf series: one
     # findings_by_rule/unsuppressed_by_rule line per bench round, the

@@ -226,24 +226,32 @@ class GeecNode:
         ``recover_signers`` delegates into its cache + coalescing
         window, so a lone check is a cache hit (gossip re-delivery), a
         row in someone else's batch, or one host recover — never the
-        padded 1-row device dispatch this path used to cost."""
+        padded 1-row device dispatch this path used to cost.  Consensus
+        blocks on this check, so it rides the scheduler's high-priority
+        window class."""
         if not self._signing:
             return True
         if len(sig) != 65:
             return False
         from eges_tpu.crypto.verify_host import recover_signers
-        return recover_signers([(sighash, sig)], self.verifier)[0] == author
+        return recover_signers([(sighash, sig)], self.verifier,
+                               priority="consensus")[0] == author
 
     def _recover_entries(self, entries) -> list:
         """Recover the signer of each ``(author, sighash, sig)`` entry in
         ONE verifier batch (or one scheduler window, where the cache
         strips already-seen votes before the device sees them); per-entry
         result is the claimed author when the signature checks out, else
-        None.  With signing off every entry passes."""
+        None.  With signing off every entry passes.  Election acks and
+        QC checks block consensus progress, so the rows enter the
+        scheduler's consensus priority class: they flush ahead of bulk
+        tx-ingest rows and their windows preempt bulk windows at lane
+        placement."""
         if not self._signing:
             return [a for a, _, _ in entries]
         from eges_tpu.crypto.verify_host import recover_signers
-        rec = recover_signers([(h, s) for _, h, s in entries], self.verifier)
+        rec = recover_signers([(h, s) for _, h, s in entries], self.verifier,
+                              priority="consensus")
         return [a if r == a else None
                 for (a, _, _), r in zip(entries, rec)]
 
@@ -1096,7 +1104,8 @@ class GeecNode:
             return False
         from eges_tpu.crypto.verify_host import recover_signers
         signer = recover_signers(
-            [(confirm.signing_hash(), confirm.sig)], self.verifier)[0]
+            [(confirm.signing_hash(), confirm.sig)], self.verifier,
+            priority="consensus")[0]
         return signer is not None and signer in self.membership
 
     # ------------------------------------------------------------------

@@ -59,6 +59,24 @@ the overlap actually happened.  Native verifiers don't expose the trio,
 so sims and the chaos harness keep the inline path and its
 byte-deterministic event ordering.
 
+**SLO-driven adaptive scheduling.** Every real-time knob lives in
+:class:`SchedulerConfig` (env-overridable as ``EGES_SCHED_*``).  With
+``adaptive=True`` a closed-loop controller runs one step per recorded
+window: it reads the flight recorder's recent wait/stage/compute
+timings plus the SLO engine's commit-latency burn rate (injectable
+:attr:`VerifierScheduler.burn_probe`) and steers the flush deadline and
+target bucket — large occupancy-biased windows while the burn is calm,
+small deadline-biased windows while the p99 objective is burning.
+Decisions journal as ``sched_adapt``.  Windows carry a priority class:
+``"consensus"`` submissions (election acks, QC checks) flush ahead of
+``"bulk"`` tx-ingest rows and their windows preempt bulk windows at
+lane placement, with per-class queue-wait metrics.  In mesh mode a
+straggler monitor hedges: a window whose wall-clock age exceeds its
+lane's flight-derived threshold (median × ``hedge_factor``) is
+speculatively re-placed on the least-loaded sibling lane; the first
+result wins, the loser is cancelled (or its results discarded), and
+stats/journal/ledger all record the window exactly once.
+
 This module must stay importable WITHOUT JAX (same contract as
 ``verify_host.py``): the bench parent and host-fallback node processes
 construct schedulers around native verifiers.
@@ -75,10 +93,12 @@ node/txpool lock domain.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import OrderedDict, deque
 from concurrent.futures import Future
+from dataclasses import dataclass, fields, replace
 
 import numpy as np
 
@@ -92,6 +112,71 @@ _MISS = object()
 # the shared bucket model (back-compat alias: scheduler and verifier
 # both round through crypto/bucketing.bucket_round now)
 _bucket16 = bucket_round
+
+
+@dataclass
+class SchedulerConfig:
+    """Every real-time knob of the scheduler in one bundle.
+
+    The scattered constructor kwargs (flush deadline, bucket cap, cache
+    size, breaker cooldown, mesh split floor) plus the adaptive
+    controller gains and hedging thresholds live here so bench runs and
+    tests can sweep them without monkeypatching scheduler internals.
+    Any field can be overridden from the environment as
+    ``EGES_SCHED_<FIELD>`` (upper-cased field name) — e.g.
+    ``EGES_SCHED_WINDOW_MS=0.5`` or ``EGES_SCHED_ADAPTIVE=1`` — read
+    once per :meth:`from_env` call (which is what the scheduler
+    constructor uses when no explicit config is passed).
+    """
+
+    # -- static window policy (the pre-adaptive scheduler surface) --
+    window_ms: float = 2.0        # flush deadline from the oldest entry
+    max_batch: int = 1024         # hard bucket cap per window
+    cache_size: int = 4096        # LRU recovery-cache entries
+    breaker_cooldown_s: float = 5.0  # per-lane breaker open time
+    min_split: int = 16           # smallest mesh chunk worth a dispatch
+    flight_ring: int = 256        # flight-recorder ring capacity
+    # -- adaptive windowing (closed-loop controller) --
+    adaptive: bool = False        # enable the per-window controller
+    slo_p99_ms: float = 50.0      # declared p99 window objective for the
+    #                               derived burn (no SLO probe attached)
+    min_window_ms: float = 0.25   # deadline floor when shrinking
+    max_window_ms: float = 8.0    # deadline ceiling when growing
+    min_target_rows: int = 32     # bucket floor when shrinking
+    shrink_gain: float = 0.5      # deadline multiplier while burning
+    grow_gain: float = 1.5        # deadline multiplier while calm
+    burn_shrink: float = 1.0      # burn >= this -> latency-bias
+    burn_relax: float = 0.5       # burn <= this -> occupancy-bias
+    adapt_every: int = 1          # controller period, recorded windows
+    adapt_recent: int = 32        # flight entries per decision
+    # -- hedged re-dispatch (mesh straggler speculation) --
+    hedge: bool = True            # speculative straggler re-placement
+    hedge_factor: float = 3.0     # straggler = age > lane median x this
+    hedge_min_windows: int = 4    # lane flights before its own median
+    #                               outranks the all-lane median
+    hedge_floor_ms: float = 25.0  # never hedge a window younger than this
+    hedge_poll_ms: float = 5.0    # straggler monitor poll period
+
+    @classmethod
+    def from_env(cls, env=None) -> "SchedulerConfig":
+        """A config built from defaults plus ``EGES_SCHED_*`` overrides
+        (field types are inferred from the defaults; booleans accept
+        1/true/yes/on).  A malformed value raises — a bad sweep knob
+        must fail loudly, not silently run the defaults."""
+        env = os.environ if env is None else env
+        kw = {}
+        for f in fields(cls):
+            raw = env.get("EGES_SCHED_" + f.name.upper())
+            if raw is None:
+                continue
+            if isinstance(f.default, bool):
+                kw[f.name] = raw.strip().lower() in ("1", "true",
+                                                     "yes", "on")
+            elif isinstance(f.default, int):
+                kw[f.name] = int(raw)
+            else:
+                kw[f.name] = float(raw)
+        return cls(**kw)
 
 
 class _DeviceLane:
@@ -143,7 +228,44 @@ class _PendingWindow:
 
     __slots__ = ("batch", "keys", "reason", "t0", "rows", "results",
                  "staged", "probing", "diverted", "computed", "failure",
-                 "finished", "t_dispatch", "t_collect")
+                 "finished", "t_dispatch", "t_collect", "ticket")
+
+
+class _WindowTicket:
+    """Shared placement identity for one mesh window and (when hedged)
+    its speculative duplicate.
+
+    Lane queues hold tickets; the straggler monitor re-places a ticket
+    whose wall-clock age exceeds its lane's flight-derived threshold
+    onto the least-loaded sibling lane, so the SAME ticket can sit in
+    two queues at once.  ``winner`` is claimed under the scheduler lock
+    by the first dispatch to finish: the loser is either *cancelled*
+    (still queued at claim time — dropped at pop, never touches a
+    device) or *wasted* (already executing — its results are discarded
+    and it skips ``_record_window``, so stats, journal events, flight
+    entries and ledger charges all happen exactly once per window).
+    Every field is guarded by the owning scheduler's ``self._lock``
+    except ``batch``/``reason``/``klass``/``rows``/``lane``, which are
+    immutable after construction.
+    """
+
+    __slots__ = ("batch", "reason", "klass", "rows", "lane",
+                 "hedge_lane", "t_placed", "hedged", "winner")
+
+    def __init__(self, batch, reason: str, klass: str, lane: int):
+        self.batch = batch
+        self.reason = reason
+        self.klass = klass           # "consensus" | "bulk"
+        self.rows = len(batch)
+        self.lane = lane             # primary placement lane index
+        self.hedge_lane = None       # sibling index once hedged
+        # Straggler aging is wall-clock by nature: a stuck lane freezes
+        # the sim's virtual clock, so a virtual-time age could never
+        # fire.  Hedges journal nothing, so determinism holds.
+        # analysis: allow-determinism(hedge aging; hedges journal nothing)
+        self.t_placed = time.monotonic()
+        self.hedged = False
+        self.winner = None           # winning lane index once recorded
 
 
 class VerifierScheduler:
@@ -155,14 +277,27 @@ class VerifierScheduler:
     scheduler wherever they previously held a ``BatchVerifier``.
     """
 
-    def __init__(self, verifier, *, window_ms: float = 2.0,
-                 max_batch: int = 1024, cache_size: int = 4096,
-                 breaker_cooldown_s: float = 5.0, breaker_clock=None,
-                 min_split: int = 16):
+    def __init__(self, verifier, *, config: SchedulerConfig | None = None,
+                 breaker_clock=None, **overrides):
+        # config consolidation: explicit kwargs (the historical
+        # ``window_ms=``/``max_batch=``/... surface every call site
+        # already uses) override a copy of the passed config, which
+        # itself defaults to SchedulerConfig.from_env() — so env sweeps,
+        # config objects and legacy kwargs compose without ambiguity
+        cfg = config if config is not None else SchedulerConfig.from_env()
+        if overrides:
+            cfg = replace(cfg, **overrides)
+        self.config = cfg
         self._verifier = verifier
-        self._window_s = window_ms / 1e3
-        self.max_batch = max_batch
-        self.cache_size = cache_size
+        window_ms = cfg.window_ms
+        if cfg.adaptive:
+            # the controller moves the deadline inside
+            # [min_window_ms, max_window_ms]; start inside the band
+            window_ms = min(max(window_ms, cfg.min_window_ms),
+                            cfg.max_window_ms)
+        self._window_s = window_ms / 1e3  # guarded-by: _lock
+        self.max_batch = cfg.max_batch
+        self.cache_size = cfg.cache_size
         # injectable device-failure hook (chaos harness / tests): called
         # with the row count right before every device dispatch, on any
         # lane; raising is treated exactly like the device itself
@@ -176,7 +311,7 @@ class VerifierScheduler:
         # lane's breaker, failure re-opens it.  ``breaker_clock`` is
         # injectable so chaos runs can measure the cooldown in
         # deterministic virtual time.
-        self.breaker_cooldown_s = breaker_cooldown_s
+        self.breaker_cooldown_s = cfg.breaker_cooldown_s
         self.breaker_clock = breaker_clock or time.monotonic
         # ONE condition guards every mutable field below (including all
         # lane queues); dispatch + lane threads wait on it.
@@ -201,16 +336,20 @@ class VerifierScheduler:
             for lane in self._lanes)
         # placement: a window larger than this splits across lanes
         # (floor min_split keeps chunks worth a device dispatch)
-        self.min_split = max(1, min_split)
+        self.min_split = max(1, cfg.min_split)
         self._chunk_cap = max(self.min_split,
-                              -(-max_batch // len(self._lanes)))
+                              -(-cfg.max_batch // len(self._lanes)))
         self._rr = 0  # round-robin cursor breaking equal-load ties
         # LRU recovery cache: (sighash, sig) -> 20-byte address or None
         # (a deterministic recovery failure is cached too — re-gossiped
         # garbage must not re-reach the device either)
         self._cache: OrderedDict[tuple, object] = OrderedDict()  # guarded-by: _lock
-        # key -> ([futures], t_submit): identical in-flight keys share
-        # one row (in-batch dedup), arrival order preserved
+        # key -> [futures, t_submit, klass]: identical in-flight keys
+        # share one row (in-batch dedup), arrival order preserved.
+        # ``klass`` is the priority class ("consensus" | "bulk"): dedup
+        # promotes a shared row to the higher class, and the flush
+        # selects consensus rows first when the window cannot take
+        # everything pending.
         self._pending: OrderedDict[tuple, list] = OrderedDict()  # guarded-by: _lock
         # key -> trace id of the submitter's active span (txpool ingest,
         # quorum verify): commit-anatomy linkage tying flight-recorder
@@ -245,6 +384,14 @@ class VerifierScheduler:
             "breaker_diverted": 0, "window_splits": 0,
             "straggler_diverts": 0, "pipeline_windows": 0,
             "pipeline_overlapped": 0,
+            # hedged re-dispatch accounting: every hedge ends as either
+            # a cancelled loser (never ran) or a wasted loser (ran,
+            # discarded) — hedges == hedge_cancelled + hedge_wasted at
+            # quiescence is the exactly-once recording invariant
+            "hedges": 0, "hedge_wins": 0, "hedge_cancelled": 0,
+            "hedge_wasted": 0,
+            # closed-loop controller + flight-ring loss accounting
+            "adapt_decisions": 0, "flight_dropped": 0,
         }
         # optional consensus event journal (utils/journal.py), attached
         # by the first owning node; flush decisions land in its stream
@@ -254,22 +401,60 @@ class VerifierScheduler:
         # lane/device attribution, in a bounded ring behind the
         # thw_flight RPC and the observatory waterfall.  Wall-clock by
         # nature (it measures real phase durations) and never journaled,
-        # so it stays outside the determinism contract.
-        self._flights: deque = deque(maxlen=256)  # guarded-by: _lock
+        # so it stays outside the determinism contract.  The ring size
+        # is configurable (flight_ring) and an append that evicts the
+        # oldest entry counts into stats["flight_dropped"] +
+        # verifier.flight_dropped — silent loss under load is visible.
+        self._flights: deque = deque(maxlen=max(1, cfg.flight_ring))  # guarded-by: _lock
         self._flight_seq = 0  # guarded-by: _lock
+        # adaptive windowing: the controller consumes recent flight
+        # timings plus the SLO burn probe and steers the flush deadline
+        # (_window_s) and target bucket (_target_rows) per window
+        self._adaptive = cfg.adaptive
+        self._target_rows = cfg.max_batch  # guarded-by: _lock
+        self._adapt_windows = 0  # guarded-by: _lock
+        # injectable SLO feedback: a zero-arg callable returning the
+        # (fast, slow) burn-rate pair of the commit-latency objective
+        # (harness/slo.py SLOEngine.burn_probe); set like failure_hook /
+        # breaker_clock before traffic.  Without one the controller
+        # derives burn from recent window p99 against config.slo_p99_ms.
+        self.burn_probe = None
+        # per-class queue-wait samples (ms) behind stats()'s
+        # class_wait_ms percentiles — the bench adaptive stage reads
+        # per-class p99 here without scraping the labeled histograms
+        self._class_waits = {
+            "bulk": deque(maxlen=2048),
+            "consensus": deque(maxlen=2048),
+        }  # guarded-by: _lock
+        # hedged re-dispatch: live (unrecorded) window tickets the
+        # straggler monitor scans; mesh-only — with one lane there is
+        # no sibling to hedge onto
+        self._hedge_on = bool(cfg.hedge) and len(self._lanes) > 1
+        self._hedge_poll_s = max(0.5e-3, cfg.hedge_poll_ms / 1e3)
+        self._tickets: set = set()  # guarded-by: _lock
+        self._hedge_thread: threading.Thread | None = None
         if len(self._lanes) > 1:
             from eges_tpu.utils.metrics import DEFAULT as metrics
             metrics.gauge("verifier.mesh_devices").set(len(self._lanes))
 
     # -- public async API -------------------------------------------------
 
-    def submit(self, sighash: bytes, sig: bytes) -> Future:  # thread-entry hot-path-entry
+    def submit(self, sighash: bytes, sig: bytes,
+               priority: str = "bulk") -> Future:  # thread-entry hot-path-entry
         """Queue one ``(sighash32, sig65)`` recovery; the future resolves
         to the 20-byte signer address, or ``None`` for an invalid
         signature.  Cache hits resolve immediately; misses ride the next
-        coalesced batch."""
+        coalesced batch.
+
+        ``priority`` is the window class: ``"consensus"`` rows
+        (election acks, QC checks — anything consensus blocks on) are
+        flushed ahead of ``"bulk"`` tx-ingest rows when a window can't
+        take everything pending, and their windows preempt bulk windows
+        at lane placement.  In-flight dedup promotes a shared row to
+        the higher class."""
         from eges_tpu.utils.metrics import DEFAULT as metrics
 
+        klass = "consensus" if priority == "consensus" else "bulk"
         fut: Future = Future()
         if len(sig) != 65 or len(sighash) != 32:
             # malformed entries never reach the device (the zero-fill
@@ -308,12 +493,15 @@ class VerifierScheduler:
                 row = self._pending.get(key)
                 if row is not None:
                     # in-flight dedup: same signature already queued by
-                    # another caller — share its batch row
+                    # another caller — share its batch row (and promote
+                    # it if this caller is consensus-critical)
                     row[0].append(fut)
                     self._stats["coalesced_rows"] += 1
+                    if klass == "consensus":
+                        row[2] = "consensus"
                 else:
                     # analysis: allow-determinism(coalescing deadline is real-time by contract; chaos pins batching via max_batch kicks)
-                    self._pending[key] = [[fut], time.monotonic()]
+                    self._pending[key] = [[fut], time.monotonic(), klass]
                     from eges_tpu.utils import tracing
                     ctx = tracing.DEFAULT.current_context()
                     if (ctx is not None and len(self._pending_trace)
@@ -324,7 +512,7 @@ class VerifierScheduler:
                             < self._PENDING_TRACE_CAP):
                         self._pending_origin[key] = rec
                     self._ensure_thread()
-                if len(self._pending) >= self.max_batch:
+                if len(self._pending) >= self._flush_target():
                     self._kick = True
                 self._lock.notify_all()
         if resolve is not _MISS:
@@ -350,13 +538,15 @@ class VerifierScheduler:
 
     # -- synchronous facades (BatchVerifier-compatible) -------------------
 
-    def recover_signers(self, entries) -> list:
+    def recover_signers(self, entries, *, priority: str = "bulk") -> list:
         """Batch-recover ``(sighash32, sig65)`` entries; one 20-byte
         address or ``None`` per entry.  Submits everything, kicks the
         window (coalescing with whatever else is pending right now), and
         blocks for the results — ``verify_host.recover_signers``
-        delegates here when the node's verifier is a scheduler."""
-        futs = [self.submit(h, s) for h, s in entries]
+        delegates here when the node's verifier is a scheduler.
+        ``priority="consensus"`` marks the rows consensus-critical (see
+        :meth:`submit`)."""
+        futs = [self.submit(h, s, priority) for h, s in entries]
         self.kick()
         out = []
         for (h, s), f in zip(entries, futs):
@@ -369,7 +559,8 @@ class VerifierScheduler:
                            if len(s) == 65 and len(h) == 32 else None)
         return out
 
-    def recover_addresses(self, sigs: np.ndarray, hashes: np.ndarray):
+    def recover_addresses(self, sigs: np.ndarray, hashes: np.ndarray,
+                          *, priority: str = "bulk"):
         """Array-in/array-out facade matching
         ``BatchVerifier.recover_addresses`` so the txpool window flush,
         block body validation, and the EVM ecrecover precompile route
@@ -380,7 +571,8 @@ class VerifierScheduler:
         if n == 0:
             return addrs, ok
         rec = self.recover_signers(
-            [(bytes(hashes[i]), bytes(sigs[i])) for i in range(n)])
+            [(bytes(hashes[i]), bytes(sigs[i])) for i in range(n)],
+            priority=priority)
         for i, r in enumerate(rec):
             if r is not None:
                 addrs[i] = np.frombuffer(r, np.uint8)
@@ -438,22 +630,32 @@ class VerifierScheduler:
             self._admission_done = True
             self._lock.notify_all()
             lane_threads = [lane.thread for lane in self._lanes]
+            hedge_thread = self._hedge_thread
         for lt in lane_threads:
             if lt is not None:
                 lt.join(timeout)
+        if hedge_thread is not None:
+            hedge_thread.join(timeout)
         leftovers: list[list] = []
         with self._lock:
+            seen_tickets: set = set()
             for lane in self._lanes:
                 while lane.queue:
-                    batch, _reason = lane.queue.popleft()
-                    lane.queued_rows -= len(batch)
-                    leftovers.extend(row for _k, row in batch)
+                    tk = lane.queue.popleft()
+                    lane.queued_rows -= tk.rows
+                    # a hedged ticket can sit in two queues; drain its
+                    # rows once, and skip tickets a dispatch already won
+                    if tk in seen_tickets or tk.winner is not None:
+                        continue
+                    seen_tickets.add(tk)
+                    leftovers.extend(row for _k, row in tk.batch)
+            self._tickets.clear()
             leftovers.extend(self._pending.values())
             self._pending.clear()
             self._pending_trace.clear()
             self._pending_origin.clear()
-        for futs, _t in leftovers:
-            for f in futs:
+        for row in leftovers:
+            for f in row[0]:
                 if not f.done():
                     f.set_exception(RuntimeError(
                         "verifier scheduler closed with unresolved futures"))
@@ -495,11 +697,28 @@ class VerifierScheduler:
                 devices.append(d)
             out["devices"] = devices
             out["flight_windows"] = self._flight_seq
+            out["flight_capacity"] = self._flights.maxlen
+            out["adaptive"] = self._adaptive
+            out["window_ms"] = round(self._window_s * 1e3, 4)
+            out["target_rows"] = self._target_rows
+            from eges_tpu.utils.metrics import percentile
+            class_wait = {}
+            for klass in sorted(self._class_waits):
+                vals = sorted(self._class_waits[klass])
+                class_wait[klass] = {
+                    "count": len(vals),
+                    "p50_ms": round(percentile(vals, 50.0), 3),
+                    "p99_ms": round(percentile(vals, 99.0), 3),
+                }
+            out["class_wait_ms"] = class_wait
         return out
 
     def flights(self, limit: int = 0) -> list[dict]:
         """Flight-recorder entries, oldest first (the ring keeps the
-        newest 256 windows); ``limit`` keeps only the newest N.  Each
+        newest ``config.flight_ring`` windows — default 256 — and
+        evictions count into ``stats()["flight_dropped"]`` /
+        ``verifier.flight_dropped``); ``limit`` keeps only the newest
+        N.  Each
         entry is one window's lifecycle: phase timestamps
         (``t_submit``/``t_begin``/``t_dispatch``/``t_collect``/
         ``t_done``), phase durations, and lane/device attribution."""
@@ -510,6 +729,12 @@ class VerifierScheduler:
         return [dict(f) for f in evs]
 
     # -- internals --------------------------------------------------------
+
+    def _flush_target(self) -> int:
+        """Rows that flush a window as "full" right now — ``max_batch``
+        statically, the controller's ``_target_rows`` (never above the
+        cap) when adaptive.  Caller holds ``self._lock``."""
+        return min(self.max_batch, max(1, self._target_rows))
 
     def _ensure_thread(self) -> None:
         # caller holds self._lock
@@ -568,8 +793,8 @@ class VerifierScheduler:
             with self._lock:
                 leftovers = list(self._pending.values())
                 self._pending.clear()
-            for futs, _t in leftovers:
-                for f in futs:
+            for row in leftovers:
+                for f in row[0]:
                     if not f.done():
                         f.set_exception(exc)
             raise
@@ -591,9 +816,13 @@ class VerifierScheduler:
                 if not self._pending and self._closed:
                     return
                 # coalescing window: more submitters may land until the
-                # bucket fills, a sync caller kicks, close drains, or
-                # the deadline measured from the OLDEST entry expires
-                while (len(self._pending) < self.max_batch
+                # bucket fills (the adaptive controller's target, capped
+                # at max_batch), a sync caller kicks, close drains, or
+                # the deadline measured from the OLDEST entry expires —
+                # both the target and the deadline are re-read each
+                # iteration so a controller decision applies to the
+                # window being coalesced right now
+                while (len(self._pending) < self._flush_target()
                         and not self._kick and not self._closed
                         and self._pending):
                     oldest = next(iter(self._pending.values()))[1]
@@ -607,11 +836,23 @@ class VerifierScheduler:
                 # "close" outranks "kick": close() raises the kick flag
                 # to wake the window wait, and the shutdown drain must
                 # be journaled as the documented flush_close step
-                reason = ("full" if len(self._pending) >= self.max_batch
+                limit = self._flush_target()
+                reason = ("full" if len(self._pending) >= limit
                           else "close" if self._closed
                           else "kick" if self._kick else "deadline")
                 self._stats["flush_" + reason] += 1
-                keys = list(self._pending)[: self.max_batch]
+                if len(self._pending) > limit:
+                    # overfull window: consensus-class rows outrank bulk
+                    # for the seats this flush has (within a class,
+                    # arrival order is preserved)
+                    keys = [k for k, row in self._pending.items()
+                            if row[2] == "consensus"][:limit]
+                    if len(keys) < limit:
+                        taken = set(keys)
+                        keys += [k for k in self._pending
+                                 if k not in taken][:limit - len(keys)]
+                else:
+                    keys = list(self._pending)
                 batch = [(k, self._pending.pop(k)) for k in keys]
                 if not self._pending:
                     self._kick = False
@@ -644,6 +885,11 @@ class VerifierScheduler:
         saturating window reaches every device at once.  Equal-load
         ties rotate round-robin — an idle mesh still spreads
         back-to-back windows instead of pinning device 0.
+
+        A window carrying any consensus-class row is placed at the HEAD
+        of its lane's queue (placement preemption): queued bulk
+        tx-ingest windows wait, already-dispatched ones are not
+        interrupted.
         """
         from eges_tpu.utils.metrics import DEFAULT as metrics
 
@@ -654,6 +900,8 @@ class VerifierScheduler:
             n_chunks = min(n_chunks, max(1, rows // self.min_split))
         size = -(-rows // n_chunks)
         chunks = [batch[i:i + size] for i in range(0, rows, size)]
+        klass = ("consensus" if any(row[2] == "consensus"
+                                    for _k, row in batch) else "bulk")
         # queue depths are captured under the lock and emitted after it:
         # the metrics registry takes its own lock, and nesting it inside
         # the scheduler condition would order-couple the two on every
@@ -668,12 +916,19 @@ class VerifierScheduler:
             if len(chunks) > 1:
                 self._stats["window_splits"] += 1
             for chunk, lane in zip(chunks, order):
-                lane.queue.append((chunk, reason))
-                lane.queued_rows += len(chunk)
+                tk = _WindowTicket(chunk, reason, klass, lane.index)
+                if klass == "consensus":
+                    lane.queue.appendleft(tk)
+                else:
+                    lane.queue.append(tk)
+                self._tickets.add(tk)
+                lane.queued_rows += tk.rows
                 lane.max_queue_depth = max(lane.max_queue_depth,
                                            len(lane.queue))
                 depth_updates.append((lane.index, len(lane.queue)))
                 self._ensure_lane_thread(lane)
+            if self._hedge_on:
+                self._ensure_hedge_thread()
             self._lock.notify_all()
         if len(chunks) > 1:
             metrics.counter("verifier.mesh_window_splits").inc()
@@ -707,23 +962,37 @@ class VerifierScheduler:
                     if not lane.queue and pending is None:
                         return  # closed, admission drained, queue empty
                     nxt = None
-                    reason = ""
                     depth = None
+                    cancelled = False
                     if lane.queue:
-                        nxt, reason = lane.queue.popleft()
-                        lane.queued_rows -= len(nxt)
-                        lane.inflight_rows += len(nxt)
+                        tk = lane.queue.popleft()
+                        lane.queued_rows -= tk.rows
                         depth = len(lane.queue)
+                        if tk.winner is not None:
+                            # the hedge raced us and its sibling dispatch
+                            # already recorded this window — drop the
+                            # loser before it touches the device (the
+                            # "cancelled" outcome; a loser that already
+                            # started finishes as "wasted" instead)
+                            self._stats["hedge_cancelled"] += 1
+                            self._tickets.discard(tk)
+                            cancelled = True
+                        else:
+                            nxt = tk
+                            lane.inflight_rows += tk.rows
                 if depth is not None:
                     # emitted after release: the gauge takes the metrics
                     # registry lock (fail-under-lock)
                     metrics.gauge(
                         f"verifier.mesh_queue_depth;device={lane.index}") \
                         .set(depth)
+                if cancelled:
+                    metrics.counter("verifier.hedge_cancelled").inc()
                 nxt_p: _PendingWindow | None = None
                 if nxt is not None:
                     if pipelined:
-                        nxt_p = self._begin_batch(lane, nxt, reason)
+                        nxt_p = self._begin_batch(lane, nxt.batch,
+                                                  nxt.reason, ticket=nxt)
                         if (pending is not None and nxt_p.staged is not None
                                 and nxt_p.failure is None):
                             # this begin's H2D ran while the previous
@@ -734,13 +1003,14 @@ class VerifierScheduler:
                                 lane.stats["pipeline_overlapped"] += 1
                     else:
                         try:
-                            self._run_batch(lane, nxt, reason)
+                            self._run_batch(lane, nxt.batch, nxt.reason,
+                                            ticket=nxt)
                         # analysis: allow-swallow(futures already resolved/failed in _run_batch finally; the lane survives to its next window)
                         except Exception:
                             pass
                         finally:
                             with self._lock:
-                                lane.inflight_rows -= len(nxt)
+                                lane.inflight_rows -= nxt.rows
                 if pending is not None:
                     self._finish_lane_window(lane, pending)
                     pending = None
@@ -757,6 +1027,8 @@ class VerifierScheduler:
                 leftovers = list(lane.queue)
                 lane.queue.clear()
                 lane.queued_rows = 0
+                for tk in leftovers:
+                    self._tickets.discard(tk)
             unfinished = []
             if pending is not None and not pending.finished:
                 unfinished.append(pending)
@@ -766,13 +1038,16 @@ class VerifierScheduler:
             for p in unfinished:
                 with self._lock:
                     lane.inflight_rows -= p.rows
-                for _k, (futs, _t) in p.batch:
-                    for f in futs:
+                for _k, row in p.batch:
+                    for f in row[0]:
                         if not f.done():
                             f.set_exception(exc)
-            for b, _r in leftovers:
-                for _k, (futs, _t) in b:
-                    for f in futs:
+            for tk in leftovers:
+                # a hedged ticket's sibling dispatch may still win; only
+                # fail futures no other lane will resolve (done() guards
+                # make the race harmless either way)
+                for _k, row in tk.batch:
+                    for f in row[0]:
                         if not f.done():
                             f.set_exception(exc)
             raise
@@ -841,17 +1116,19 @@ class VerifierScheduler:
 
     # -- window execution -------------------------------------------------
 
-    def _run_batch(self, lane: _DeviceLane, batch, reason: str) -> None:
+    def _run_batch(self, lane: _DeviceLane, batch, reason: str,
+                   ticket: "_WindowTicket | None" = None) -> None:
         """Dispatch one coalesced window (or mesh chunk) on ``lane``,
         OUTSIDE the scheduler lock (the device call is the long pole;
         submitters keep queueing into the next window meanwhile).  The
         inline composition of the split-phase halves: begin (fill +
         dispatch) then finish (collect + record + resolve) with no
         overlap — the pre-pipeline behavior."""
-        self._finish_batch(lane, self._begin_batch(lane, batch, reason))
+        self._finish_batch(lane,
+                           self._begin_batch(lane, batch, reason, ticket))
 
-    def _begin_batch(self, lane: _DeviceLane, batch,
-                     reason: str) -> _PendingWindow:
+    def _begin_batch(self, lane: _DeviceLane, batch, reason: str,
+                     ticket: "_WindowTicket | None" = None) -> _PendingWindow:
         """Phase 1 of one window: singleton/breaker divert decisions,
         numpy fill, and the device dispatch.  On a pipeline-capable
         target the dispatch is split-phase (stage H2D + async commit,
@@ -863,6 +1140,7 @@ class VerifierScheduler:
         p.batch = batch
         p.keys = [k for k, _ in batch]
         p.reason = reason
+        p.ticket = ticket
         p.rows = len(batch)
         p.results = [None] * p.rows
         p.staged = None
@@ -973,7 +1251,34 @@ class VerifierScheduler:
                 # analysis: allow-determinism(flight recorder timestamps are wall-clock by design and never journaled)
                 p.t_collect = time.monotonic()
             if p.failure is None and p.computed:
-                self._record_window(lane, p, mesh)
+                won = True
+                tk = p.ticket
+                if tk is not None:
+                    hedge_won = False
+                    with self._lock:
+                        if tk.winner is None:
+                            # first dispatch to finish claims the window
+                            tk.winner = lane.index
+                            self._tickets.discard(tk)
+                            if tk.hedged and lane.index == tk.hedge_lane:
+                                self._stats["hedge_wins"] += 1
+                                hedge_won = True
+                        else:
+                            # the sibling dispatch won while we computed:
+                            # discard these (bit-identical) results —
+                            # skipping _record_window keeps stats,
+                            # journal, flights and ledger charges
+                            # exactly-once per window
+                            won = False
+                            self._stats["hedge_wasted"] += 1
+                    if hedge_won:
+                        from eges_tpu.utils.metrics import DEFAULT as metrics
+                        metrics.counter("verifier.hedge_wins").inc()
+                    elif not won:
+                        from eges_tpu.utils.metrics import DEFAULT as metrics
+                        metrics.counter("verifier.hedge_wasted").inc()
+                if won:
+                    self._record_window(lane, p, mesh)
         except BaseException as exc:
             if p.failure is None:
                 p.failure = exc
@@ -982,10 +1287,13 @@ class VerifierScheduler:
             # a blocked recover_signers caller is a wedged consensus
             # node.  If the batch died before results were computed,
             # its futures FAIL with that error rather than masquerading
-            # as None ("invalid signature").
+            # as None ("invalid signature").  A hedge loser runs this
+            # loop too: the winner resolved everything already, so the
+            # done() guard makes it a no-op (and both dispatches compute
+            # the same batch, so the results are bit-identical anyway).
             p.finished = True
-            for (_, (futs, _)), r in zip(batch, p.results):
-                for f in futs:
+            for (_, row), r in zip(batch, p.results):
+                for f in row[0]:
                     if f.done():
                         continue
                     if p.computed:
@@ -1012,8 +1320,11 @@ class VerifierScheduler:
         pad = getattr(lane.target, "_pad", None) \
             or getattr(self._verifier, "_pad", None) or bucket_round
         bucket = pad(rows) if rows > 1 else 1  # diverted rows pad nothing
-        oldest = min(t for _, (_, t) in batch)
+        oldest = min(row[1] for _, row in batch)
         waited = p.t0 - oldest
+        tk = p.ticket
+        klass = ("consensus" if any(row[2] == "consensus"
+                                    for _, row in batch) else "bulk")
         # one flight-recorder entry per computed window: lifecycle phase
         # boundaries + lane attribution (the thw_flight RPC surface)
         t_dispatch = p.t_dispatch if p.t_dispatch is not None else done
@@ -1030,8 +1341,13 @@ class VerifierScheduler:
             "stage_ms": round((t_dispatch - p.t0) * 1e3, 3),
             "compute_ms": round((t_collect - t_dispatch) * 1e3, 3),
             "total_ms": round((done - oldest) * 1e3, 3),
+            "klass": klass,
+            "hedged": bool(tk is not None and tk.hedged),
+            "hedge_win": bool(tk is not None and tk.hedged
+                              and lane.index == tk.hedge_lane),
             "traces": [],
         }
+        flight_evicts = False
         with self._lock:
             # blk/trace linkage: distinct submitter trace ids riding this
             # window (txpool ingest spans, quorum verifies) — popped here
@@ -1069,7 +1385,18 @@ class VerifierScheduler:
             flight["cache_rows"] = cache_rows
             flight["window"] = self._flight_seq
             self._flight_seq += 1
+            if (self._flights.maxlen is not None
+                    and len(self._flights) >= self._flights.maxlen):
+                # the ring is full: this append evicts the oldest entry
+                # — the silent-loss signal the flight_dropped counter
+                # and observatory surface
+                self._stats["flight_dropped"] += 1
+                flight_evicts = True
             self._flights.append(flight)
+            # per-class queue-wait samples behind stats()'s percentiles
+            for _k, row in batch:
+                self._class_waits[row[2]].append(
+                    (p.t0 - row[1]) * 1e3)
         # per-origin window cost: each captured origin gets its row
         # count plus its row-share of the window's wall-clock interior,
         # booked as host-ms when the rows were host-served (singleton
@@ -1083,9 +1410,17 @@ class VerifierScheduler:
                            host_ms=ms if host_served else 0.0,
                            device_ms=0.0 if host_served else ms)
         metrics.counter("verifier.flight_windows").inc()
-        for _, (_, t_sub) in batch:
+        if flight_evicts:
+            metrics.counter("verifier.flight_dropped").inc()
+        for _, row in batch:
+            w = p.t0 - row[1]
             metrics.histogram("verifier.sched_queue_wait_seconds") \
-                .observe(p.t0 - t_sub)
+                .observe(w)
+            # per-class queue-wait: the priority-preemption deliverable
+            # is visible as a class-labeled histogram split
+            metrics.histogram(
+                "verifier.sched_queue_wait_seconds;class=%s"
+                % row[2]).observe(w)
         metrics.histogram("verifier.sched_batch_rows").observe(rows)
         metrics.histogram("verifier.sched_occupancy") \
             .observe(rows / bucket)
@@ -1131,6 +1466,200 @@ class VerifierScheduler:
                                occupancy=round(rows / bucket, 4),
                                diverted=p.diverted,
                                queue_wait_ms=round(waited * 1e3, 3))
+        if self._adaptive:
+            # one controller step per RECORDED window (hedge losers
+            # never get here), after the window's own journal events so
+            # a sched_adapt decision always follows the flush it saw
+            self._adapt_step()
+
+
+    # -- adaptive windowing (closed-loop controller) ----------------------
+
+    def _adapt_step(self) -> None:  # hot-path-entry
+        """One closed-loop controller step: telemetry in, window policy
+        out.
+
+        Inputs are the flight recorder's recent wait/stage/compute/total
+        timings plus the SLO engine's commit-latency burn rate (via the
+        injectable :attr:`burn_probe`; without one, burn derives from
+        the recent window p99 against ``config.slo_p99_ms``).  Output is
+        the flush deadline (``_window_s``) and target bucket
+        (``_target_rows``) the NEXT windows coalesce under: burning the
+        p99 objective shrinks both (deadline-biased small buckets, less
+        queueing ahead of each dispatch); a calm burn grows them back
+        toward occupancy.  Every decision journals as ``sched_adapt``
+        with its inputs — the measured value attrs are wall-clock
+        derived and volatile-stripped by the chaos canonical dump, while
+        the event COUNT stays pinned by kick-driven batching, so
+        determinism checks still byte-match under the virtual clock.
+        """
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+        from eges_tpu.utils.metrics import percentile
+
+        cfg = self.config
+        probe = self.burn_probe
+        burn_fast = burn_slow = None
+        if probe is not None:
+            try:
+                burn_fast, burn_slow = probe()
+            # analysis: allow-swallow(a torn-down SLO engine must not
+            # take the verify hot path down with it — the controller
+            # falls back to the flight-derived burn)
+            except Exception:
+                burn_fast = burn_slow = None
+        decision = None
+        with self._lock:
+            self._adapt_windows += 1
+            if self._adapt_windows % max(1, cfg.adapt_every):
+                return
+            recent = list(self._flights)[-max(1, cfg.adapt_recent):]
+            totals = sorted(f["total_ms"] for f in recent)
+            waits = sorted(f["wait_ms"] for f in recent)
+            p99 = percentile(totals, 99.0)
+            if burn_fast is None:
+                derived = (p99 / cfg.slo_p99_ms
+                           if cfg.slo_p99_ms > 0 else 0.0)
+                burn_fast = burn_slow = derived
+            burn = max(burn_fast, burn_slow)
+            window_ms = self._window_s * 1e3
+            target = self._target_rows
+            if burn >= cfg.burn_shrink:
+                # the p99 objective is burning: bias to latency —
+                # shorter deadline, smaller bucket
+                window_ms = max(cfg.min_window_ms,
+                                window_ms * cfg.shrink_gain)
+                target = max(cfg.min_target_rows, target // 2)
+                why = "shrink"
+            elif burn <= cfg.burn_relax:
+                # calm: trade latency headroom back for occupancy
+                window_ms = min(cfg.max_window_ms,
+                                window_ms * cfg.grow_gain)
+                target = min(cfg.max_batch, target * 2)
+                why = "grow"
+            else:
+                why = "hold"
+            self._window_s = window_ms / 1e3
+            self._target_rows = target
+            self._stats["adapt_decisions"] += 1
+            decision = {
+                "window_ms": round(window_ms, 4),
+                "target_rows": target,
+                "burn_fast": round(float(burn_fast), 4),
+                "burn_slow": round(float(burn_slow), 4),
+                "p99_ms": round(p99, 3),
+                "wait_p50_ms": round(percentile(waits, 50.0), 3),
+                "decision": why,
+            }
+        # gauges + journal OUTSIDE the condition (fail-under-lock)
+        metrics.gauge("verifier.sched_window_ms").set(
+            decision["window_ms"])
+        metrics.gauge("verifier.sched_target_rows").set(
+            decision["target_rows"])
+        metrics.counter("verifier.adapt_decisions").inc()
+        journal = self.journal
+        if journal is not None:
+            journal.record("sched_adapt", **decision)
+
+    # -- hedged re-dispatch (straggler speculation) -----------------------
+
+    def _ensure_hedge_thread(self) -> None:
+        # caller holds self._lock; the monitor starts lazily on the
+        # first mesh placement so single-lane schedulers (and meshes
+        # with hedging disabled) never spawn it
+        if self._hedge_thread is None or not self._hedge_thread.is_alive():
+            self._hedge_thread = threading.Thread(
+                target=self._hedge_loop, name="verifier-hedge",
+                daemon=True)
+            self._hedge_thread.start()
+
+    def _lane_threshold_ms(self, lane_index: int) -> float:
+        """Straggler threshold for one lane: the median window total
+        over this lane's recent flights × ``hedge_factor`` — the
+        all-lane median until the lane has ``hedge_min_windows`` of its
+        own history — floored at ``hedge_floor_ms`` so an idle mesh
+        never hedges on noise.  Caller holds ``self._lock``."""
+        from eges_tpu.utils.metrics import percentile
+
+        cfg = self.config
+        lane_tot = sorted(f["total_ms"] for f in self._flights
+                          if f["device"] == lane_index)
+        if len(lane_tot) >= cfg.hedge_min_windows:
+            base = percentile(lane_tot, 50.0)
+        else:
+            all_tot = sorted(f["total_ms"] for f in self._flights)
+            base = percentile(all_tot, 50.0) if all_tot else 0.0
+        return max(cfg.hedge_floor_ms, cfg.hedge_factor * base)
+
+    def _hedge_scan(self) -> list:
+        """One straggler-monitor pass (caller holds ``self._lock``):
+        every live, un-hedged ticket whose wall-clock age exceeds its
+        lane's flight-derived threshold is speculatively re-placed on
+        the least-loaded OTHER lane with a closed breaker.  Returns the
+        tickets hedged this pass (for post-lock metrics emission)."""
+        if not self._tickets:
+            return []
+        # Straggler aging is wall-clock by nature — a stuck lane freezes
+        # the sim's virtual clock exactly when hedging must fire; hedged
+        # windows journal nothing, so determinism holds.
+        # analysis: allow-determinism(hedge aging; hedges journal nothing)
+        now = time.monotonic()
+        picks = []
+        for tk in list(self._tickets):
+            if tk.hedged or tk.winner is not None:
+                continue
+            age_ms = (now - tk.t_placed) * 1e3
+            if age_ms < self._lane_threshold_ms(tk.lane):
+                continue
+            sibs = [L for L in self._lanes
+                    if L.index != tk.lane and L.breaker == "closed"]
+            if not sibs:
+                continue
+            sib = min(sibs, key=lambda L: (L.load(), L.index))
+            tk.hedged = True
+            tk.hedge_lane = sib.index
+            # the duplicate rides the sibling's queue like any other
+            # window (consensus class still preempts); first result
+            # wins — the loser is cancelled at pop or wasted at finish
+            if tk.klass == "consensus":
+                sib.queue.appendleft(tk)
+            else:
+                sib.queue.append(tk)
+            sib.queued_rows += tk.rows
+            sib.max_queue_depth = max(sib.max_queue_depth,
+                                      len(sib.queue))
+            self._stats["hedges"] += 1
+            self._ensure_lane_thread(sib)
+            picks.append(tk)
+        if picks:
+            self._lock.notify_all()
+        return picks
+
+    def _hedge_loop(self) -> None:  # hot-path-entry
+        """Straggler monitor: while any window ticket is live, poll its
+        age against the lane's flight-derived threshold and re-place
+        stragglers on a sibling lane.  Polling is REAL time on purpose
+        (see ``_hedge_scan``): the injectable virtual clock freezes
+        while a stuck window blocks the sim's clock thread, which is
+        precisely when hedging has to fire.  Hedges touch stats,
+        metrics and the flight ring only — never the journal — so chaos
+        determinism is unaffected by when (or whether) they happen."""
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+
+        while True:
+            with self._lock:
+                if self._closed and self._admission_done:
+                    return
+                if not self._tickets:
+                    # nothing in flight: sleep until a placement (or
+                    # close) notifies the condition
+                    self._lock.wait()
+                    continue
+                # analysis: allow-determinism(hedge polling is real-time
+                # by contract; hedged windows journal nothing)
+                self._lock.wait(self._hedge_poll_s)
+                picks = self._hedge_scan()
+            for _tk in picks:
+                metrics.counter("verifier.hedges").inc()
 
 
 def scheduler_for(verifier, **kwargs) -> VerifierScheduler | None:

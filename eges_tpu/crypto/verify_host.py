@@ -183,7 +183,7 @@ class NativeMeshVerifier(NativeBatchVerifier):
         return list(self._targets)
 
 
-def batch_verify_txns(txns, verifier) -> bool:
+def batch_verify_txns(txns, verifier, priority: str = "bulk") -> bool:
     """Verify the signed (non-Geec) transactions of a block as one device
     batch; the single shared implementation behind both the acceptor ACK
     check and the insert-path body validation (SURVEY §3.5's two verify
@@ -191,7 +191,9 @@ def batch_verify_txns(txns, verifier) -> bool:
 
     Returns False if any signed txn is malformed or fails recovery.
     ``verifier=None`` falls back to per-txn host recovery (the
-    signature_nocgo.go role).
+    signature_nocgo.go role).  ``priority`` is the scheduler's window
+    class (``"consensus"`` preempts bulk tx-ingest windows); it only
+    applies when the verifier is a scheduler.
     """
     signed = [t for t in txns if not t.is_geec and (t.r or t.s or t.v)]
     if not signed:
@@ -212,7 +214,9 @@ def batch_verify_txns(txns, verifier) -> bool:
         # the sender cache — the acceptor-ACK check and the insert-path
         # body validation (the two sites below) verify the SAME block's
         # signatures, so the second site becomes pure cache hits
-        rec = verifier.recover_signers([(h, sig) for sig, h in parts])
+        kw = {"priority": priority} if hasattr(verifier, "submit") else {}
+        rec = verifier.recover_signers(
+            [(h, sig) for sig, h in parts], **kw)
         return all(r is not None for r in rec)
     sigs = np.zeros((len(parts), 65), np.uint8)
     hashes = np.zeros((len(parts), 32), np.uint8)
@@ -223,7 +227,7 @@ def batch_verify_txns(txns, verifier) -> bool:
     return bool(ok.all())
 
 
-def recover_signers(entries, verifier) -> list:
+def recover_signers(entries, verifier, priority: str = "bulk") -> list:
     """Batch-recover the signer address of each ``(sighash32, sig65)``
     entry; returns one 20-byte address or ``None`` per entry.
 
@@ -232,6 +236,9 @@ def recover_signers(entries, verifier) -> list:
     collects signed votes, then recovers ALL signers in one device call
     and counts only votes whose signer matches the claimed author.
     ``verifier=None`` falls back to per-entry host recovery.
+    ``priority="consensus"`` marks the rows consensus-critical when the
+    verifier is a scheduler (vote quorums block consensus, so node.py
+    passes it on every quorum/single-vote verify).
     """
     out = []
     if verifier is None:
@@ -247,7 +254,8 @@ def recover_signers(entries, verifier) -> list:
     if hasattr(verifier, "recover_signers"):
         # a VerifierScheduler front-end: per-entry cache hits + cross-
         # caller coalescing replace the dedicated one-shot device batch
-        return verifier.recover_signers(entries)
+        kw = {"priority": priority} if hasattr(verifier, "submit") else {}
+        return verifier.recover_signers(entries, **kw)
     sigs = np.zeros((len(entries), 65), np.uint8)
     hashes = np.zeros((len(entries), 32), np.uint8)
     for i, (h, sig) in enumerate(entries):

@@ -39,7 +39,7 @@ class SimCluster:
                  verifier=None, mine=None, signed: bool = True,
                  alloc: dict | None = None, txpool: bool = False,
                  fast_sync: set | None = None, defer: set | None = None,
-                 mesh_devices: int | None = None):
+                 mesh_devices: int | None = None, sched_config=None):
         self.clock = SimClock()
         self.net = SimNet(self.clock, seed=seed, drop_rate=drop_rate)
         self.nodes: list[SimNode] = []
@@ -58,8 +58,13 @@ class SimCluster:
         # that shared scheduler a mesh dispatcher — one window lane per
         # device, shared by every sim node.  verifier=None (host
         # fallback) passes through untouched.
+        # sched_config (a crypto.scheduler.SchedulerConfig) pins the
+        # shared scheduler's knobs for this cluster — chaos scenarios
+        # use it to enable adaptive windowing / hedging with the sim's
+        # deterministic flush discipline instead of env overrides
         from eges_tpu.crypto.scheduler import scheduler_for
-        verifier = scheduler_for(verifier)
+        kw = {"config": sched_config} if sched_config is not None else {}
+        verifier = scheduler_for(verifier, **kw)
         self.verifier = verifier
 
         if n_bootstrap is None:

@@ -77,6 +77,18 @@ METRIC_FAMILIES = frozenset({
     # crypto/scheduler.py — window flight recorder (bounded lifecycle
     # ring behind the thw_flight RPC)
     "verifier.flight_windows",
+    # crypto/scheduler.py — flight-ring overflow (oldest window evicted
+    # before anything read it; the ring's silent-loss signal)
+    "verifier.flight_dropped",
+    # crypto/scheduler.py — SLO-driven adaptive window controller:
+    # chosen deadline/bucket per step plus the decision count
+    "verifier.adapt_decisions", "verifier.sched_target_rows",
+    "verifier.sched_window_ms",
+    # crypto/scheduler.py — hedged re-dispatch of straggling windows:
+    # speculative duplicates placed, duplicates that won, losers
+    # cancelled before execution, losers that ran to waste
+    "verifier.hedge_cancelled", "verifier.hedge_wasted",
+    "verifier.hedge_wins", "verifier.hedges",
     # utils/timeseries.py + harness/collector.py — telemetry plane
     "telemetry.envelopes", "telemetry.samples",
     # harness/slo.py — burn-rate SLO engine
@@ -163,6 +175,20 @@ METRIC_HELP = {
         "Lane windows whose staging overlapped the previous compute.",
     "verifier.flight_windows":
         "Windows recorded by the lifecycle flight recorder.",
+    "verifier.flight_dropped":
+        "Flight-recorder windows evicted unread by ring overflow.",
+    "verifier.adapt_decisions":
+        "Window-sizing decisions taken by the adaptive controller.",
+    "verifier.sched_target_rows":
+        "Current adaptive target rows per coalesced window.",
+    "verifier.sched_window_ms":
+        "Current adaptive flush deadline in milliseconds.",
+    "verifier.hedge_cancelled":
+        "Hedged duplicates cancelled before execution (winner first).",
+    "verifier.hedge_wasted":
+        "Hedged duplicates that ran after the winner (wasted work).",
+    "verifier.hedge_wins": "Straggling windows won by the hedge copy.",
+    "verifier.hedges": "Speculative duplicate dispatches placed.",
     "telemetry.envelopes": "Telemetry envelopes ingested by the collector.",
     "telemetry.samples": "Registry samples taken by the telemetry sampler.",
     "slo.alerts_firing": "SLO objectives currently in the firing state.",

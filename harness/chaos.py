@@ -80,6 +80,12 @@ VOLATILE_KEYS = {
     # device/host ms) under this ONE top-level key by design; the
     # decayed counts and deltas are virtual-time deterministic
     "ingress_ledger": ("costs",),
+    # the adaptive controller's inputs (flight p99, queue wait, burn
+    # rates) and therefore its outputs are wall-clock measurements; the
+    # decision COUNT is protocol content (one per recorded window,
+    # pinned by kick-driven batching) and stays in the dump
+    "sched_adapt": ("window_ms", "target_rows", "burn_fast",
+                    "burn_slow", "p99_ms", "wait_p50_ms", "decision"),
 }
 
 
@@ -468,6 +474,122 @@ def _scn_mesh_device_blackout(seed: int, fast: bool) -> dict:
     return res
 
 
+def _scn_straggler_hedge(seed: int, fast: bool) -> dict:
+    """One lane of a 2-lane mesh pinned slow (its device dispatch
+    blocks until healed): the hedge monitor must re-place the stuck
+    window on the healthy sibling, p99 window latency must recover to
+    within 2x the healthy baseline (floored at the hedge detection
+    allowance), the ledger must never double-bill a hedged window, and
+    both phases must stay byte-deterministic."""
+    import threading
+
+    from eges_tpu.crypto.scheduler import SchedulerConfig, VerifierScheduler
+    from eges_tpu.crypto.verify_host import NativeMeshVerifier
+    from eges_tpu.utils.metrics import percentile
+
+    # kick-driven flushes (deterministic rows) with the adaptive
+    # controller ON but PINNED — min == max on both control outputs —
+    # so every window journals a sched_adapt decision without the
+    # controller ever altering window membership; a huge cooldown keeps
+    # both breakers closed so hedging (not the breaker) is the rescue
+    def _cfg() -> SchedulerConfig:
+        return SchedulerConfig(
+            window_ms=10_000.0, breaker_cooldown_s=1e9,
+            adaptive=True, min_window_ms=10_000.0,
+            max_window_ms=10_000.0, min_target_rows=1024,
+            hedge=True, hedge_min_windows=4, hedge_floor_ms=25.0,
+            hedge_poll_ms=2.0)
+
+    blocks = 3 if fast else 5
+
+    def _phase(pin: bool):
+        mesh = NativeMeshVerifier(2)
+        sched = VerifierScheduler(mesh, config=_cfg())
+        cluster = SimCluster(4, seed=seed, verifier=sched, signed=True)
+        sched.breaker_clock = cluster.clock.now
+        col = _enable_slo(cluster)
+        # close the loop end-to-end: the controller's burn input is the
+        # live collector's commit-latency burn rate (its value attrs
+        # are volatile-stripped from the sched_adapt events)
+        sched.burn_probe = col.burn_probe("commit_latency")
+        release = threading.Event()
+        if pin:
+            victim = mesh.device_targets()[0]
+            orig = victim.recover_addresses
+
+            def _stuck(sigs, hashes):
+                release.wait()
+                return orig(sigs, hashes)
+
+            victim.recover_addresses = _stuck
+        FaultInjector(cluster)       # journals the (empty) fault plan
+        cluster.start()
+        cluster.run(600.0,
+                    stop_condition=lambda: cluster.min_height() >= blocks)
+        # heal BEFORE the recovery phase: the pinned lane wakes up, the
+        # losing (wasted) duplicate completes, and close() can join the
+        # lane thread instead of deadlocking on the stuck dispatch
+        release.set()
+        return cluster, col, sched
+
+    # phase A — healthy baseline
+    cluster_a, col_a, sched_a = _phase(pin=False)
+    for sn in cluster_a.live_nodes():
+        sn.node.stop()
+    cluster_a.flush_telemetry()
+    col_a.finalize()
+    sched_a.close()
+    journals_a = cluster_a.journals()
+    totals_a = sorted(f["total_ms"] for f in sched_a.flights())
+    p99_a = percentile(totals_a, 99.0)
+
+    # phase B — lane 0 pinned slow; hedging is the only way out
+    cluster_b, col_b, sched_b = _phase(pin=True)
+    res = _finish("straggler_hedge", seed, cluster_b,
+                  extra_blocks=2, bound_s=240.0, checks={})
+    sched_b.close()
+    stats = sched_b.stats()
+    totals_b = sorted(f["total_ms"] for f in sched_b.flights())
+    p99_b = percentile(totals_b, 99.0)
+    # the p99 bound carries a hedge-detection allowance: the monitor
+    # cannot act before the straggler threshold (hedge_floor_ms) plus a
+    # poll tick, so a sub-millisecond healthy baseline does not demand
+    # a sub-millisecond rescue
+    bound_ms = 2.0 * max(p99_a, sched_b.config.hedge_floor_ms)
+    # exactly-once billing: only the winning dispatch runs the window's
+    # bookkeeping (the loser never touches the pending-origin map), so
+    # rows billed across every node ledger can never exceed the rows
+    # the scheduler recorded
+    billed = sum(
+        o.get("rows", 0.0)
+        for sn in cluster_b.nodes
+        for o in sn.node.ledger.snapshot().get("origins", []))
+    res = _slo_checks(res, cluster_b, col_b, lambda: {
+        "hedge_fired": stats["hedges"] >= 1,
+        "hedge_won": stats["hedge_wins"] >= 1,
+        "hedges_accounted": stats["hedges"] == (
+            stats["hedge_cancelled"] + stats["hedge_wasted"]),
+        "p99_recovered": p99_b <= bound_ms,
+        "no_double_billing": billed <= stats["rows"],
+        "controller_stepped": stats["adapt_decisions"] > 0,
+    })
+    # fold the healthy phase's streams into the dump under a distinct
+    # prefix so --check-determinism byte-compares BOTH phases
+    res["journals"].update(
+        {"healthy.%s" % name: evs for name, evs in journals_a.items()})
+    res["verifier"] = stats
+    res["hedge"] = {
+        "p99_healthy_ms": round(p99_a, 3),
+        "p99_hedged_ms": round(p99_b, 3),
+        "bound_ms": round(bound_ms, 3),
+        "hedges": stats["hedges"],
+        "hedge_wins": stats["hedge_wins"],
+        "hedge_cancelled": stats["hedge_cancelled"],
+        "hedge_wasted": stats["hedge_wasted"],
+    }
+    return res
+
+
 def _scn_calm_baseline(seed: int, fast: bool) -> dict:
     """No faults at all: a healthy cluster with the live telemetry plane
     enabled must fire ZERO SLO alerts — the false-positive guard for the
@@ -707,6 +829,7 @@ SCENARIOS = {
     "corruption_flood": _scn_corruption_flood,
     "verifier_blackout": _scn_verifier_blackout,
     "mesh_device_blackout": _scn_mesh_device_blackout,
+    "straggler_hedge": _scn_straggler_hedge,
     "calm_baseline": _scn_calm_baseline,
     "commit_attribution": _scn_commit_attribution,
     "ingress_flood_attribution": _scn_ingress_flood_attribution,
@@ -778,6 +901,14 @@ def render_result(res: dict) -> str:
                        led.get("snapshots", 0), led.get("origins", 0),
                        dom.get("origin", "-"),
                        dom.get("share", 0.0) * 100.0))
+    if "hedge" in res:
+        h = res["hedge"]
+        out.append("  hedge: p99 healthy %.3fms -> hedged %.3fms "
+                   "(bound %.3fms)  hedges=%d wins=%d cancelled=%d "
+                   "wasted=%d" % (
+                       h["p99_healthy_ms"], h["p99_hedged_ms"],
+                       h["bound_ms"], h["hedges"], h["hedge_wins"],
+                       h["hedge_cancelled"], h["hedge_wasted"]))
     if "flight_stragglers" in res:
         out.append("  flight stragglers: %s" % (
             ", ".join(str(d) for d in res["flight_stragglers"])

@@ -13,10 +13,14 @@ Metrics in ``LOWER_IS_BETTER`` (``cold_start_seconds`` — the AOT
 artifact store's deliverable — ``commit_p99_ms`` — the commit
 anatomy stage's end-to-end p99 — and ``ledger_overhead_pct`` — the
 attribution cost the ingress provenance ledger adds to the verify hot
-path) gate in the opposite direction: a RISE past the threshold fails,
-so a broken artifact store, a commit-path latency regression, or
-provenance cost creeping onto the hot path cannot hide behind a
-healthy steady-state throughput number.  Metrics in
+path, and the adaptive-scheduler stage's ``sched_p99_window_ms`` /
+``sched_queue_wait_p99_ms_consensus`` / ``sched_queue_wait_p99_ms_bulk``
+— p99 window latency and per-class queue wait under the bursty
+workload) gate in the opposite direction: a RISE past the threshold
+fails, so a broken artifact store, a commit-path latency regression,
+provenance cost creeping onto the hot path, or a controller that stops
+shrinking the window under burn cannot hide behind a healthy
+steady-state throughput number.  Metrics in
 ``ZERO_TOLERANCE`` (``slo_false_positive_alerts`` — alerts fired by
 the burn-rate SLO engine on a calm, fault-free sim) gate on the
 newest value alone: it must be exactly 0, even with a single history
@@ -57,7 +61,10 @@ _DEFAULT_HISTORY = os.path.join(
 # metrics where smaller is the win (durations): the gate fails on a
 # RISE past the threshold instead of a drop
 LOWER_IS_BETTER = frozenset({"cold_start_seconds", "commit_p99_ms",
-                             "ledger_overhead_pct"})
+                             "ledger_overhead_pct",
+                             "sched_p99_window_ms",
+                             "sched_queue_wait_p99_ms_bulk",
+                             "sched_queue_wait_p99_ms_consensus"})
 
 # metrics whose newest value must be EXACTLY zero — no threshold, no
 # previous-entry requirement: any count at all is a failure

@@ -121,6 +121,11 @@ def summarize(by_node: dict[str, list[dict]],
     # telemetry sampler heartbeats, merged across streams
     slo_alerts: list[tuple] = []
     telemetry_samples: dict[str, int] = {}
+    # adaptive scheduler controller decisions (crypto/scheduler.py):
+    # per-node shrink/grow/hold tallies; the sizing inputs themselves
+    # are wall-clock-derived and deliberately excluded (same rationale
+    # as mesh queue wait above)
+    sched_adapt: dict[str, dict] = {}
     # forward compatibility: journals written by a NEWER build may carry
     # event types this parser has never heard of — count and skip them
     # instead of letting a per-type branch trip over missing attrs
@@ -156,6 +161,13 @@ def summarize(by_node: dict[str, list[dict]],
                 d["load_s"] += float(ev.get("load_s", 0.0))
                 d["compile_s"] += float(ev.get("compile_s", 0.0))
                 d["cold_start_s"] += float(ev.get("cold_start_s", 0.0))
+                continue
+            if typ == "sched_adapt":
+                d = sched_adapt.setdefault(name, {
+                    "decisions": 0, "shrink": 0, "grow": 0, "hold": 0})
+                d["decisions"] += 1
+                verdict = str(ev.get("decision", "hold"))
+                d[verdict if verdict in d else "hold"] += 1
                 continue
             if typ == "verifier_mesh_dispatch":
                 d = mesh.setdefault(int(ev.get("device", -1)), {
@@ -266,6 +278,9 @@ def summarize(by_node: dict[str, list[dict]],
         "telemetry_samples": {
             name: telemetry_samples[name]
             for name in sorted(telemetry_samples)},
+        "sched_adapt": {
+            name: dict(sched_adapt[name])
+            for name in sorted(sched_adapt)},
         "unknown_events": {
             typ: unknown_events[typ] for typ in sorted(unknown_events)},
         "anatomy": anatomy_mod.assemble(by_node),
@@ -307,13 +322,20 @@ def flight_straggler_lanes(flights: list[dict],
     return sorted(lanes, key=repr)
 
 
-def render_flights(flights: list[dict], width: int = 40) -> str:
+def render_flights(flights: list[dict], width: int = 40,
+                   dropped: int = 0) -> str:
     """Text waterfall of verifier window lifecycles: one bar per
     window (``.`` wait, ``=`` stage/dispatch, ``#`` compute/collect)
     scaled against the slowest window, with lane attribution and a
-    straggler verdict line."""
+    straggler verdict line.  ``dropped`` is the scheduler's
+    ``flight_dropped`` stat (windows the bounded ring evicted unread);
+    passing it makes the recorder's silent loss visible in the render
+    instead of quietly under-counting windows."""
     rows = [f for f in flights if isinstance(f, dict)]
-    out = ["verifier flight recorder — %d window(s)" % len(rows)]
+    head = "verifier flight recorder — %d window(s)" % len(rows)
+    if dropped:
+        head += " (+%d dropped by ring overflow)" % dropped
+    out = [head]
     if not rows:
         out.append("  (no windows recorded)")
         return "\n".join(out)
@@ -334,13 +356,17 @@ def render_flights(flights: list[dict], width: int = 40) -> str:
         bar = "." * n_wait + "=" * n_stage + "#" * n_comp
         flags = "*" if f.get("diverted") else \
             ("?" if f.get("probing") else "")
+        if f.get("hedged"):
+            flags += "H" if f.get("hedge_win") else "h"
         out.append("  %5s %4s %5s %-9s [%-*s] %7.3fms %s" % (
             f.get("window", "?"), f.get("device", "?"),
             f.get("rows", "?"), str(f.get("reason", "?"))[:9],
             width, bar[:width], total, flags))
     stragglers = flight_straggler_lanes(rows)
-    out.append("  stragglers: %s   (* diverted, ? breaker probe)" % (
-        ", ".join(str(d) for d in stragglers) if stragglers else "-"))
+    out.append("  stragglers: %s   (* diverted, ? breaker probe,"
+               " H hedge won, h hedged)" % (
+                   ", ".join(str(d) for d in stragglers)
+                   if stragglers else "-"))
     return "\n".join(out)
 
 
