@@ -3,9 +3,9 @@
 # a fresh clone with no remote), then the fast test suite.
 BASE := $(shell git rev-parse --verify -q origin/main || echo HEAD)
 
-.PHONY: check analyze race test anatomy-smoke ledger-smoke
+.PHONY: check analyze race taint test anatomy-smoke ledger-smoke
 
-check: analyze race test anatomy-smoke ledger-smoke
+check: analyze race taint test anatomy-smoke ledger-smoke
 
 analyze:
 	python -m harness.analysis --github --diff $(BASE)
@@ -15,6 +15,13 @@ analyze:
 race:
 	python -m harness.analysis --github --no-baseline \
 		--rules lockset-race,check-then-act,escape,waiver-expired
+
+# ingress-taint slice: whole tree, no diff scoping — taint propagates
+# across files, so an untouched sink can start firing from a touched
+# source
+taint:
+	python -m harness.analysis --github --no-baseline \
+		--rules taint-alloc,taint-cardinality,taint-loop,unchecked-decode
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'

@@ -74,6 +74,18 @@ class GeecNode:
     ``now()`` and ``call_later(delay_s, fn) -> cancelable handle``.
     """
 
+    # Ingress hardening caps: every attacker-fed byte path or container
+    # is bounded up front; overflow is shed oldest-first with a counted
+    # ``*_dropped`` metric so floods stay visible, cheap, and non-fatal
+    # (cf. geth's message-size limits and fetcher/txpool caps).
+    INGRESS_MAX_BYTES = 1 << 20       # one datagram's decode budget
+    DEFER_MAX = 4096                  # deferred-thunk queue depth
+    GEEC_TXN_MAX_BYTES = 1 << 20      # one UDP txn payload
+    GEEC_PENDING_MAX = 1 << 14        # pending UDP txn backlog
+    REG_PENDING_MAX = 4096            # pending registration requests
+    FASTSYNC_MAX_ACCOUNTS = 1 << 20   # fast-sync state staging rows
+    HEIGHT_WINDOW = 8192              # retained per-height bookkeeping
+
     def __init__(self, chain: BlockChain, clock, transport,
                  node_cfg: NodeConfig, chain_cfg: ChainGeecConfig, *,
                  mine: bool = True, verifier=None, log=None):
@@ -356,6 +368,15 @@ class GeecNode:
             self._on_gossip(data)
 
     def _on_gossip(self, data: bytes) -> None:
+        if len(data) > self.INGRESS_MAX_BYTES:
+            # decode budget enforced before ANY byte is parsed: an
+            # oversized datagram costs one length check, billed to its
+            # origin, and never reaches RLP (DoS-resistance contract)
+            from eges_tpu.utils.metrics import DEFAULT as metrics
+            metrics.counter("consensus.ingress_oversized").inc()
+            ledger.charge(drops=1)
+            self._log("oversized gossip dropped", nbytes=len(data))
+            return
         try:
             code, msg = M.unpack_gossip(data)
         except Exception as exc:
@@ -402,6 +423,13 @@ class GeecNode:
             self._on_direct(data)
 
     def _on_direct(self, data: bytes) -> None:
+        if len(data) > self.INGRESS_MAX_BYTES:
+            # same decode budget as the gossip plane
+            from eges_tpu.utils.metrics import DEFAULT as metrics
+            metrics.counter("consensus.ingress_oversized").inc()
+            ledger.charge(drops=1)
+            self._log("oversized direct dropped", nbytes=len(data))
+            return
         try:
             code, author, msg = M.unpack_direct(data)
         except Exception as exc:
@@ -438,16 +466,33 @@ class GeecNode:
     def on_geec_txn(self, payload: bytes) -> None:
         """UDP txn ingest (ref: consensus/geec/geec_api.go:28-41)."""
         from eges_tpu.core.types import geec_txn
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+        if len(payload) > self.GEEC_TXN_MAX_BYTES:
+            metrics.counter("consensus.geec_txn_dropped").inc()
+            ledger.charge(drops=1)
+            return
         with self._lock:
+            if len(self.pending_geec_txns) >= self.GEEC_PENDING_MAX:
+                # backlog full: shed the oldest so a txn flood cannot
+                # pin memory ahead of the next proposal drain
+                self.pending_geec_txns.pop(0)
+                metrics.counter("consensus.geec_txn_dropped").inc()
+                ledger.charge(drops=1)
             self.pending_geec_txns.append(geec_txn(payload))
 
     # defer a thunk until the working block reaches ``blk`` (Wait analogue)
     def _defer(self, blk: int, thunk) -> None:
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+        if len(self._deferred) >= self.DEFER_MAX:
+            # depth cap: a peer stuffing far-future waits evicts the
+            # oldest deferral instead of growing the queue unboundedly
+            self._deferred.pop(0)
+            metrics.counter("consensus.deferred_dropped").inc()
+            ledger.charge(drops=1)
         self._deferred.append((blk, thunk))
         # a deferred message is buffered work the sender imposed on us —
         # billed to the ambient ingress origin (no-op on internal paths)
         ledger.charge(deferred=1)
-        from eges_tpu.utils.metrics import DEFAULT as metrics
         metrics.gauge("consensus.deferred_depth").set(len(self._deferred))
 
     def _drain_deferred(self) -> None:
@@ -1536,6 +1581,15 @@ class GeecNode:
                 return
         if reply.cursor != len(fs["accounts"]):
             return  # duplicate or out-of-order page; the tick re-asks
+        if (len(fs["accounts"]) + len(reply.accounts)
+                > self.FASTSYNC_MAX_ACCOUNTS):
+            # a malicious state server claiming an absurd account count
+            # cannot balloon the staging buffers: abort this sync; the
+            # next tick re-anchors against a different pivot/server
+            self._log("fastsync state too large, aborting",
+                      staged=len(fs["accounts"]))
+            self._fs = None
+            return
         fs["accounts"].extend(reply.accounts)
         fs["codes"].extend(reply.codes)
         fs["total"] = reply.total
@@ -1816,6 +1870,13 @@ class GeecNode:
             if blk.number not in self.empty_block_list:
                 self.empty_block_list.append(blk.number)
         self.unconfirmed.append(blk)
+        # per-height bookkeeping is windowed: entries older than
+        # HEIGHT_WINDOW heights cannot be referenced by any committee /
+        # confirm path near the tip, so long runs hold steady memory
+        while len(self.trust_rands) > self.HEIGHT_WINDOW:
+            del self.trust_rands[next(iter(self.trust_rands))]
+        while len(self.empty_block_list) > self.HEIGHT_WINDOW:
+            self.empty_block_list.pop(0)
         if not replay:
             self._last_commit_t = self.clock.now()
             # per-block ingress provenance snapshot: one ingress_ledger
@@ -1926,6 +1987,14 @@ class GeecNode:
         if (known is not None and known.ip == reg.ip and known.port == reg.port
                 and known.renew >= reg.renew):
             return
+        if (known is None
+                and len(self.pending_regs) >= self.REG_PENDING_MAX):
+            # a gossip flood of forged registrations evicts the oldest
+            # pending request instead of growing the dict without bound
+            self.pending_regs.pop(next(iter(self.pending_regs)))
+            from eges_tpu.utils.metrics import DEFAULT as metrics
+            metrics.counter("consensus.reg_req_dropped").inc()
+            ledger.charge(drops=1)
         self.pending_regs[reg.account] = reg
 
     # ------------------------------------------------------------------
@@ -1959,6 +2028,8 @@ class GeecNode:
         confirm = ConfirmBlockMsg(block_number=empty.number, hash=empty.hash,
                                   confidence=0, empty_block=True)
         self.empty_block_list.append(empty.number)
+        while len(self.empty_block_list) > self.HEIGHT_WINDOW:
+            self.empty_block_list.pop(0)
         self.chain.offer(empty.with_confirm(confirm))
 
     def _handle_committee_timeout(self, version: int) -> None:

@@ -66,6 +66,7 @@ class TxPool:
         self.owner = ""  # identifies this pool's node in span attrs
         self._ingest_ctx: dict[bytes, tracing.SpanContext] = {}  # guarded-by: _lock
         self._INGEST_CTX_CAP = 8192
+        self._KNOWN_CAP = 1 << 16  # dedup-history bound (maxKnownTxs role)
         # commit-anatomy linkage: per-txn ingest/admit timestamps on the
         # node clock (virtual under the simulator), emitted as one
         # ``commit_anatomy`` stage="pool" event when a block includes
@@ -107,6 +108,13 @@ class TxPool:
                     # billed to whoever delivered THIS copy
                     ledger.charge(drops=1)
                     continue
+                if len(self._known) >= self._KNOWN_CAP:
+                    # coarse clear at the cap (geth's maxKnownTxs
+                    # idiom): briefly losing dedup history is cheaper
+                    # than letting a hash flood grow the set forever
+                    self._known.clear()
+                    from eges_tpu.utils import metrics
+                    metrics.DEFAULT.counter("txpool.known_clears").inc()
                 self._known.add(h)
                 self._queue.append(t)
                 if len(self._ingest_ctx) < self._INGEST_CTX_CAP:
@@ -304,7 +312,7 @@ class TxPool:
                     del by_nonce[nonce]
                     if not by_nonce:
                         del self.pending[sender]
-            self._dead.add(t.hash)
+            self._dead.add(t.hash)  # bounded-by: _maybe_compact clears when dead > live (called below)
             self._ingest_ctx.pop(t.hash, None)
             self._ingest_t.pop(t.hash, None)
             self._admit_t.pop(t.hash, None)

@@ -582,7 +582,7 @@ class RpcServer:
         else:
             numbers, gaps = idx.candidates(from_n, to_n, addresses, topics)
         for lo, hi in gaps:
-            numbers.extend(range(lo, hi + 1))
+            numbers.extend(range(lo, hi + 1))  # bounded-by: hi <= to_n <= chain.height() (clamped in _parse_filter)
         out = []
         for n in sorted(numbers):
             blk = self.chain.get_block_by_number(n)
@@ -620,7 +620,9 @@ class RpcServer:
 
         h = self.chain.height()
         from_n = block_num(obj.get("fromBlock"), h)
-        to_n = block_num(obj.get("toBlock"), h)
+        # clamp to the canonical height: a far-future toBlock must not
+        # size the block scan in _logs_in_range (eth_getLogs DoS vector)
+        to_n = min(block_num(obj.get("toBlock"), h), h)
         addrs = obj.get("address")
         if isinstance(addrs, str):
             addrs = [addrs]
@@ -642,6 +644,7 @@ class RpcServer:
 
     FILTER_TTL_S = 300.0   # unpolled filters expire (geth's 5-min timeout)
     FILTER_MAX = 256       # hard cap on installed filters per node
+    HTTP_MAX_BODY = 16 * 1024 * 1024  # request-body cap (matches the WS cap)
 
     def _expire_filters(self) -> None:
         import time
@@ -661,7 +664,7 @@ class RpcServer:
         self._expire_filters()
         self._filter_seq += 1
         fid = _hex(self._filter_seq)
-        self._filters[fid] = {
+        self._filters[fid] = {  # bounded-by: FILTER_MAX (_expire_filters above)
             "kind": "logs" if method == "eth_newFilter" else "blocks",
             "obj": obj,
             "last": self.chain.height(),
@@ -743,7 +746,7 @@ class RpcServer:
         state = parent_state.copy()
         ctx = block_ctx(blk.header)
         gas = 0
-        for i in range(index):
+        for i in range(index):  # bounded-by: index < len(blk.transactions) (lookup_txn invariant)
             r = apply_txn(state, blk.transactions[i], senders[i],
                           blk.header.coinbase, gas, ctx=ctx,
                           verifier=self.chain.verifier)
@@ -826,6 +829,14 @@ class RpcServer:
                     await self._handle_ws(reader, writer, headers)
                     return
                 length = int(headers.get("content-length", 0))
+                if length > self.HTTP_MAX_BODY:
+                    # refuse before buffering anything: the client's
+                    # declared content-length must not size the read
+                    writer.write(
+                        b"HTTP/1.1 413 Payload Too Large\r\n"
+                        b"Content-Length: 0\r\nConnection: close\r\n\r\n")
+                    await writer.drain()
+                    break
                 body = await reader.readexactly(length) if length else b""
                 if http_method == "GET":
                     # Prometheus scrape endpoint; everything else 404s

@@ -265,7 +265,7 @@ class SimNet:
                         lambda d: self._fire_gossip(nid, d, src))
                        (node_id, sender_id))
 
-    def _fire_gossip(self, node_id: str, data: bytes,
+    def _fire_gossip(self, node_id: str, data: bytes,  # ingress-entry
                      sender_id: str = "") -> None:
         # delivery-time lookup: the receiver may have crashed (left the
         # net) while this datagram was in flight
@@ -275,7 +275,7 @@ class SimNet:
             return
         # provenance stamp: the receiving node's entry point reads the
         # delivering peer (utils/ledger.py) to tag ingress cost
-        with ledger.peer(sender_id):
+        with ledger.peer(sender_id):  # bounded-by: _ORIGIN_MAX (ledger.peer clamps)
             sink(data)
 
     def deliver_direct(self, sender_id: str, addr: tuple, data: bytes) -> None:
@@ -298,7 +298,7 @@ class SimNet:
                    (lambda a, src: lambda d: self._fire_direct(a, d, src))
                    (addr, sender_id))
 
-    def _fire_direct(self, addr: tuple, data: bytes,
+    def _fire_direct(self, addr: tuple, data: bytes,  # ingress-entry
                      sender_id: str = "") -> None:
         entry = self._direct_sinks.get(addr)
         if entry is None:
@@ -306,5 +306,5 @@ class SimNet:
             from eges_tpu.utils.metrics import DEFAULT as metrics
             metrics.counter("net.dead_letters").inc()
             return
-        with ledger.peer(sender_id):
+        with ledger.peer(sender_id):  # bounded-by: _ORIGIN_MAX (ledger.peer clamps)
             entry[1](data)

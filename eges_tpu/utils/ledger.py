@@ -79,13 +79,19 @@ SENDER_CAP = 8
 _tls = threading.local()
 
 
+# Origin strings come off the wire (transport peer ids, RPC client
+# tags): clamp their length before they key ledger records or land in
+# journal rows, so a hostile transport cannot inflate either.
+_ORIGIN_MAX = 64
+
+
 @contextlib.contextmanager
 def peer(peer_id: str):
     """Mark ``peer_id`` as the delivering transport peer for the
     duration of a delivery callback (set by the network fabric, read by
     the receiving node's entry points via :func:`current_peer`)."""
     prev = getattr(_tls, "peer", "")
-    _tls.peer = str(peer_id)
+    _tls.peer = str(peer_id)[:_ORIGIN_MAX]
     try:
         yield
     finally:
@@ -102,7 +108,7 @@ def bind(ledger: "IngressLedger", origin: str):
     """Attach ``(ledger, origin)`` as the ambient charge target for the
     duration of a handler (node entry points wrap their dispatch)."""
     prev = getattr(_tls, "bound", None)
-    _tls.bound = (ledger, origin)
+    _tls.bound = (ledger, str(origin)[:_ORIGIN_MAX])
     try:
         yield
     finally:

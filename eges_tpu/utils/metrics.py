@@ -33,8 +33,10 @@ METRIC_FAMILIES = frozenset({
     "chain.geec_txns", "chain.height", "chain.insert",
     "chain.insert_seconds", "chain.txns",
     # consensus/
-    "consensus.deferred_depth", "consensus.elected",
-    "consensus.forced_empties", "consensus.phase_seconds",
+    "consensus.deferred_depth", "consensus.deferred_dropped",
+    "consensus.elected", "consensus.forced_empties",
+    "consensus.geec_txn_dropped", "consensus.ingress_oversized",
+    "consensus.phase_seconds", "consensus.reg_req_dropped",
     "consensus.sealed", "membership.min_ttl", "membership.size",
     # net/ + sim/simnet.py
     "net.dead_letters", "net.direct_bytes", "net.direct_msgs",
@@ -42,7 +44,7 @@ METRIC_FAMILIES = frozenset({
     # sim/faults.py — deterministic fault injection
     "sim.faults_injected",
     # core/txpool.py
-    "txpool.pending",
+    "txpool.known_clears", "txpool.pending",
     # crypto/ verifiers
     "verifier.batches", "verifier.compile_cache_hits",
     "verifier.compile_cache_misses", "verifier.d2h_seconds",
@@ -114,8 +116,14 @@ METRIC_HELP = {
     "chain.insert_seconds": "Block insert latency in seconds.",
     "chain.txns": "Payload transactions inserted with blocks.",
     "consensus.deferred_depth": "Events parked on the deferred queue.",
+    "consensus.deferred_dropped": "Oldest deferrals evicted at DEFER_MAX.",
     "consensus.elected": "Elections won by this node.",
     "consensus.forced_empties": "Empty blocks forced by round timeout.",
+    "consensus.geec_txn_dropped": "UDP geec txns shed by size or backlog cap.",
+    "consensus.ingress_oversized": "Datagrams dropped by the ingress "
+                                   "byte cap before decode.",
+    "consensus.reg_req_dropped": "Pending registrations evicted at "
+                                 "REG_PENDING_MAX.",
     "consensus.phase_seconds": "Consensus phase duration in seconds.",
     "consensus.sealed": "Blocks sealed by this node.",
     "membership.min_ttl": "Minimum TTL across registered members.",
@@ -127,6 +135,7 @@ METRIC_HELP = {
     "net.gossip_msgs": "Messages sent over the gossip plane.",
     "net.peer_count": "Currently connected peers.",
     "sim.faults_injected": "Scripted faults injected by the chaos harness.",
+    "txpool.known_clears": "Coarse clears of the known-txn dedup set.",
     "txpool.pending": "Transactions pending in the pool.",
     "verifier.batches": "Signature verification batches dispatched.",
     "verifier.compile_cache_hits": "Verifier JIT compile-cache hits.",

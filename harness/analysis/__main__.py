@@ -32,6 +32,38 @@ def _changed_files(root: str, base: str) -> set[str] | None:
             for line in proc.stdout.splitlines() if line.strip()}
 
 
+def _sarif(report) -> dict:
+    """SARIF 2.1.0 log of the unsuppressed findings — the GitHub
+    code-scanning upload format, one result per finding, one reusable
+    rule entry per distinct rule id."""
+    rules = sorted({f.rule for f in report.unsuppressed})
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "eges-analysis",
+                "informationUri":
+                    "https://example.invalid/eges-tpu/harness/analysis",
+                "rules": [{"id": r} for r in rules],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "ruleIndex": rules.index(f.rule),
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {"startLine": f.line},
+                }}],
+            } for f in report.unsuppressed],
+        }],
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m harness.analysis",
@@ -60,6 +92,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--github", action="store_true",
                     help="also print ::error workflow annotations for "
                          "unsuppressed findings (GitHub Actions)")
+    ap.add_argument("--sarif", metavar="FILE", default=None,
+                    help="write unsuppressed findings as a SARIF 2.1.0 "
+                         "log (GitHub code-scanning upload format); "
+                         "'-' writes to stdout")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore the checked-in baseline")
     ap.add_argument("--update-baseline", action="store_true",
@@ -119,6 +155,14 @@ def main(argv: list[str] | None = None) -> int:
         for f in report.unsuppressed:
             print(f"::error file={f.path},line={f.line}::"
                   f"{f.rule}: {f.message}")
+
+    if args.sarif:
+        doc = json.dumps(_sarif(report), indent=2, sort_keys=True)
+        if args.sarif == "-":
+            print(doc)
+        else:
+            with open(args.sarif, "w", encoding="utf-8") as fh:
+                fh.write(doc + "\n")
 
     if args.summary:
         with open(args.summary, "a", encoding="utf-8") as fh:

@@ -42,6 +42,8 @@ RULES = (
     "transfer-hygiene",                                  # transfer
     "dtype-promotion",                                   # dtypes
     "lockset-race", "check-then-act", "escape",          # lockset
+    "taint-alloc", "taint-cardinality", "taint-loop",    # taint
+    "unchecked-decode",                                  # taint
     "waiver-expired",                                    # core (runner)
 )
 
@@ -137,6 +139,18 @@ class SourceFile:
     def guarded_by(self, line: int) -> str | None:
         """``# guarded-by: <lock>`` annotation on a source line."""
         m = re.search(r"guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)",
+                      self.line_comment(line))
+        return m.group(1) if m else None
+
+    def bounded_by(self, line: int) -> str | None:
+        """``# bounded-by: <expr>`` annotation on a source line — the
+        declared bound an attacker-controlled value flows under (the
+        taint checker's contract, mirroring ``# guarded-by:``).  The
+        expression is free-form (a constant name, a ``min(...)`` call,
+        a prose-ish cap like ``SENDER_CAP per origin``) — it documents
+        the bound for the reviewer; the checker only requires that one
+        is declared."""
+        m = re.search(r"bounded-by:\s*(\S.*?)\s*$",
                       self.line_comment(line))
         return m.group(1) if m else None
 
@@ -265,7 +279,7 @@ class Report:
                  elapsed_s: float, stale_baseline: list[dict],
                  errors: list[str],
                  expiring_waivers: list[dict] | None = None,
-                 guarded_by: int = 0):
+                 guarded_by: int = 0, bounded_by: int = 0):
         self.findings = findings
         self.files = files
         self.elapsed_s = elapsed_s
@@ -277,6 +291,8 @@ class Report:
         # `# guarded-by:` annotations in the scanned tree — the durable
         # locking contracts; trendable so coverage only grows
         self.guarded_by = guarded_by
+        # `# bounded-by:` annotations — the declared ingress bounds
+        self.bounded_by = bounded_by
 
     @property
     def unsuppressed(self) -> list[Finding]:
@@ -307,6 +323,7 @@ class Report:
             "unsuppressed_by_rule": self.unsuppressed_by_rule(),
             "waivers_expiring_30d": self.expiring_waivers,
             "guarded_by_annotations": self.guarded_by,
+            "bounded_by_annotations": self.bounded_by,
         }
 
 
@@ -316,7 +333,7 @@ def run(root: str, paths: tuple[str, ...] = DEFAULT_PATHS,
     from harness.analysis import (
         determinism, dtypes, future_lifecycle, host_sync, jit_purity,
         lock_discipline, lock_order, lockset, recompile, robustness,
-        transfer, vocabulary,
+        taint, transfer, vocabulary,
     )
 
     t0 = time.monotonic()
@@ -324,7 +341,8 @@ def run(root: str, paths: tuple[str, ...] = DEFAULT_PATHS,
     findings: list[Finding] = []
     for checker in (lock_discipline, lock_order, future_lifecycle,
                     determinism, jit_purity, vocabulary, robustness,
-                    host_sync, recompile, transfer, dtypes, lockset):
+                    host_sync, recompile, transfer, dtypes, lockset,
+                    taint):
         findings.extend(checker.check(project))
 
     # waiver expiry: the clock is overridable so tests stay
@@ -393,8 +411,11 @@ def run(root: str, paths: tuple[str, ...] = DEFAULT_PATHS,
     guarded = sum(
         1 for src in project.files for ln in src.lines
         if "guarded-by:" in ln.partition("#")[2])
+    bounded = sum(
+        1 for src in project.files for ln in src.lines
+        if "bounded-by:" in ln.partition("#")[2])
     return Report(findings, len(project.files), time.monotonic() - t0,
-                  stale, project.errors, expiring, guarded)
+                  stale, project.errors, expiring, guarded, bounded)
 
 
 def _plus_days(day: str, days: int) -> str:

@@ -1,0 +1,958 @@
+"""Ingress taint analysis: every attacker-controlled byte is bounded.
+
+The source paper's claim is *DoS resistance* — yet nothing verified
+statically that bytes arriving from the network are clamped, validated,
+or capped before they size an allocation, key a dict, spin a loop, or
+reach a device staging buffer.  This checker closes that gap: an
+interprocedural taint pass over the ingress surface (datagram/gossip
+handlers, RPC request params, decoded payload fields), reusing the
+pure-AST symbol tables and edge resolution from ``hotpath.py``.
+
+**Lattice.**  Three levels, joined by ``max``:
+
+* ``CLEAN``   (0) — not attacker-influenced, or fully clamped;
+* ``BOUNDED`` (1) — attacker-chosen *values* inside a structure whose
+  size/extent is capped (a decoded message behind a byte-limit gate, a
+  ``readexactly`` behind a length check);
+* ``RAW``     (2) — unbounded attacker control (the datagram itself,
+  an unchecked content-length, an uncapped collection).
+
+**Sources.**  A ``# ingress-entry`` comment on a ``def`` line seeds its
+non-self params RAW; known handler names (``on_gossip``, ``on_direct``,
+``deliver_gossip``, ``_handle_conn`` …) seed RAW by name; the RPC
+dispatch surface (``dispatch``, ``_handle_body``, ``submit_txns``,
+``broadcast_txns``) seeds BOUNDED — the transport layer has already
+length-capped the frame, but every value in it is attacker-chosen.
+
+**Propagation.**  Assignments, attribute loads off tainted values,
+BinOp/BoolOp/collection displays (join), subscripts, and calls.
+Resolved calls propagate interprocedurally: a fixpoint worklist joins
+argument levels into callee parameters and flows return-expression
+levels back to call sites.  Unresolved calls conservatively return the
+join of their argument levels, capped at BOUNDED for method calls on
+non-tainted receivers (``reader.readline()`` is attacker data, but the
+stream API itself bounds no one read at RAW's "unbounded" meaning only
+when a tainted length was passed in).
+
+**Sanitizers — declared, not inferred:**
+
+* clamp calls: ``clamp_rpc_limit``, ``bucket_round``, ``min(x, CAP)``;
+* bounds compares: ``if len(x) > CAP: return`` downgrades ``x``;
+* membership/signature validation: a call to ``is_committee`` /
+  ``_verify_single`` / ``recover_signers`` … marks the rest of the
+  function *validated* — loop/cardinality sinks after it are quiet,
+  and callees reached only from validated sites inherit it;
+* the ``# bounded-by: <expr>`` same-line contract (mirroring
+  ``# guarded-by:``) suppresses all four rules at that line — the
+  reviewer-auditable escape hatch when the bound lives out-of-band.
+
+**Sinks — four rules**, reported only in in-scope files (the ingress
+surface itself: consensus/node.py, sim/simnet.py, rpc/, core/txpool.py,
+utils/ledger.py, plus any file carrying a ``# ingress-entry`` mark):
+
+* ``taint-alloc`` — a tainted value sizes an allocation
+  (``bytes/bytearray(n)``, ``np/jnp.zeros(n)``, ``range(n)``,
+  ``reader.readexactly(n)``, ``b"x" * n``);
+* ``taint-cardinality`` — a tainted value keys a long-lived (``self``-
+  rooted) dict/set/list, a metric label, or a journal attribute with
+  no size cap in sight — the memory/metrics-explosion vector;
+* ``taint-loop`` — ``for``/``while`` over a RAW collection before any
+  signature or membership validation;
+* ``unchecked-decode`` — a decode/unpack/parse call consuming a RAW
+  payload (no length gate between the wire and the parser).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from harness.analysis.core import Finding, Project, SourceFile
+from harness.analysis.hotpath import (
+    _GENERIC_METHODS, _UNIQUE_LIMIT, _Module, _mod_paths,
+)
+
+CLEAN, BOUNDED, RAW = 0, 1, 2
+
+# files where sinks are *reported* (propagation still walks the whole
+# tree — a helper in utils/ can launder taint back into the surface)
+_SCOPE_MARKS = ("consensus/node.py", "sim/simnet.py", "/rpc/",
+                "core/txpool.py", "utils/ledger.py")
+
+# name-seeded entry points: RAW — the raw wire datagram / stream
+_RAW_ENTRIES = frozenset({
+    "on_gossip", "on_direct", "on_geec_txn", "deliver_gossip",
+    "deliver_direct", "_handle_conn", "_handle_ipc", "_handle_ws",
+})
+
+# name-seeded entry points: BOUNDED — transport already capped the
+# frame, values inside are still attacker-chosen
+_BOUNDED_ENTRIES = frozenset({
+    "dispatch", "_handle_body", "submit_txns", "broadcast_txns",
+})
+
+# params never seeded even on an entry (infrastructure, not payload)
+_NEVER_SEED = frozenset({"self", "writer"})
+
+# declared clamps: the call result is CLEAN regardless of arguments
+_CLAMP_FUNCS = frozenset({"clamp_rpc_limit", "bucket_round", "_pad"})
+
+# declared validators: a call to one of these leaf names marks the
+# calling function validated from that line on (signature/membership
+# checks — the paper's admission gates)
+_VALIDATOR_FUNCS = frozenset({
+    "is_committee", "is_acceptor", "is_member", "_verify_single",
+    "_verify_quorum", "_confirm_ok", "_filter_certified",
+    "_certified_mask", "recover_signers", "recover_addresses",
+})
+
+# validator calls whose *result* is also CLEAN (the surviving rows are
+# exactly the signature-checked ones)
+_CLEANING_VALIDATORS = frozenset({
+    "_filter_certified", "_certified_mask", "recover_signers",
+    "recover_addresses",
+})
+
+# decode-sink leaf names (unchecked-decode)
+_DECODE_FUNCS = frozenset({"loads", "decode", "unpack", "parse"})
+
+# allocation constructors whose first positional arg is a size
+_SIZED_CTORS = frozenset({"bytes", "bytearray"})
+_NP_ALLOCS = frozenset({"zeros", "ones", "empty", "full"})
+
+# container-mutator method names whose arguments land in the container
+_CONTAINER_ADDS = frozenset({"add", "append", "appendleft", "extend",
+                             "setdefault", "update"})
+
+_MAX_FIXPOINT_PASSES = 10
+
+
+def _in_scope(path: str, src: SourceFile) -> bool:
+    if any(mark in path for mark in _SCOPE_MARKS):
+        return True
+    return "# ingress-entry" in src.text or "#ingress-entry" in src.text
+
+
+def _leaf_name(func: ast.expr) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _key(node: ast.expr) -> str | None:
+    """Stable identity for a trackable lvalue: bare name, self-attr,
+    or a dotted chain off either."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _key(node.value)
+        if base is not None:
+            return base + "." + node.attr
+    return None
+
+
+def _shallow_walk(node: ast.AST):
+    """Walk without descending into nested function/class defs —
+    their bodies get their own environments."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+class _FnInfo:
+    """Per-function analysis state shared across fixpoint passes."""
+
+    __slots__ = ("path", "qual", "mod", "node", "cls", "params",
+                 "param_levels", "ret_level", "validated_entry",
+                 "seeded")
+
+    def __init__(self, path: str, qual: str, mod: _Module,
+                 node: ast.FunctionDef, cls: str | None):
+        self.path = path
+        self.qual = qual
+        self.mod = mod
+        self.node = node
+        self.cls = cls
+        self.params = [a.arg for a in node.args.args
+                       + getattr(node.args, "posonlyargs", [])
+                       + node.args.kwonlyargs]
+        self.param_levels: dict[str, int] = {p: CLEAN for p in self.params}
+        self.ret_level = CLEAN
+        # True when EVERY call site reaching this function sits in a
+        # validated region (then the callee inherits the validation);
+        # starts True and is cleared by any unvalidated call site
+        self.validated_entry: bool | None = None
+        self.seeded = False
+
+
+class _Analyzer:
+    def __init__(self, project: Project):
+        self.project = project
+        self.modules = {src.path: _Module(src) for src in project.files}
+        self.by_method: dict[str, list[tuple[str, str]]] = {}
+        for path, mod in self.modules.items():
+            for cname, tab in mod.classes.items():
+                for mname in tab["methods"]:
+                    self.by_method.setdefault(mname, []).append(
+                        (path, f"{cname}.{mname}"))
+        self.fns: dict[tuple[str, str], _FnInfo] = {}
+        for path, mod in self.modules.items():
+            for fname, fn in mod.defs.items():
+                self.fns[(path, fname)] = _FnInfo(
+                    path, fname, mod, fn, None)
+            for cname, tab in mod.classes.items():
+                for mname, fn in tab["methods"].items():
+                    qual = f"{cname}.{mname}"
+                    self.fns[(path, qual)] = _FnInfo(
+                        path, qual, mod, fn, cname)
+        self._seed()
+        self.findings: list[Finding] = []
+        self._dirty: set[tuple[str, str]] = set()
+        self._vlines: dict[tuple[str, str], list[int]] = {}
+        self._len_guards: dict[tuple[str, str], bool] = {}
+        self._reporting = False
+        self._ret = CLEAN
+
+    # -- sources --------------------------------------------------------
+
+    def _seed(self) -> None:
+        for info in self.fns.values():
+            name = info.qual.rpartition(".")[2]
+            comment = info.mod.src.line_comment(info.node.lineno)
+            level = None
+            if "ingress-entry" in comment:
+                level = RAW
+            elif name in _RAW_ENTRIES:
+                level = RAW
+            elif name in _BOUNDED_ENTRIES:
+                level = BOUNDED
+            if level is None:
+                continue
+            info.seeded = True
+            info.validated_entry = False
+            for p in info.params:
+                if p not in _NEVER_SEED:
+                    info.param_levels[p] = max(
+                        info.param_levels[p], level)
+
+    # -- call resolution (hotpath idiom) --------------------------------
+
+    def _resolve(self, info: _FnInfo, call: ast.Call) -> _FnInfo | None:
+        mod = info.mod
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in mod.defs:
+                return self.fns.get((info.path, f.id))
+            if f.id in mod.from_imports:
+                dotted, orig = mod.from_imports[f.id]
+                for path in _mod_paths(dotted):
+                    got = self.fns.get((path, orig))
+                    if got is not None:
+                        return got
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        recv = f.value
+        if (isinstance(recv, ast.Name) and recv.id == "self"
+                and info.cls):
+            tab = mod.classes.get(info.cls, {})
+            name = tab.get("aliases", {}).get(f.attr, f.attr)
+            if name in tab.get("methods", {}):
+                return self.fns.get((info.path, f"{info.cls}.{name}"))
+        if isinstance(recv, ast.Name):
+            dotted = mod.imports.get(recv.id)
+            if dotted is None and recv.id in mod.from_imports:
+                base, orig = mod.from_imports[recv.id]
+                dotted = f"{base}.{orig}" if base else orig
+            if dotted:
+                for path in _mod_paths(dotted):
+                    got = self.fns.get((path, f.attr))
+                    if got is not None:
+                        return got
+        if (f.attr not in _GENERIC_METHODS
+                and not f.attr.startswith("__")):
+            owners = self.by_method.get(f.attr, ())
+            if 0 < len(owners) <= _UNIQUE_LIMIT:
+                return self.fns.get(owners[0])
+        return None
+
+    # -- expression evaluation ------------------------------------------
+
+    def _level(self, env: dict[str, int], node: ast.expr,
+               info: _FnInfo, propagate: bool) -> int:
+        """Taint level of an expression under ``env``."""
+        if isinstance(node, ast.Constant):
+            return CLEAN
+        if isinstance(node, ast.Name):
+            return env.get(node.id, CLEAN)
+        if isinstance(node, ast.Attribute):
+            key = _key(node)
+            if key is not None and key in env:
+                return env[key]
+            base = self._level(env, node.value, info, propagate)
+            # reads off self are CLEAN unless the attr itself is
+            # tracked tainted — the *insert* is the gated point
+            if (isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                return CLEAN
+            return base
+        if isinstance(node, ast.Starred):
+            return self._level(env, node.value, info, propagate)
+        if isinstance(node, (ast.BinOp,)):
+            lhs = self._level(env, node.left, info, propagate)
+            rhs = self._level(env, node.right, info, propagate)
+            return max(lhs, rhs)
+        if isinstance(node, ast.BoolOp):
+            return max((self._level(env, v, info, propagate)
+                        for v in node.values), default=CLEAN)
+        if isinstance(node, ast.UnaryOp):
+            return self._level(env, node.operand, info, propagate)
+        if isinstance(node, ast.IfExp):
+            return max(self._level(env, node.body, info, propagate),
+                       self._level(env, node.orelse, info, propagate))
+        if isinstance(node, ast.Compare):
+            return CLEAN  # a boolean carries no exploitable magnitude
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return max((self._level(env, e, info, propagate)
+                        for e in node.elts), default=CLEAN)
+        if isinstance(node, ast.Dict):
+            parts = [self._level(env, v, info, propagate)
+                     for v in node.values if v is not None]
+            parts += [self._level(env, k, info, propagate)
+                      for k in node.keys if k is not None]
+            return max(parts, default=CLEAN)
+        if isinstance(node, ast.Subscript):
+            base = self._level(env, node.value, info, propagate)
+            if isinstance(node.slice, ast.Slice):
+                # an explicit slice bounds the extent
+                return min(base, BOUNDED) if base else CLEAN
+            return base
+        if isinstance(node, ast.JoinedStr):
+            return max((self._level(env, v.value, info, propagate)
+                        for v in node.values
+                        if isinstance(v, ast.FormattedValue)),
+                       default=CLEAN)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            lvl = max((self._level(env, g.iter, info, propagate)
+                       for g in node.generators), default=CLEAN)
+            return lvl
+        if isinstance(node, ast.Await):
+            return self._level(env, node.value, info, propagate)
+        if isinstance(node, ast.Call):
+            return self._call_level(env, node, info, propagate)
+        return CLEAN
+
+    def _call_level(self, env: dict[str, int], call: ast.Call,
+                    info: _FnInfo, propagate: bool) -> int:
+        name = _leaf_name(call.func)
+        args = list(call.args) + [kw.value for kw in call.keywords]
+        arg_levels = [self._level(env, a, info, propagate) for a in args]
+        arg_max = max(arg_levels, default=CLEAN)
+        if name in _CLAMP_FUNCS or name in _CLEANING_VALIDATORS:
+            return CLEAN
+        if name == "min" and len(arg_levels) >= 2 \
+                and any(lv == CLEAN for lv in arg_levels):
+            return CLEAN  # min(x, CAP): the cap wins
+        if name == "len":
+            arg = arg_levels[0] if arg_levels else CLEAN
+            # len() of a bounded/clean structure is a safe number;
+            # len() of a RAW structure is itself attacker-sized
+            return RAW if arg == RAW else CLEAN
+        # a container-mutator taints its receiver: headers[k] = v /
+        # out.append(tainted) make the container itself carry the level
+        if propagate and name in _CONTAINER_ADDS \
+                and isinstance(call.func, ast.Attribute):
+            base = call.func.value
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            bk = _key(base)
+            if bk is not None and arg_max > env.get(bk, CLEAN):
+                env[bk] = arg_max
+        target = self._resolve(info, call)
+        if target is not None:
+            if propagate:
+                self._flow_args(env, call, info, target)
+            return target.ret_level
+        # unresolved: join of args, plus the receiver's taint capped at
+        # BOUNDED (x.hex(), reader.readline() — derived data, but a
+        # method call alone doesn't make it unbounded)
+        recv_level = CLEAN
+        if isinstance(call.func, ast.Attribute):
+            recv_level = min(
+                self._level(env, call.func.value, info, propagate),
+                BOUNDED)
+        return max(arg_max, recv_level)
+
+    def _flow_args(self, env: dict[str, int], call: ast.Call,
+                   info: _FnInfo, target: _FnInfo) -> None:
+        """Join call-site argument levels into callee params and record
+        validated-region inheritance."""
+        params = [p for p in target.params if p != "self"]
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Starred) or i >= len(params):
+                break
+            lv = self._level(env, a, info, False)
+            p = params[i]
+            if lv > target.param_levels.get(p, CLEAN):
+                target.param_levels[p] = lv
+                self._dirty.add((target.path, target.qual))
+        for kw in call.keywords:
+            if kw.arg is None or kw.arg not in target.param_levels:
+                continue
+            lv = self._level(env, kw.value, info, False)
+            if lv > target.param_levels[kw.arg]:
+                target.param_levels[kw.arg] = lv
+                self._dirty.add((target.path, target.qual))
+        # validated-region inheritance considers only TAINT-CARRYING
+        # call sites: a clean call site (startup replay, internal tick)
+        # says nothing about whether attacker data was validated
+        site_levels = [self._level(env, a, info, False)
+                       for a in call.args] + \
+                      [self._level(env, kw.value, info, False)
+                       for kw in call.keywords]
+        if max(site_levels, default=CLEAN) < BOUNDED:
+            return
+        validated_here = self._validated_at(info, call.lineno)
+        if target.validated_entry is None:
+            target.validated_entry = validated_here
+        elif target.validated_entry and not validated_here:
+            target.validated_entry = False
+            self._dirty.add((target.path, target.qual))
+
+    # -- validated regions ----------------------------------------------
+
+    def _validator_lines(self, info: _FnInfo) -> list[int]:
+        key = (info.path, info.qual)
+        cached = self._vlines.get(key)
+        if cached is not None:
+            return cached
+        lines = []
+        for n in _shallow_walk(info.node):
+            if isinstance(n, ast.Call) \
+                    and _leaf_name(n.func) in _VALIDATOR_FUNCS:
+                lines.append(n.lineno)
+        lines.sort()
+        self._vlines[key] = lines
+        return lines
+
+    def _validated_at(self, info: _FnInfo, line: int) -> bool:
+        """True when ``line`` sits after a validator call in this
+        function, or the whole function inherits validation from its
+        (uniformly validated) call sites."""
+        if info.validated_entry:
+            return True
+        return any(v <= line for v in self._validator_lines(info))
+
+    def _has_len_guard(self, info: _FnInfo) -> bool:
+        """True when the function compares ``len(<self-rooted
+        container>)`` against anything with an inequality anywhere —
+        the declared capacity check that makes its container writes
+        bounded (the txpool/_ingest_ctx idiom).  Local aliases of
+        ``self`` attributes count."""
+        key = (info.path, info.qual)
+        cached = self._len_guards.get(key)
+        if cached is not None:
+            return cached
+        aliases = set()
+        for n in _shallow_walk(info.node):
+            if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                    and isinstance(n.value, ast.Attribute)
+                    and isinstance(n.value.value, ast.Name)
+                    and n.value.value.id == "self"):
+                aliases.add(n.targets[0].id)
+        found = False
+        for n in _shallow_walk(info.node):
+            if not (isinstance(n, ast.Compare) and len(n.ops) == 1
+                    and isinstance(n.ops[0], (ast.Gt, ast.GtE,
+                                              ast.Lt, ast.LtE))):
+                continue
+            for side in (n.left, n.comparators[0]):
+                # walk within the side: ``len(a) + len(b) > CAP`` is a
+                # capacity check too, not just a bare ``len(a) > CAP``
+                for sub in ast.walk(side):
+                    if not (isinstance(sub, ast.Call)
+                            and _leaf_name(sub.func) == "len"
+                            and sub.args):
+                        continue
+                    arg = sub.args[0]
+                    while isinstance(arg, ast.Subscript):
+                        arg = arg.value
+                    k = _key(arg)
+                    if k and (k.startswith("self.")
+                              or k.split(".")[0] in aliases):
+                        found = True
+        self._len_guards[key] = found
+        return found
+
+    def _container_key(self, node: ast.expr,
+                       aliases: dict[str, str]) -> str | None:
+        """The self-rooted identity of a container receiver (unwrapping
+        nested subscripts), or None when it isn't long-lived state."""
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        k = _key(node)
+        if k is None:
+            return None
+        if k.startswith("self."):
+            return k
+        root = k.split(".")[0]
+        if root in aliases:
+            return aliases[root]
+        return None
+
+    # -- guards ---------------------------------------------------------
+
+    def _compare_effects(self, node: ast.Compare, env: dict[str, int],
+                         info: _FnInfo) -> tuple[list, list]:
+        """(true_effects, false_effects) of one inequality compare.
+        An effect is ``(key, capped_level)``: the downgrade that holds
+        on the path where the condition is known true/false.  Only
+        Gt/GtE/Lt/LtE sanitize — ``x != expected`` proves nothing
+        about magnitude — and only a compare against a CLEAN bound
+        proves anything.  A ``len(x)`` cap downgrades ``x`` to BOUNDED
+        (size capped, contents still attacker-chosen); a direct value
+        cap downgrades to CLEAN."""
+        if len(node.ops) != 1 or not isinstance(
+                node.ops[0], (ast.Gt, ast.GtE, ast.Lt, ast.LtE)):
+            return [], []
+        lo_first = isinstance(node.ops[0], (ast.Lt, ast.LtE))
+        left, right = node.left, node.comparators[0]
+        smaller, larger = (left, right) if lo_first else (right, left)
+        true_eff, false_eff = [], []
+        for expr, bound, eff in ((smaller, larger, true_eff),
+                                 (larger, smaller, false_eff)):
+            # "expr is below the bound" holds on this path
+            if self._level(env, bound, info, False) != CLEAN:
+                continue
+            if (isinstance(expr, ast.Call)
+                    and _leaf_name(expr.func) == "len" and expr.args):
+                k = _key(expr.args[0])
+                if k is not None:
+                    eff.append((k, BOUNDED))
+            else:
+                k = _key(expr)
+                if k is not None:
+                    eff.append((k, CLEAN))
+        return true_eff, false_eff
+
+    def _guard_effects(self, test: ast.expr, env: dict[str, int],
+                       info: _FnInfo) -> tuple[list, list]:
+        """Branch-sensitive effects of an If/While test.  For ``and``,
+        the TRUE path proves every conjunct (apply all true-effects)
+        while the FALSE path proves nothing (any conjunct may have
+        failed); ``or`` is the mirror image."""
+        if isinstance(test, ast.Compare):
+            return self._compare_effects(test, env, info)
+        if isinstance(test, ast.BoolOp):
+            true_eff, false_eff = [], []
+            for v in test.values:
+                t, f = self._guard_effects(v, env, info)
+                if isinstance(test.op, ast.And):
+                    true_eff.extend(t)
+                else:
+                    false_eff.extend(f)
+            return true_eff, false_eff
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op,
+                                                        ast.Not):
+            t, f = self._guard_effects(test.operand, env, info)
+            return f, t
+        return [], []
+
+    @staticmethod
+    def _apply_effects(env: dict[str, int], effects: list) -> None:
+        for k, cap in effects:
+            if env.get(k, CLEAN) > cap:
+                env[k] = cap
+
+    @staticmethod
+    def _terminates(stmts: list[ast.stmt]) -> bool:
+        """True when the block always leaves the enclosing suite —
+        the early-exit guard shape (``if oversized: count; return``)."""
+        return any(isinstance(s, (ast.Return, ast.Raise, ast.Break,
+                                  ast.Continue)) for s in stmts)
+
+    # -- sinks ----------------------------------------------------------
+
+    def _report(self, rule: str, info: _FnInfo, line: int,
+                message: str) -> None:
+        if not self._reporting:
+            return
+        src = info.mod.src
+        if not _in_scope(info.path, src):
+            return
+        if src.bounded_by(line) is not None:
+            return
+        self.findings.append(Finding(
+            rule=rule, path=info.path, line=line,
+            symbol=info.qual, message=message))
+
+    def _expr_sinks(self, expr: ast.expr, env: dict[str, int],
+                    info: _FnInfo, aliases: dict[str, str]) -> None:
+        for node in _shallow_walk(expr):
+            if isinstance(node, ast.Call):
+                self._call_sinks(node, env, info, aliases)
+            elif (isinstance(node, ast.BinOp)
+                  and isinstance(node.op, ast.Mult)):
+                for lhs, rhs in ((node.left, node.right),
+                                 (node.right, node.left)):
+                    if (isinstance(lhs, ast.Constant)
+                            and isinstance(lhs.value, (bytes, str))
+                            and self._level(env, rhs, info, False)
+                            >= BOUNDED):
+                        self._report(
+                            "taint-alloc", info, node.lineno,
+                            "attacker-influenced repeat count sizes a "
+                            "sequence multiplication — clamp it or "
+                            "declare the bound with # bounded-by:")
+                        break
+
+    def _call_sinks(self, call: ast.Call, env: dict[str, int],
+                    info: _FnInfo, aliases: dict[str, str]) -> None:
+        name = _leaf_name(call.func)
+        args = list(call.args) + [kw.value for kw in call.keywords]
+
+        def lv(a: ast.expr) -> int:
+            return self._level(env, a, info, False)
+
+        # taint-alloc: tainted value sizes an allocation.  Display /
+        # comprehension arguments COPY existing (already-materialized)
+        # data rather than sizing a fresh buffer from an integer — only
+        # a scalar-shaped argument can be an attacker-chosen size.
+        if name in _SIZED_CTORS or name in _NP_ALLOCS:
+            if call.args and not isinstance(
+                    call.args[0],
+                    (ast.GeneratorExp, ast.ListComp, ast.SetComp,
+                     ast.DictComp, ast.List, ast.Tuple, ast.Set,
+                     ast.Dict, ast.JoinedStr, ast.Starred)) \
+                    and lv(call.args[0]) >= BOUNDED:
+                self._report(
+                    "taint-alloc", info, call.lineno,
+                    f"attacker-influenced value sizes a {name}() "
+                    "allocation — clamp it (clamp_rpc_limit / min(x, "
+                    "CAP)) or declare the bound with # bounded-by:")
+        elif name == "range":
+            extent = CLEAN
+            if len(call.args) >= 2 and isinstance(call.args[1],
+                                                  ast.BinOp) \
+                    and isinstance(call.args[1].op, ast.Add):
+                b = call.args[1]
+                if ast.dump(b.left) == ast.dump(call.args[0]):
+                    extent = lv(b.right)
+                elif ast.dump(b.right) == ast.dump(call.args[0]):
+                    extent = lv(b.left)
+                else:
+                    extent = max((lv(a) for a in call.args),
+                                 default=CLEAN)
+            else:
+                extent = max((lv(a) for a in call.args), default=CLEAN)
+            if extent >= BOUNDED:
+                self._report(
+                    "taint-alloc", info, call.lineno,
+                    "attacker-influenced extent drives a range() — "
+                    "clamp the bound (min(x, CAP)) or declare it with "
+                    "# bounded-by:")
+        elif name in ("readexactly", "recv", "recv_into"):
+            if any(lv(a) >= BOUNDED for a in args):
+                self._report(
+                    "taint-alloc", info, call.lineno,
+                    f"attacker-controlled length reaches {name}() — "
+                    "an unchecked content-length buffers unbounded "
+                    "bytes; cap it before reading")
+
+        # unchecked-decode: a parser consumes a RAW payload
+        if (name in _DECODE_FUNCS or name.startswith("unpack_")
+                or name.startswith("decode_")) and name != "extract":
+            if any(lv(a) == RAW for a in args):
+                self._report(
+                    "unchecked-decode", info, call.lineno,
+                    f"{name}() consumes a payload with no length gate "
+                    "between the wire and the parser — check len() "
+                    "against a cap first")
+
+        # taint-cardinality: long-lived container / label / origin feeds
+        if name in _CONTAINER_ADDS and isinstance(call.func,
+                                                  ast.Attribute):
+            ck = self._container_key(call.func.value, aliases)
+            # dict.update(k=v) writes FIXED keys — only positional
+            # args (merged mappings / iterables) can mint new entries
+            checked = list(call.args) if name == "update" else args
+            if ck is not None and any(lv(a) >= BOUNDED
+                                      for a in checked) \
+                    and not self._validated_at(info, call.lineno) \
+                    and not self._has_len_guard(info):
+                self._report(
+                    "taint-cardinality", info, call.lineno,
+                    f"attacker-influenced value lands in {ck} with no "
+                    "size cap or membership validation in this "
+                    "function — an attacker can grow it without "
+                    "bound; add a capacity check with eviction")
+        if name in ("counter", "gauge"):
+            for a in args:
+                if isinstance(a, ast.JoinedStr) and lv(a) >= BOUNDED:
+                    self._report(
+                        "taint-cardinality", info, call.lineno,
+                        "attacker-influenced value interpolated into a "
+                        "metric name — unbounded label cardinality "
+                        "explodes the registry; use a fixed family")
+                    break
+        if name == "record":
+            for kw in call.keywords:
+                v = kw.value
+                fire = (isinstance(v, ast.JoinedStr)
+                        and lv(v) >= BOUNDED)
+                if (isinstance(v, ast.Call)
+                        and _leaf_name(v.func) == "hex"
+                        and isinstance(v.func, ast.Attribute)
+                        and self._level(env, v.func.value, info, False)
+                        >= BOUNDED):
+                    fire = True
+                if fire:
+                    self._report(
+                        "taint-cardinality", info, call.lineno,
+                        f"attacker-influenced journal attribute "
+                        f"{kw.arg!r} is unsliced — unbounded distinct "
+                        "values bloat the journal; truncate ([:8]) or "
+                        "validate membership first")
+        if name in ("peer", "bind") and isinstance(call.func,
+                                                   ast.Attribute):
+            rk = _key(call.func.value)
+            if rk is not None and rk.split(".")[-1] == "ledger" \
+                    and any(lv(a) >= BOUNDED for a in args) \
+                    and not self._validated_at(info, call.lineno):
+                self._report(
+                    "taint-cardinality", info, call.lineno,
+                    "attacker-controlled origin feeds the ingress "
+                    "ledger top-K — clamp the origin string length "
+                    "or declare the bound with # bounded-by:")
+
+    def _for_sink(self, st: ast.For, env: dict[str, int],
+                  info: _FnInfo) -> None:
+        if isinstance(st.iter, ast.Call) \
+                and _leaf_name(st.iter.func) == "range":
+            return  # the range() alloc rule owns that shape
+        if self._level(env, st.iter, info, False) == RAW \
+                and not self._validated_at(info, st.lineno):
+            self._report(
+                "taint-loop", info, st.lineno,
+                "loop over an unbounded attacker-controlled "
+                "collection before any signature or membership "
+                "validation — cap the collection (or validate) first")
+
+    def _while_sink(self, st: ast.While, env: dict[str, int],
+                    info: _FnInfo) -> None:
+        if self._validated_at(info, st.lineno):
+            return
+        comps = [c for c in ast.walk(st.test)
+                 if isinstance(c, ast.Compare)]
+        if comps:
+            for c in comps:
+                sides = [c.left] + list(c.comparators)
+                lvls = [self._level(env, s, info, False) for s in sides]
+                if RAW in lvls and CLEAN not in lvls:
+                    self._report(
+                        "taint-loop", info, st.lineno,
+                        "while-loop bounded only by attacker-"
+                        "controlled values — no clean comparand "
+                        "terminates it; cap the bound first")
+                    return
+        elif self._level(env, st.test, info, False) == RAW:
+            self._report(
+                "taint-loop", info, st.lineno,
+                "while-loop driven by an unbounded attacker-"
+                "controlled value — cap it first")
+
+    # -- statement executor ---------------------------------------------
+
+    def _assign(self, target: ast.expr, value_node: ast.expr | None,
+                lv: int, env: dict[str, int], info: _FnInfo,
+                aliases: dict[str, str]) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = lv
+            if (isinstance(value_node, ast.Attribute)
+                    and isinstance(value_node.value, ast.Name)
+                    and value_node.value.id == "self"):
+                aliases[target.id] = "self." + value_node.attr
+            elif target.id in aliases:
+                del aliases[target.id]
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._assign(e, None, lv, env, info, aliases)
+        elif isinstance(target, ast.Starred):
+            self._assign(target.value, None, lv, env, info, aliases)
+        elif isinstance(target, ast.Attribute):
+            k = _key(target)
+            if k is not None:
+                env[k] = lv
+        elif isinstance(target, ast.Subscript):
+            ck = self._container_key(target.value, aliases)
+            key_lv = self._level(env, target.slice, info, False)
+            if ck is not None and key_lv >= BOUNDED \
+                    and not self._validated_at(info, target.lineno) \
+                    and not self._has_len_guard(info):
+                self._report(
+                    "taint-cardinality", info, target.lineno,
+                    f"attacker-influenced key indexes into {ck} with "
+                    "no size cap or membership validation in this "
+                    "function — an attacker mints unbounded entries; "
+                    "add a capacity check with eviction")
+            # the write taints the container itself (headers[k] = v)
+            base = target.value
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            bk = _key(base)
+            if bk is not None:
+                env[bk] = max(env.get(bk, CLEAN), lv, key_lv)
+
+    def _merge(self, env: dict[str, int], *branches: dict[str, int]
+               ) -> None:
+        keys = set()
+        for b in branches:
+            keys |= set(b)
+        for k in keys:
+            env[k] = max(b.get(k, CLEAN) for b in branches)
+
+    def _exec(self, stmts: list[ast.stmt], env: dict[str, int],
+              info: _FnInfo, aliases: dict[str, str]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef, ast.Import,
+                               ast.ImportFrom, ast.Global,
+                               ast.Nonlocal, ast.Pass, ast.Break,
+                               ast.Continue)):
+                continue
+            if isinstance(st, ast.Assign):
+                self._expr_sinks(st.value, env, info, aliases)
+                lv = self._level(env, st.value, info, True)
+                for t in st.targets:
+                    self._assign(t, st.value, lv, env, info, aliases)
+            elif isinstance(st, ast.AnnAssign):
+                if st.value is not None:
+                    self._expr_sinks(st.value, env, info, aliases)
+                    lv = self._level(env, st.value, info, True)
+                    self._assign(st.target, st.value, lv, env, info,
+                                 aliases)
+            elif isinstance(st, ast.AugAssign):
+                self._expr_sinks(st.value, env, info, aliases)
+                lv = max(self._level(env, st.value, info, True),
+                         self._level(env, st.target, info, False))
+                self._assign(st.target, st.value, lv, env, info,
+                             aliases)
+            elif isinstance(st, ast.Expr):
+                self._expr_sinks(st.value, env, info, aliases)
+                self._level(env, st.value, info, True)
+            elif isinstance(st, ast.Return):
+                if st.value is not None:
+                    self._expr_sinks(st.value, env, info, aliases)
+                    self._ret = max(self._ret, self._level(
+                        env, st.value, info, True))
+            elif isinstance(st, ast.If):
+                self._expr_sinks(st.test, env, info, aliases)
+                self._level(env, st.test, info, True)
+                true_eff, false_eff = self._guard_effects(
+                    st.test, env, info)
+                benv, oenv = dict(env), dict(env)
+                self._apply_effects(benv, true_eff)
+                self._apply_effects(oenv, false_eff)
+                self._exec(st.body, benv, info, aliases)
+                self._exec(st.orelse, oenv, info, aliases)
+                # an early-exit branch never rejoins: the fallthrough
+                # state is the OTHER branch's alone (the oversize-
+                # reject guard shape)
+                if self._terminates(st.body):
+                    env.clear()
+                    env.update(oenv)
+                elif st.orelse and self._terminates(st.orelse):
+                    env.clear()
+                    env.update(benv)
+                else:
+                    self._merge(env, benv, oenv)
+            elif isinstance(st, ast.While):
+                self._expr_sinks(st.test, env, info, aliases)
+                self._while_sink(st, env, info)
+                true_eff, false_eff = self._guard_effects(
+                    st.test, env, info)
+                benv = dict(env)
+                self._apply_effects(benv, true_eff)
+                self._exec(st.body, benv, info, aliases)
+                self._merge(env, env, benv)
+                # the loop exits with the test false (break is folded
+                # in conservatively by the max-merge above)
+                self._apply_effects(env, false_eff)
+                self._exec(st.orelse, env, info, aliases)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                self._expr_sinks(st.iter, env, info, aliases)
+                self._for_sink(st, env, info)
+                ilv = self._level(env, st.iter, info, True)
+                self._assign(st.target, None, ilv, env, info, aliases)
+                benv = dict(env)
+                self._exec(st.body, benv, info, aliases)
+                self._merge(env, env, benv)
+                self._exec(st.orelse, env, info, aliases)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    self._expr_sinks(item.context_expr, env, info,
+                                     aliases)
+                    lv = self._level(env, item.context_expr, info, True)
+                    if item.optional_vars is not None:
+                        self._assign(item.optional_vars, None, lv, env,
+                                     info, aliases)
+                self._exec(st.body, env, info, aliases)
+            elif isinstance(st, ast.Try):
+                benv = dict(env)
+                self._exec(st.body, benv, info, aliases)
+                self._merge(env, env, benv)
+                for h in st.handlers:
+                    henv = dict(env)
+                    self._exec(h.body, henv, info, aliases)
+                    self._merge(env, env, henv)
+                self._exec(st.orelse, env, info, aliases)
+                self._exec(st.finalbody, env, info, aliases)
+            elif isinstance(st, (ast.Raise, ast.Assert, ast.Delete)):
+                for child in ast.iter_child_nodes(st):
+                    if isinstance(child, ast.expr):
+                        self._expr_sinks(child, env, info, aliases)
+
+    def _scan_fn(self, info: _FnInfo) -> None:
+        env = dict(info.param_levels)
+        aliases: dict[str, str] = {}
+        self._ret = CLEAN
+        self._exec(info.node.body, env, info, aliases)
+        if self._ret > info.ret_level:
+            info.ret_level = self._ret
+
+    # -- driver ---------------------------------------------------------
+
+    def _snapshot(self) -> tuple:
+        return tuple(
+            (key, tuple(sorted(self.fns[key].param_levels.items())),
+             self.fns[key].ret_level, self.fns[key].validated_entry)
+            for key in sorted(self.fns))
+
+    def analyze(self) -> list[Finding]:
+        for _ in range(_MAX_FIXPOINT_PASSES):
+            before = self._snapshot()
+            for key in sorted(self.fns):
+                self._scan_fn(self.fns[key])
+            if self._snapshot() == before:
+                break
+        self._reporting = True
+        for key in sorted(self.fns):
+            info = self.fns[key]
+            if _in_scope(info.path, info.mod.src):
+                self._scan_fn(info)
+        self.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        return self.findings
+
+
+def check(project: Project) -> list[Finding]:
+    return _Analyzer(project).analyze()
+

@@ -794,6 +794,122 @@ def _scn_ingress_flood_attribution(seed: int, fast: bool) -> dict:
     return res
 
 
+def _scn_oversized_payload_flood(seed: int, fast: bool) -> dict:
+    """Live proof of the static taint bounds: an injected peer floods
+    the cluster with (a) datagrams past INGRESS_MAX_BYTES — dropped for
+    the price of a length check, before RLP ever runs — and (b)
+    far-future GOSSIP_QUERY messages that stuff the defer queue until
+    the DEFER_MAX eviction path sheds oldest-first.  Consensus must
+    keep committing, every node's defer queue must end at or under its
+    cap, and the ingress ledger must bill both drop families to the
+    flooder — byte-deterministic across same-seed runs."""
+    from eges_tpu.core.types import QueryBlockMsg, Transaction
+    from eges_tpu.utils import ledger as ledger_mod
+    from eges_tpu.utils.metrics import DEFAULT as metrics
+    import eges_tpu.consensus.messages as M
+
+    cluster = SimCluster(4, seed=seed, txn_per_block=4, txpool=True)
+    inj = FaultInjector(cluster)     # journals the (empty) fault plan
+    cluster.net.join("flooder", "10.0.0.99", 9999,
+                     lambda d: None, lambda d: None)
+    cluster.net.join("client", "10.0.0.98", 9998,
+                     lambda d: None, lambda d: None)
+    # shrink the defer cap so the eviction path is exercised in a few
+    # virtual seconds (same override both runs -> still deterministic)
+    for sn in cluster.nodes:
+        sn.node.DEFER_MAX = 64
+
+    # metric counters are process-global: gate the checks on deltas so
+    # back-to-back runs (the determinism harness) stay independent
+    oversized0 = metrics.counter("consensus.ingress_oversized").value
+    evicted0 = metrics.counter("consensus.deferred_dropped").value
+
+    # honest contrast traffic: a well-behaved client's signed txns
+    priv = bytes([7]) * 32
+    good = tuple(Transaction(nonce=i, gas_price=1, gas_limit=21000,
+                             to=bytes(20), value=0).signed(priv)
+                 for i in range(4))
+
+    def honest():
+        cluster.net.deliver_gossip("client", M.pack_gossip(
+            M.GOSSIP_TXNS, M.TxnsMsg(txns=good)))
+
+    from eges_tpu.consensus.node import GeecNode as _Node
+    junk = b"\x00" * (_Node.INGRESS_MAX_BYTES + 1)
+    flooding = [True]
+    wave = [0]
+
+    def flood():
+        if not flooding[0]:
+            return
+        # one oversized datagram per wave: must die at the byte gate
+        cluster.net.deliver_gossip("flooder", junk)
+        # a burst of unique far-future queries: each one is a deferral
+        base = 100_000 + wave[0] * 16
+        wave[0] += 1
+        for i in range(16):
+            cluster.net.deliver_gossip("flooder", M.pack_gossip(
+                M.GOSSIP_QUERY,
+                QueryBlockMsg(block_number=base + i, version=1,
+                              ip="10.0.0.99", retry=0, port=9999)))
+        cluster.clock.call_later(2.0, flood)
+
+    cluster.clock.call_later(0.5, honest)
+    cluster.clock.call_later(1.0, flood)
+    cluster.start()
+
+    def _tripped() -> bool:
+        return (metrics.counter("consensus.ingress_oversized").value
+                > oversized0
+                and metrics.counter("consensus.deferred_dropped").value
+                > evicted0)
+
+    cluster.run(600.0, stop_condition=_tripped)
+    flooding[0] = False
+    res = _finish("oversized_payload_flood", seed, cluster,
+                  extra_blocks=2, bound_s=240.0,
+                  checks={
+                      "flood_waves_sent": wave[0] > 0,
+                      "oversized_dropped_pre_decode": (
+                          metrics.counter(
+                              "consensus.ingress_oversized").value
+                          > oversized0),
+                      "defer_evictions_counted": (
+                          metrics.counter(
+                              "consensus.deferred_dropped").value
+                          > evicted0),
+                      "defer_queues_capped": all(
+                          len(sn.node._deferred) <= sn.node.DEFER_MAX
+                          for sn in cluster.nodes),
+                  })
+    # forensics: both drop families must bill to the flooder, who must
+    # out-rank every honest origin on both (honest peers DO carry some
+    # drops — duplicate re-gossip — and protocol deferrals; the signal
+    # is the flooder sitting on top of both columns).  The well-behaved
+    # client must stay entirely unblamed.
+    rep = ledger_mod.assemble(res["journals"])
+    rows = {o["origin"]: o for o in rep.get("origins", [])}
+    flooder = rows.get("peer:flooder", {})
+    honest = [o for name, o in rows.items() if name != "peer:flooder"]
+    client = rows.get("peer:client", {})
+    checks = {
+        "flooder_billed_drops": flooder.get("drops", 0.0) > 0,
+        "flooder_billed_deferred": flooder.get("deferred", 0.0) > 0,
+        "flooder_top_offender": all(
+            flooder.get("drops", 0.0) > o.get("drops", 0.0)
+            and flooder.get("deferred", 0.0) > o.get("deferred", 0.0)
+            for o in honest),
+        "honest_client_unblamed": (client.get("drops", 0.0) <= 0.0
+                                   and client.get("deferred", 0.0) <= 0.0
+                                   and client.get("admits", 0.0) > 0),
+    }
+    res["ledger"] = {"origins": len(rows),
+                     "flooder_drops": flooder.get("drops", 0.0)}
+    res["checks"].update(checks)
+    res["ok"] = bool(res["ok"] and all(checks.values()))
+    return res
+
+
 def _scn_combo(seed: int, fast: bool) -> dict:
     """The acceptance storm: leader-kill + 20% loss + an asymmetric
     partition, all at once, then heal everything.  Live nodes must
@@ -833,6 +949,7 @@ SCENARIOS = {
     "calm_baseline": _scn_calm_baseline,
     "commit_attribution": _scn_commit_attribution,
     "ingress_flood_attribution": _scn_ingress_flood_attribution,
+    "oversized_payload_flood": _scn_oversized_payload_flood,
     "combo": _scn_combo,
 }
 

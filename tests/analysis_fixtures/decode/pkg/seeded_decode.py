@@ -1,0 +1,47 @@
+"""Seeded unchecked-decode fixtures: parsers fed the raw wire payload
+with no length gate in between — plus gated / contracted / waived
+twins that must stay quiet."""
+
+import json
+
+
+class EagerDecode:
+    """The payload hits the parser before anything bounds it."""
+
+    def on_frame(self, data):  # ingress-entry
+        return json.loads(data)         # fires: RAW decode
+
+
+class EagerUnpack:
+    """Same vector through an unpack_* helper."""
+
+    def unpack_frame(self, data):
+        return data.split(b"\0")
+
+    def on_frame(self, data):  # ingress-entry
+        return self.unpack_frame(data)  # fires: RAW unpack_*
+
+
+class GatedTwin:
+    """Clean twin: a length gate between the wire and the parser."""
+
+    CAP = 1 << 16
+
+    def on_frame(self, data):  # ingress-entry
+        if len(data) > self.CAP:
+            return None
+        return json.loads(data)
+
+
+class ContractDecode:
+    """The gate lives in the transport; the contract declares it."""
+
+    def on_frame(self, data):  # ingress-entry
+        return json.loads(data)  # bounded-by: len(data) <= MTU (transport cap)
+
+
+class WaivedDecode:
+    """Same shape as EagerDecode, silenced by a line waiver."""
+
+    def on_frame(self, data):  # ingress-entry
+        return json.loads(data)  # analysis: allow-unchecked-decode(loopback only)

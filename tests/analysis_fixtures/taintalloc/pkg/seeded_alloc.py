@@ -1,0 +1,63 @@
+"""Seeded taint-alloc fixtures: wire-derived sizes reaching buffer
+allocations, sequence repeats, ranges, and socket reads with no clamp
+— plus clean twins (min() clamp, early-exit gate, same-line contract)
+and a waiver that must all stay quiet."""
+
+
+class SizedByWire:
+    """The frame's self-declared length sizes buffers before any
+    bound is enforced — the classic length-prefix OOM."""
+
+    def on_frame(self, data):  # ingress-entry
+        n = int.from_bytes(data, "big")
+        buf = bytearray(n)          # fires: attacker-sized allocation
+        pad = b"\x00" * n           # fires: attacker-sized repeat
+        slots = range(n)            # fires: attacker-sized extent
+        return buf, pad, slots
+
+
+class ReadsByHeader:
+    """A client-declared content-length sizes the stream read."""
+
+    async def on_frame(self, reader, data):  # ingress-entry
+        n = int.from_bytes(data, "big")
+        return await reader.readexactly(n)   # fires: unchecked read
+
+
+class ClampedTwin:
+    """Clean twin: the size flows through min() against a constant."""
+
+    CAP = 4096
+
+    def on_frame(self, data):  # ingress-entry
+        n = min(int.from_bytes(data, "big"), self.CAP)
+        return bytearray(n)
+
+
+class GatedTwin:
+    """Clean twin: an early-exit bounds compare caps the size."""
+
+    CAP = 4096
+
+    def on_frame(self, data):  # ingress-entry
+        n = int.from_bytes(data, "big")
+        if n > self.CAP:
+            return None
+        return bytearray(n)
+
+
+class ContractTwin:
+    """The bound holds by an invariant the checker cannot see; the
+    same-line contract declares it."""
+
+    def on_frame(self, data):  # ingress-entry
+        n = int.from_bytes(data, "big")
+        return bytearray(n)  # bounded-by: n <= MTU (transport caps frames)
+
+
+class WaivedAlloc:
+    """Same shape as SizedByWire, silenced by a line waiver."""
+
+    def on_frame(self, data):  # ingress-entry
+        n = int.from_bytes(data, "big")
+        return bytearray(n)  # analysis: allow-taint-alloc(fuzz harness input only)

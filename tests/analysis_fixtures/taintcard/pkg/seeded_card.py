@@ -1,0 +1,91 @@
+"""Seeded taint-cardinality fixtures: attacker-minted dict keys, set
+membership, metric-label interpolation and unsliced journal attrs —
+plus capped / validated / contracted twins that must stay quiet."""
+
+
+class MintsKeys:
+    """Every datagram mints a fresh key: unbounded dict growth."""
+
+    def __init__(self):
+        self.seen = {}
+
+    def on_frame(self, data):  # ingress-entry
+        self.seen[data] = True          # fires: unbounded key mint
+
+
+class GrowsSet:
+    """Same vector through a container-mutator method call."""
+
+    def __init__(self):
+        self.peers = set()
+
+    def on_frame(self, peer):  # ingress-entry
+        self.peers.add(peer)            # fires: unbounded set growth
+
+
+class LabelExplosion:
+    """Attacker bytes interpolated into a metric family name."""
+
+    def __init__(self, metrics, journal):
+        self.metrics = metrics
+        self.journal = journal
+
+    def on_frame(self, tag):  # ingress-entry
+        self.metrics.counter(f"peer.{tag}.bytes").inc()   # fires: label
+        self.journal.record("frame", origin=f"peer:{tag}")  # fires: attr
+
+
+class CappedTwin:
+    """Clean twin: a capacity check with eviction in the same
+    function bounds the container."""
+
+    CAP = 1024
+
+    def __init__(self):
+        self.seen = {}
+
+    def on_frame(self, data):  # ingress-entry
+        if len(self.seen) >= self.CAP:
+            self.seen.clear()
+        self.seen[data] = True
+
+
+class ValidatedTwin:
+    """Clean twin: membership validation gates the write."""
+
+    def __init__(self, membership):
+        self.membership = membership
+        self.votes = {}
+
+    def is_member(self, addr):
+        return addr in self.membership
+
+    def on_frame(self, addr):  # ingress-entry
+        if not self.is_member(addr):
+            return
+        self.votes[addr] = True
+
+
+class ContractTwin:
+    """The cap lives in another function; the contract declares it."""
+
+    def __init__(self):
+        self.seen = {}
+
+    def _expire(self):
+        while len(self.seen) > 64:
+            self.seen.pop(next(iter(self.seen)))
+
+    def on_frame(self, data):  # ingress-entry
+        self._expire()
+        self.seen[data] = True  # bounded-by: 64 (_expire evicts above)
+
+
+class WaivedCard:
+    """Same shape as MintsKeys, silenced by a line waiver."""
+
+    def __init__(self):
+        self.seen = {}
+
+    def on_frame(self, data):  # ingress-entry
+        self.seen[data] = True  # analysis: allow-taint-cardinality(test double)

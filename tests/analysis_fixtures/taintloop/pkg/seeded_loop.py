@@ -1,0 +1,69 @@
+"""Seeded taint-loop fixtures: iteration over unbounded wire
+collections before validation, a while-loop bounded only by attacker
+values — plus validated / size-gated / contracted twins."""
+
+
+class UnvalidatedLoop:
+    """Work proportional to whatever the sender packed in."""
+
+    def on_batch(self, items):  # ingress-entry
+        total = 0
+        for it in items:        # fires: RAW iteration, no validation
+            total += 1
+        return total
+
+
+class AttackerBoundedWhile:
+    """The loop bound itself comes off the wire."""
+
+    def on_frame(self, data):  # ingress-entry
+        lo = int.from_bytes(data, "big")
+        hi = lo * 3
+        while lo < hi:          # fires: no clean comparand at all
+            lo += 1
+        return lo
+
+
+class ValidatedTwin:
+    """Clean twin: the collection passes a declared validator first;
+    the surviving rows are exactly the signature-checked ones."""
+
+    def _filter_certified(self, items):
+        return [i for i in items if i]
+
+    def on_batch(self, items):  # ingress-entry
+        ok = self._filter_certified(items)
+        total = 0
+        for it in ok:
+            total += 1
+        return total
+
+
+class GatedTwin:
+    """Clean twin: an early-exit size gate caps the iteration."""
+
+    CAP = 64
+
+    def on_batch(self, items):  # ingress-entry
+        if len(items) > self.CAP:
+            return 0
+        total = 0
+        for it in items:
+            total += 1
+        return total
+
+
+class ContractLoop:
+    """The bound holds upstream; the contract declares it."""
+
+    def on_batch(self, items):  # ingress-entry
+        for it in items:  # bounded-by: len(items) <= MAX_BATCH (framer splits)
+            pass
+
+
+class WaivedLoop:
+    """Same shape as UnvalidatedLoop, silenced by a line waiver."""
+
+    def on_batch(self, items):  # ingress-entry
+        for it in items:  # analysis: allow-taint-loop(replay tool, local input)
+            pass

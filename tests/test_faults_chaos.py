@@ -377,6 +377,23 @@ def test_chaos_membership_ttl_partition_scenario():
                for e in faults)
 
 
+def test_chaos_oversized_payload_flood_caps_hold_deterministically():
+    """Tier-1 leg of the ingress-taint acceptance: oversized datagrams
+    are shed before decode, the deferral queue evicts at DEFER_MAX, the
+    ledger pins both costs on the flooder — and two same-seed runs dump
+    byte-identical journals."""
+    res = chaos.run_scenario("oversized_payload_flood", seed=0, fast=True)
+    assert res["ok"], {k: v for k, v in res.items() if k != "journals"}
+    for key in ("oversized_dropped_pre_decode", "defer_evictions_counted",
+                "defer_queues_capped", "flooder_billed_drops",
+                "flooder_billed_deferred", "flooder_top_offender",
+                "honest_client_unblamed"):
+        assert res["checks"][key], (key, res["checks"])
+    a = chaos.canonical_dump(res["journals"])
+    res2 = chaos.run_scenario("oversized_payload_flood", seed=0, fast=True)
+    assert a == chaos.canonical_dump(res2["journals"])
+
+
 @pytest.mark.slow
 def test_chaos_verifier_blackout_scenario_deterministic():
     res = chaos.run_scenario("verifier_blackout", seed=0, fast=True)

@@ -410,6 +410,78 @@ def test_baseline_budget_staleness_and_justification(tmp_path):
         run(root, paths=("pkg",), rules=("swallow",), baseline_path=bl)
 
 
+# -- ingress taint --------------------------------------------------------
+
+TAINT_RULES = ("taint-alloc", "taint-cardinality", "taint-loop",
+               "unchecked-decode")
+
+
+def test_taint_alloc_flags_each_seeded_sizer():
+    rep = _run_fixture("taintalloc", paths=("pkg",), rules=TAINT_RULES)
+    got = {(f.rule, f.line) for f in rep.unsuppressed}
+    # buffer ctor, sequence repeat, range extent, stream read
+    assert got == {("taint-alloc", 13), ("taint-alloc", 14),
+                   ("taint-alloc", 15), ("taint-alloc", 24)}, [
+        f.render() for f in rep.unsuppressed]
+    # min() clamp, early-exit gate, and the bounded-by contract twins
+    # stay quiet; the line waiver suppresses but is still recorded
+    waived = {f.line for f in rep.findings if f.waived}
+    assert waived == {63}
+
+
+def test_taint_cardinality_flags_mints_labels_and_attrs():
+    rep = _run_fixture("taintcard", paths=("pkg",), rules=TAINT_RULES)
+    by_line = {f.line: f.message for f in rep.unsuppressed}
+    assert set(by_line) == {13, 23, 34, 35}, [
+        f.render() for f in rep.unsuppressed]
+    assert "mints unbounded entries" in by_line[13]      # dict key
+    assert "self.peers" in by_line[23]                   # set add
+    assert "label cardinality" in by_line[34]            # metric name
+    assert "journal attribute 'origin'" in by_line[35]   # journal attr
+    # capped / membership-validated / contracted twins stay quiet
+    assert {f.line for f in rep.findings if f.waived} == {91}
+
+
+def test_taint_loop_flags_raw_iteration_and_while():
+    rep = _run_fixture("taintloop", paths=("pkg",), rules=TAINT_RULES)
+    got = {(f.rule, f.line) for f in rep.unsuppressed}
+    assert got == {("taint-loop", 11), ("taint-loop", 22)}, [
+        f.render() for f in rep.unsuppressed]
+    # the validator-cleaned and size-gated twins stay quiet
+    assert {f.line for f in rep.findings if f.waived} == {68}
+
+
+def test_unchecked_decode_flags_parsers():
+    rep = _run_fixture("decode", paths=("pkg",), rules=TAINT_RULES)
+    got = {(f.rule, f.line) for f in rep.unsuppressed}
+    assert got == {("unchecked-decode", 12), ("unchecked-decode", 22)}, [
+        f.render() for f in rep.unsuppressed]
+    assert {f.line for f in rep.findings if f.waived} == {47}
+
+
+def test_bounded_by_and_waiver_flip(tmp_path):
+    """The contract and the waiver are load-bearing: stripping either
+    comment makes its line fire."""
+    import shutil
+    root = str(tmp_path / "taintalloc")
+    shutil.copytree(os.path.join(FIXTURES, "taintalloc"), root)
+    p = os.path.join(root, "pkg", "seeded_alloc.py")
+    src = open(p).read()
+    with open(p, "w") as fh:
+        fh.write(src
+                 .replace("  # bounded-by: n <= MTU "
+                          "(transport caps frames)", "")
+                 .replace("  # analysis: allow-taint-alloc"
+                          "(fuzz harness input only)", ""))
+    rep = run(root, paths=("pkg",), rules=TAINT_RULES, baseline_path=None)
+    lines = {f.line for f in rep.unsuppressed}
+    assert {55, 63} <= lines, [f.render() for f in rep.unsuppressed]
+    assert not any(f.waived for f in rep.findings)
+    # the original tree counts its contracts in the report
+    orig = _run_fixture("taintalloc", paths=("pkg",), rules=TAINT_RULES)
+    assert orig.bounded_by == 1
+
+
 # -- the CI gate over the real tree --------------------------------------
 
 def test_repo_tree_has_zero_unsuppressed_findings():
@@ -441,11 +513,17 @@ def test_cli_gate_exit_codes_and_summary(tmp_path):
                                              "dtype-promotion",
                                              "lockset-race",
                                              "check-then-act", "escape",
+                                             "taint-alloc",
+                                             "taint-cardinality",
+                                             "taint-loop",
+                                             "unchecked-decode",
                                              "waiver-expired"}
     assert line["waivers_expiring_30d"] == []
     # the real tree carries explicit guarded-by contracts, and the
     # trend line counts them so a mass deletion is visible
     assert line["guarded_by_annotations"] > 0
+    # same for the ingress bounded-by contracts added with the taint pass
+    assert line["bounded_by_annotations"] > 0
 
     # seeded regression: the same CLI exits non-zero on a dirty tree
     proc = subprocess.run(
@@ -466,6 +544,10 @@ def test_cli_gate_exit_codes_and_summary(tmp_path):
     ("lockset", "pkg"),        # seeded empty-intersection write race
     ("checkact", "pkg"),       # seeded unguarded check-then-act
     ("escape", "pkg"),         # seeded self-escape from __init__
+    ("taintalloc", "pkg"),     # seeded attacker-sized allocations
+    ("taintcard", "pkg"),      # seeded unbounded key/label minting
+    ("taintloop", "pkg"),      # seeded unvalidated wire iteration
+    ("decode", "pkg"),         # seeded length-gate-free parsers
 ])
 def test_cli_exits_nonzero_on_each_seeded_concurrency_bug(tree, paths):
     proc = subprocess.run(
@@ -507,6 +589,33 @@ def test_cli_github_annotations():
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "::error" not in proc.stdout
+
+
+def test_cli_sarif_output(tmp_path):
+    out = str(tmp_path / "findings.sarif")
+    proc = subprocess.run(
+        [sys.executable, "-m", "harness.analysis", "--root",
+         os.path.join(FIXTURES, "taintalloc"), "--no-baseline",
+         "--sarif", out, "pkg"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.load(open(out))
+    assert doc["version"] == "2.1.0"
+    run_ = doc["runs"][0]
+    assert run_["tool"]["driver"]["name"] == "eges-analysis"
+    assert [r["id"] for r in run_["tool"]["driver"]["rules"]] == [
+        "taint-alloc"]
+    locs = {(res["ruleId"],
+             res["locations"][0]["physicalLocation"]["region"]["startLine"])
+            for res in run_["results"]}
+    assert locs == {("taint-alloc", 13), ("taint-alloc", 14),
+                    ("taint-alloc", 15), ("taint-alloc", 24)}
+    # a clean tree still writes a valid log, with zero results
+    proc = subprocess.run(
+        [sys.executable, "-m", "harness.analysis", "--sarif", out],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.load(open(out))["runs"][0]["results"] == []
 
 
 # -- --diff scoping -------------------------------------------------------
