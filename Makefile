@@ -3,9 +3,9 @@
 # a fresh clone with no remote), then the fast test suite.
 BASE := $(shell git rev-parse --verify -q origin/main || echo HEAD)
 
-.PHONY: check analyze race taint test anatomy-smoke ledger-smoke
+.PHONY: check analyze race taint test anatomy-smoke ledger-smoke profile
 
-check: analyze race taint test anatomy-smoke ledger-smoke
+check: analyze race taint test anatomy-smoke ledger-smoke profile
 
 analyze:
 	python -m harness.analysis --github --diff $(BASE)
@@ -36,3 +36,9 @@ anatomy-smoke:
 # rejects attributed (eges_tpu/utils/ledger.py --selftest)
 ledger-smoke:
 	JAX_PLATFORMS=cpu python -m eges_tpu.utils.ledger --selftest
+
+# continuous-profiler smoke: a ~2s self-profiled sim must produce a
+# non-empty folded artifact whose journaled reports reassemble to the
+# sampler's exact totals (eges_tpu/utils/profiler.py --selftest)
+profile:
+	JAX_PLATFORMS=cpu python -m eges_tpu.utils.profiler --selftest

@@ -103,7 +103,7 @@ from dataclasses import dataclass, fields, replace
 import numpy as np
 
 from eges_tpu.crypto.bucketing import bucket_round
-from eges_tpu.utils import ledger
+from eges_tpu.utils import ledger, profiler
 
 # sentinel distinguishing "cached None" (a signature that verifiably
 # fails recovery) from "not cached"
@@ -767,20 +767,21 @@ class VerifierScheduler:
         that coalesced down to a single row, and the post-close inline
         path.  Counts into ``verifier.host_rows`` like every other host
         fallback so the device-share metric stays honest."""
-        h, sig = key
-        from eges_tpu.crypto.verify_host import _count_host_rows
-        _count_host_rows(1)
-        from eges_tpu.crypto import native
-        if native.available():
-            from eges_tpu.crypto.keccak import keccak256
-            pubs, okb = native.ec_recover_batch(h, sig, 1)
-            return keccak256(pubs[:64])[12:] if okb[0] else None
-        from eges_tpu.crypto import secp256k1 as host
-        try:
-            return host.recover_address(h, sig)
-        # analysis: allow-swallow(invalid signature maps to a None result)
-        except Exception:
-            return None
+        with profiler.phase("verify_compute"):
+            h, sig = key
+            from eges_tpu.crypto.verify_host import _count_host_rows
+            _count_host_rows(1)
+            from eges_tpu.crypto import native
+            if native.available():
+                from eges_tpu.crypto.keccak import keccak256
+                pubs, okb = native.ec_recover_batch(h, sig, 1)
+                return keccak256(pubs[:64])[12:] if okb[0] else None
+            from eges_tpu.crypto import secp256k1 as host
+            try:
+                return host.recover_address(h, sig)
+            # analysis: allow-swallow(invalid signature maps to a None result)
+            except Exception:
+                return None
 
     def _dispatch_loop(self) -> None:
         """Wrapper keeping the strand-no-future invariant: if the flush
@@ -991,8 +992,10 @@ class VerifierScheduler:
                 nxt_p: _PendingWindow | None = None
                 if nxt is not None:
                     if pipelined:
-                        nxt_p = self._begin_batch(lane, nxt.batch,
-                                                  nxt.reason, ticket=nxt)
+                        with profiler.phase("verify_stage"):
+                            nxt_p = self._begin_batch(lane, nxt.batch,
+                                                      nxt.reason,
+                                                      ticket=nxt)
                         if (pending is not None and nxt_p.staged is not None
                                 and nxt_p.failure is None):
                             # this begin's H2D ran while the previous
@@ -1124,8 +1127,9 @@ class VerifierScheduler:
         inline composition of the split-phase halves: begin (fill +
         dispatch) then finish (collect + record + resolve) with no
         overlap — the pre-pipeline behavior."""
-        self._finish_batch(lane,
-                           self._begin_batch(lane, batch, reason, ticket))
+        with profiler.phase("verify_stage"):
+            p = self._begin_batch(lane, batch, reason, ticket)
+        self._finish_batch(lane, p)
 
     def _begin_batch(self, lane: _DeviceLane, batch, reason: str,
                      ticket: "_WindowTicket | None" = None) -> _PendingWindow:
@@ -1198,8 +1202,9 @@ class VerifierScheduler:
                         self._stats["pipeline_windows"] += 1
                         lane.stats["pipeline_windows"] += 1
                 else:
-                    addrs, ok = lane.target.recover_addresses(
-                        sigs, hashes)
+                    with profiler.phase("verify_compute"):
+                        addrs, ok = lane.target.recover_addresses(
+                            sigs, hashes)
                     p.results = [bytes(addrs[i]) if ok[i] else None
                                  for i in range(p.rows)]
                     if p.probing:
@@ -1234,7 +1239,8 @@ class VerifierScheduler:
         try:
             if p.failure is None and p.staged is not None and not p.computed:
                 try:
-                    addrs, ok = lane.target.collect_recover(p.staged)
+                    with profiler.phase("verify_collect"):
+                        addrs, ok = lane.target.collect_recover(p.staged)
                     p.results = [bytes(addrs[i]) if ok[i] else None
                                  for i in range(rows)]
                     if p.probing:

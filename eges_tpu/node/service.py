@@ -395,6 +395,12 @@ class NodeService:
     async def start(self) -> None:
         from eges_tpu.utils.debug import install_sigusr1
         install_sigusr1()  # kill -USR1 dumps stacks (pprof-dump parity)
+        # continuous sampling profiler (geth --pprof parity): always on
+        # unless EGES_PROFILE_HZ=0; serves thw_profile/thw_health and
+        # the periodic profile.folded dump below
+        from eges_tpu.utils import profiler as profiler_mod
+        if profiler_mod.DEFAULT.start():
+            self.log.geec("profiler started", hz=profiler_mod.DEFAULT.hz)
         if self._verifier_mode == "jax" and self._raw_verifier is not None:
             # warm the smallest recover graph NOW: the first jit compile
             # can take minutes on a small host, and letting it happen
@@ -490,7 +496,32 @@ class NodeService:
                         os.path.join(self.cfg.datadir, "journal.jsonl"))
                 except OSError:
                     pass
+                self._dump_profile()
             await asyncio.sleep(0.5)
+
+    def _dump_profile(self) -> None:
+        """Journal one aggregate profiler report (rides the telemetry
+        push like every other journal event) and rewrite the cumulative
+        ``profile.folded`` flamegraph artifact next to journal.jsonl.
+        A real node's journal is not a determinism-checked stream, so
+        the report lands inline — sims use a dedicated stream instead
+        (sim/cluster.py enable_profiling)."""
+        from eges_tpu.utils import profiler as profiler_mod
+        prof = profiler_mod.DEFAULT
+        if not prof.running:
+            return
+        prof.journal_snapshot(self.node.journal)
+        try:
+            from harness.profutil import artifact_header
+            header = artifact_header(source="node-service")
+        except ImportError:  # installed without the harness tree
+            header = {"source": "node-service"}
+        try:
+            prof.dump_folded(
+                os.path.join(self.cfg.datadir, "profile.folded"),
+                header=header)
+        except OSError:
+            pass  # an unwritable datadir must not kill the height loop
 
     async def run_forever(self) -> None:
         await self.start()
@@ -508,6 +539,13 @@ class NodeService:
                 os.path.join(self.cfg.datadir, "spans.jsonl"))
         except OSError:
             pass
+        # final profile report BEFORE the journal drain below (so it
+        # lands in journal.jsonl), then join the sampler — a
+        # still-walking sampler would race interpreter shutdown
+        from eges_tpu.utils import profiler as profiler_mod
+        if profiler_mod.DEFAULT.running:
+            self._dump_profile()
+        profiler_mod.DEFAULT.stop()
         try:
             self.node.journal.dump(
                 os.path.join(self.cfg.datadir, "journal.jsonl"))

@@ -50,13 +50,20 @@ RPC_METHODS = frozenset({
     "eth_subscribe", "eth_uninstallFilter", "eth_unsubscribe",
     "net_version", "thw_flight", "thw_health", "thw_journal",
     "thw_ledger", "thw_membership", "thw_metrics",
-    "thw_pendingGeecTxns", "thw_register", "thw_status", "thw_traces",
-    "web3_clientVersion",
+    "thw_pendingGeecTxns", "thw_profile", "thw_register", "thw_status",
+    "thw_traces", "web3_clientVersion",
 })
 
 
 def _hex(n: int) -> str:
     return hex(n)
+
+
+def _profiler_stats() -> dict:
+    """The process-wide sampling profiler's health block (hz, samples,
+    dropped, overhead estimate) — all zeros/False when disabled."""
+    from eges_tpu.utils import profiler as profiler_mod
+    return profiler_mod.DEFAULT.stats()
 
 
 def _block_json(b: Block, full: bool) -> dict:
@@ -392,6 +399,24 @@ class RpcServer:
             out = flights(limit=limit)
             out.reverse()
             return out
+        if method == "thw_profile":
+            # continuous-profiler report snapshots (utils/profiler.py):
+            # per-phase/per-role sample deltas + top self-time rows,
+            # NEWEST FIRST like thw_flight; params: [] | [limit] |
+            # [{"limit": n}].  Empty when the plane is disabled
+            # (EGES_PROFILE_HZ=0) or no snapshot interval elapsed yet.
+            from eges_tpu.utils import profiler as profiler_mod
+            limit = 64
+            if params:
+                p = params[0]
+                if isinstance(p, dict):
+                    limit = int(p.get("limit", limit))
+                else:
+                    limit = int(p)
+            limit = clamp_rpc_limit(limit)
+            out = profiler_mod.DEFAULT.snapshots(limit=limit)
+            out.reverse()
+            return out
         if method.startswith("debug_"):
             return self._debug(method, params)
         raise RpcError(-32601, f"method {method} not found")
@@ -448,6 +473,9 @@ class RpcServer:
                           if (engine := getattr(node, "slo_engine",
                                                 None)) is not None
                           else {}),
+            # continuous sampling profiler: rate, sample volume, loss,
+            # and the self-cost estimate the <5% overhead guard pins
+            "profiler": _profiler_stats(),
         }
 
     # -- read-only EVM execution (ref: internal/ethapi/api.go Call) -------

@@ -105,6 +105,14 @@ class SimCluster:
         self._telemetry_sink = None
         self._telemetry_interval = 0.0
         self._telemetry_cursor: dict[str, int] = {}
+        # continuous profiling plane (enable_profiling): aggregate
+        # profiler_report events ride journals() as "profiler" — a
+        # DEDICATED stream, because sampled counts are wall-clock and
+        # must never touch the determinism-checked node streams (chaos
+        # scenarios never call enable_profiling)
+        self.profiler = None
+        self.profile_journal = None
+        self._profile_interval = 0.0
         for i in range(n_nodes):
             name = f"node{i}"
             ncfg = NodeConfig(
@@ -295,6 +303,47 @@ class SimCluster:
         if self._telemetry_sampler is not None:
             self._telemetry_tick(reschedule=False)
 
+    # -- continuous profiling plane (utils/profiler.py) -----------------
+
+    def enable_profiling(self, *, hz: float | None = None,
+                         interval_s: float = 5.0, profiler=None):
+        """Start a sampling profiler for the sim process and journal
+        one aggregate ``profiler_report`` per ``interval_s`` of VIRTUAL
+        time into a dedicated "profiler" stream (like the telemetry
+        plane, the process is shared so the cluster profiles once).
+
+        The sampler itself runs on REAL time — stacks are wall-clock
+        by nature — which is exactly why the reports get their own
+        stream: chaos determinism checks byte-compare node streams and
+        never enable this plane.  ``hz=None`` resolves EGES_PROFILE_HZ
+        (default ~97); 0 leaves the plane off (no thread, empty
+        stream).  Returns the profiler.
+        """
+        from eges_tpu.utils.journal import Journal
+        from eges_tpu.utils.profiler import SamplingProfiler
+
+        self.profiler = profiler or SamplingProfiler(hz=hz)
+        self.profile_journal = Journal("profiler", clock=self.clock.now)
+        self._profile_interval = interval_s
+        self.profiler.start()
+        self.clock.call_later(interval_s, self._profile_tick)
+        return self.profiler
+
+    def _profile_tick(self, reschedule: bool = True) -> None:
+        self.profiler.journal_snapshot(self.profile_journal)
+        if reschedule:
+            self.clock.call_later(self._profile_interval,
+                                  self._profile_tick)
+
+    def stop_profiling(self) -> None:
+        """Join the sampler and journal the final report (forced, so a
+        profiled run is never invisible to the collector fold).  No-op
+        when profiling is off."""
+        if self.profiler is None:
+            return
+        self.profiler.stop()
+        self.profiler.journal_snapshot(self.profile_journal, force=True)
+
     def journals(self) -> dict[str, list[dict]]:
         """Per-node consensus event journals, keyed by sim node name —
         the live-poll source ``harness/observatory.py`` merges (the
@@ -312,4 +361,6 @@ class SimCluster:
             out["telemetry"] = self.telemetry_journal.events()
         if self.slo_journal is not None:
             out["slo"] = self.slo_journal.events()
+        if self.profile_journal is not None:
+            out["profiler"] = self.profile_journal.events()
         return out

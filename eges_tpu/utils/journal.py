@@ -102,6 +102,14 @@ EVENT_TYPES = frozenset({
     # timing-derived attrs are volatile-stripped by the chaos canonical
     # dump; the decision COUNT stays deterministic)
     "sched_adapt",
+    # continuous sampling profiler (eges_tpu/utils/profiler.py): one
+    # aggregate per-phase/per-role sample-count report per profiling
+    # interval.  Sampled stacks are wall-clock by nature, so these are
+    # journaled ONLY into the dedicated "profiler" stream created by
+    # SimCluster.enable_profiling() (or a real node's journal) — never
+    # into determinism-checked streams; chaos scenarios never enable
+    # the plane
+    "profiler_report",
 })
 
 # The registered ``_breakdown`` phase vocabulary (consensus/node.py);

@@ -100,6 +100,9 @@ METRIC_FAMILIES = frozenset({
     # eges_tpu/utils/ledger.py — ingress provenance ledger
     "ledger.evictions", "ledger.origins", "ledger.rejects",
     "ledger.rows", "ledger.snapshots",
+    # eges_tpu/utils/profiler.py — continuous sampling profiler
+    "profiler.dropped", "profiler.hz", "profiler.overhead_pct",
+    "profiler.reports", "profiler.samples",
 })
 
 # One-line help string per registered family, emitted as ``# HELP``
@@ -208,6 +211,11 @@ METRIC_HELP = {
     "ledger.rejects": "Ingress rejects booked to origins by the ledger.",
     "ledger.rows": "Verifier rows booked to origins by the ledger.",
     "ledger.snapshots": "Per-block ingress_ledger snapshots journaled.",
+    "profiler.dropped": "Profiler samples lost to walk races or stack caps.",
+    "profiler.hz": "Configured stack-sampling rate of the CPU profiler.",
+    "profiler.overhead_pct": "Profiler self-cost as % of elapsed wall time.",
+    "profiler.reports": "profiler_report events folded by the collector.",
+    "profiler.samples": "Thread stack samples captured by the CPU profiler.",
 }
 
 

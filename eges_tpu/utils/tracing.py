@@ -28,6 +28,8 @@ from contextlib import contextmanager
 from contextvars import ContextVar
 from dataclasses import dataclass
 
+from eges_tpu.utils import profiler
+
 MAGIC = b"\xd7TRC"
 _HEADER_LEN = len(MAGIC) + 16 + 8
 
@@ -135,12 +137,21 @@ class Tracer:
 
     @contextmanager
     def span(self, name: str, parent=_UNSET, **attrs):
-        """Start a span, make it current for the body, end it on exit."""
+        """Start a span, make it current for the body, end it on exit.
+
+        Span names in ``profiler.SPAN_PHASES`` also tag the calling
+        thread with the matching pipeline phase for the span body — the
+        bridge that lets the continuous sampling profiler attribute
+        CPU samples to ``pool_admit`` etc. without its own hooks on
+        every ingest path (one dict probe per span when unmapped)."""
         sp = self.start_span(name, parent, **attrs)
         token = self._current.set(sp.context())
+        ptok = profiler.tag_span(name)
         try:
             yield sp
         finally:
+            if ptok is not None:
+                profiler.pop_phase(ptok)
             self._current.reset(token)
             sp.end()
 

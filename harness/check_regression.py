@@ -16,11 +16,14 @@ attribution cost the ingress provenance ledger adds to the verify hot
 path, and the adaptive-scheduler stage's ``sched_p99_window_ms`` /
 ``sched_queue_wait_p99_ms_consensus`` / ``sched_queue_wait_p99_ms_bulk``
 — p99 window latency and per-class queue wait under the bursty
-workload) gate in the opposite direction: a RISE past the threshold
-fails, so a broken artifact store, a commit-path latency regression,
-provenance cost creeping onto the hot path, or a controller that stops
-shrinking the window under burn cannot hide behind a healthy
-steady-state throughput number.  Metrics in
+workload — and ``host_cpu_share_of_verify_pct`` — the continuous
+profiler's phase-attributed split: the share of pipeline CPU samples
+spent in host-side pool phases rather than the verify window) gate in
+the opposite direction: a RISE past the threshold fails, so a broken
+artifact store, a commit-path latency regression, provenance cost
+creeping onto the hot path, a controller that stops shrinking the
+window under burn, or ingest overhead growing relative to verify
+compute cannot hide behind a healthy steady-state throughput number.  Metrics in
 ``ZERO_TOLERANCE`` (``slo_false_positive_alerts`` — alerts fired by
 the burn-rate SLO engine on a calm, fault-free sim) gate on the
 newest value alone: it must be exactly 0, even with a single history
@@ -61,6 +64,7 @@ _DEFAULT_HISTORY = os.path.join(
 # metrics where smaller is the win (durations): the gate fails on a
 # RISE past the threshold instead of a drop
 LOWER_IS_BETTER = frozenset({"cold_start_seconds", "commit_p99_ms",
+                             "host_cpu_share_of_verify_pct",
                              "ledger_overhead_pct",
                              "sched_p99_window_ms",
                              "sched_queue_wait_p99_ms_bulk",

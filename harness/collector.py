@@ -29,6 +29,7 @@ import threading
 from eges_tpu.utils.metrics import DEFAULT as metrics
 from eges_tpu.utils.timeseries import SeriesStore, fold_payload
 from eges_tpu.utils.ledger import LedgerAssembler
+from eges_tpu.utils.profiler import ProfileAssembler
 from harness.anatomy import AnatomyAssembler
 from harness.slo import SLOEngine
 
@@ -64,6 +65,10 @@ class ClusterCollector:
         # ingress-provenance fold: same sorted barrier flush, same
         # live/replay byte-identity contract as the anatomy section
         self.ledger = LedgerAssembler()
+        # continuous-profiler fold: aggregate profiler_report events
+        # (sample counts are deterministic functions of the stream even
+        # though the sampled stacks behind them are wall-clock)
+        self.profile = ProfileAssembler()
         self._buffer: list[dict] = []  # guarded-by: _lock
         self._event_counts: dict[str, int] = {}  # guarded-by: _lock
         self.envelopes = 0  # guarded-by: _lock
@@ -110,6 +115,7 @@ class ClusterCollector:
         for ev in sorted(ready, key=_order_key):
             self.anatomy.ingest(ev)
             self.ledger.ingest(ev)
+            self.profile.ingest(ev)
             self.slo.ingest(ev)
 
     def _step(self, sample: dict, ts: float) -> None:
@@ -154,6 +160,7 @@ class ClusterCollector:
             "alerts_fired": self.slo.fired_total,
             "anatomy": self.anatomy.report(),
             "ledger": self.ledger.report(),
+            "profile": self.profile.report(),
         }
 
     def report_json(self) -> str:

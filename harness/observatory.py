@@ -41,6 +41,7 @@ if _REPO not in sys.path:
 
 from eges_tpu.utils import journal as journal_mod
 from eges_tpu.utils import ledger as ledger_mod
+from eges_tpu.utils import profiler as profiler_mod
 from eges_tpu.utils.metrics import percentile
 from harness import anatomy as anatomy_mod
 
@@ -53,7 +54,8 @@ CONSUMED = ("election_started", "election_won", "election_lost",
             "fault_heal", "fault_link", "fault_net", "fault_skew",
             "fault_trigger", "fault_breaker", "verifier_mesh_dispatch",
             "verifier_aot_load", "telemetry_sample",
-            "slo_pending", "slo_firing", "slo_resolved")
+            "slo_pending", "slo_firing", "slo_resolved",
+            "profiler_report")
 
 _SLO = ("slo_pending", "slo_firing", "slo_resolved")
 
@@ -126,6 +128,9 @@ def summarize(by_node: dict[str, list[dict]],
     # are wall-clock-derived and deliberately excluded (same rationale
     # as mesh queue wait above)
     sched_adapt: dict[str, dict] = {}
+    # continuous-profiler report counts per stream; the attribution
+    # itself is folded by profiler.assemble below
+    profiler_reports: dict[str, int] = {}
     # forward compatibility: journals written by a NEWER build may carry
     # event types this parser has never heard of — count and skip them
     # instead of letting a per-type branch trip over missing attrs
@@ -141,6 +146,9 @@ def summarize(by_node: dict[str, list[dict]],
             blk = ev.get("blk")
             if typ == "telemetry_sample":
                 telemetry_samples[name] = telemetry_samples.get(name, 0) + 1
+                continue
+            if typ == "profiler_report":
+                profiler_reports[name] = profiler_reports.get(name, 0) + 1
                 continue
             if typ in _SLO:
                 slo_alerts.append((
@@ -281,10 +289,14 @@ def summarize(by_node: dict[str, list[dict]],
         "sched_adapt": {
             name: dict(sched_adapt[name])
             for name in sorted(sched_adapt)},
+        "profiler_reports": {
+            name: profiler_reports[name]
+            for name in sorted(profiler_reports)},
         "unknown_events": {
             typ: unknown_events[typ] for typ in sorted(unknown_events)},
         "anatomy": anatomy_mod.assemble(by_node),
         "ledger": ledger_mod.assemble(by_node),
+        "profile": profiler_mod.assemble(by_node),
     }
 
 
@@ -500,6 +512,55 @@ def render_ledger(rep: dict) -> str:
     return "\n".join(out)
 
 
+# -- continuous CPU profile -----------------------------------------------
+
+def render_profile(rep: dict) -> str:
+    """Text view of a profile report (``ProfileAssembler.report`` /
+    ``profiler.assemble``): per-phase CPU attribution with shares, the
+    per-role split, and the top self-time functions — the table that
+    answers "what fraction of pool_admit CPU is decode vs LRU probe vs
+    lock wait" down to named functions."""
+    out = ["continuous profiler — %d sample(s), %d report(s), "
+           "%d node(s)" % (rep.get("samples", 0), rep.get("reports", 0),
+                           len(rep.get("nodes") or {}))]
+    samples = int(rep.get("samples", 0))
+    if samples <= 0:
+        out.append("  (no profile samples recorded — plane disabled or "
+                   "run too short)")
+        return "\n".join(out)
+    out.append("  sampling: %.0f Hz  dropped %d" % (
+        float(rep.get("hz", 0.0)), rep.get("dropped", 0)))
+    out.append("  per-phase CPU attribution (share of sampled wall "
+               "time):")
+    by_phase = rep.get("by_phase") or {}
+    for ph, n in sorted(by_phase.items(), key=lambda kv: (-kv[1], kv[0])):
+        share = 100.0 * n / samples
+        out.append("    %-16s %8d  %5.1f%%  %s" % (
+            ph, n, share, "#" * int(share / 2.0)))
+    host_share = rep.get("host_cpu_share_of_verify_pct")
+    if host_share is not None:
+        out.append("  host CPU share of verify pipeline: %.2f%%  "
+                   "(pool_* / (pool_* + verify_*))" % host_share)
+    by_role = rep.get("by_role") or {}
+    if by_role:
+        out.append("  per-role: " + "  ".join(
+            "%s %.1f%%" % (role, 100.0 * n / samples)
+            for role, n in sorted(by_role.items(),
+                                  key=lambda kv: (-kv[1], kv[0]))))
+    top = rep.get("top_self") or []
+    if top:
+        out.append("  top self-time functions:")
+        out.append("    %-52s %-14s %7s %7s" % (
+            "function", "phase", "samples", "share"))
+        for row in top:
+            out.append("    %-52s %-14s %7d %6.2f%%" % (
+                str(row.get("func", "?"))[:52],
+                str(row.get("phase", "?"))[:14],
+                int(row.get("samples", 0)),
+                float(row.get("pct", 0.0))))
+    return "\n".join(out)
+
+
 # -- collection -----------------------------------------------------------
 
 def collect_live(cluster) -> dict[str, list[dict]]:
@@ -536,15 +597,47 @@ def load_journals(indir: str) -> dict[str, list[dict]]:
 
 
 def run_sim(nodes: int = 4, blocks: int = 6, seconds: float = 600.0,
-            seed: int = 0):
+            seed: int = 0, profile_hz: float | None = None):
     """Run a virtual-time sim cluster until every node holds ``blocks``
-    blocks; returns the cluster (stopped virtual clock, journals full)."""
+    blocks; returns the cluster (stopped virtual clock, journals full).
+    The continuous profiling plane rides along by default
+    (``profile_hz=None`` resolves EGES_PROFILE_HZ, default ~97; pass
+    ``0`` to disable) so a bare ``python -m harness.observatory``
+    renders the per-phase CPU attribution table; the sampler is joined
+    before journals are collected, so the summary stays a pure
+    function of the returned events."""
     from eges_tpu.sim.cluster import SimCluster
 
     cluster = SimCluster(nodes, seed=seed, txn_per_block=5, txpool=True)
+    cluster.enable_profiling(hz=profile_hz)
     cluster.start()
+    _inject_pool_load(cluster)
     cluster.run(seconds, stop_condition=lambda: cluster.min_height() >= blocks)
+    cluster.stop_profiling()
     return cluster
+
+
+def _inject_pool_load(cluster, rows: int = 96) -> None:
+    """Feed signed transactions through node0's txpool so the profiler
+    has live pool_admit extents to sample: a bare consensus sim never
+    calls ``add_remotes``, and the consensus phases are record_span()'d
+    after the fact from virtual-clock durations (no live extent), so
+    without real ingest the per-phase table renders 100% untagged.  The
+    batch is sized exactly to ``max_batch`` so the flush — per-entry
+    sender recovery included — runs synchronously inside the
+    ``txpool.ingest`` span on this thread, where the sampler can
+    attribute it."""
+    from eges_tpu.core.types import Transaction
+
+    pool = cluster.nodes[0].node.txpool
+    if pool is None:
+        return
+    pool.max_batch = rows
+    priv = bytes([11]) * 32
+    txns = [Transaction(nonce=i, gas_limit=21_000, to=bytes(20),
+                        value=0).signed(priv, chain_id=1)
+            for i in range(rows)]
+    pool.add_remotes(txns)
 
 
 # -- rendering ------------------------------------------------------------
@@ -624,6 +717,8 @@ def render(summary: dict, net: dict | None = None) -> str:
         out.append(render_anatomy(summary["anatomy"]))
     if summary.get("ledger") is not None:
         out.append(render_ledger(summary["ledger"]))
+    if summary.get("profile") is not None:
+        out.append(render_profile(summary["profile"]))
     return "\n".join(out)
 
 

@@ -36,7 +36,6 @@ from __future__ import annotations
 
 import json
 import os
-import statistics
 import subprocess
 import sys
 import time
@@ -44,14 +43,12 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+from harness.profutil import header_line, median_ms as _median_ms
+
 CONFIGS = ("rtt", "nop", "pallasnop", "out3",
            "chain16", "chain64", "chain256", "chain64d")
 CALLS = 14          # timed calls per config (each on fresh content)
 SHAPE = (8, 128)    # one native VPU tile: transfer cost is negligible
-
-
-def _median_ms(xs: list[float]) -> float:
-    return round(statistics.median(xs) * 1e3, 2)
 
 
 def _child(name: str) -> None:
@@ -142,6 +139,7 @@ def _child(name: str) -> None:
 
 
 def main() -> None:
+    print(header_line(source="profile_floor"), flush=True)
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     results: dict[str, dict] = {}

@@ -9,7 +9,6 @@ independent full-pipeline runs.  Writes KERNEL_PROFILE2.json.
 import functools
 import json
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
@@ -22,6 +21,7 @@ from eges_tpu.ops.pallas_kernels import (
     NLIMBS, P, fp_mul_pallas, keccak_block_pallas,
     point_table_pallas, pow_mod_pallas, strauss_tab,
 )
+from harness.profutil import header_line, timeit_unique
 
 GLV_WINDOWS = 33
 B = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
@@ -33,18 +33,8 @@ def fresh_limbs(n):
     return jnp.asarray(rng.integers(0, 2**16, (n, NLIMBS), dtype=np.uint32))
 
 
-def timeit_unique(fn, gen, reps=6):
-    args0 = gen()
-    jax.block_until_ready(fn(*args0))
-    argsets = [gen() for _ in range(reps)]
-    jax.block_until_ready(argsets)
-    t0 = time.perf_counter()
-    for a in argsets:
-        jax.block_until_ready(fn(*a))
-    return (time.perf_counter() - t0) / reps
-
-
 def main():
+    print(header_line(source="profile_kernels2"), flush=True)
     print("device:", jax.devices()[0], " B =", B, flush=True)
     res = {"device": str(jax.devices()[0]), "batch": B}
 

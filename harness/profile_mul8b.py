@@ -14,7 +14,6 @@ microbenchmark the watcher runs on the next tunnel window).
 """
 
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
@@ -25,6 +24,7 @@ sys.path.insert(0, "/root/repo")
 
 from eges_tpu.ops import bigint
 from eges_tpu.ops.pallas_kernels import NLIMBS, P, _k_mul, fp_mul_pallas
+from harness.profutil import header_line, timeit
 
 
 def _read8(ref):
@@ -59,17 +59,8 @@ def fp_mul8b(a, b):
         .reshape(NLIMBS, B).T
 
 
-def timeit(fn, *args, reps=10):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps
-
-
 def main():
+    print(header_line(source="profile_mul8b"))
     rng = __import__("random").Random(3)
     B = 4096
     vals = [rng.randrange(P) for _ in range(B)]

@@ -11,7 +11,6 @@ move to (8, 128) rows.
 """
 
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
@@ -21,6 +20,7 @@ from jax.experimental import pallas as pl
 sys.path.insert(0, "/root/repo")
 
 from eges_tpu.ops.pallas_kernels import NLIMBS, _k_mul
+from harness.profutil import header_line, timeit
 
 CHAIN = 64
 rng = np.random.default_rng()
@@ -55,25 +55,16 @@ def run_1d(a, b, lane):
     )(a, b)
 
 
-def timeit(fn, *args, reps=4):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-        jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps
-
-
 def main():
     B = 4096
+    print(header_line(source="profile_mulchain"), flush=True)
     print("device:", jax.devices()[0], " B =", B, " chain =", CHAIN,
           flush=True)
     a1 = jnp.asarray(rng.integers(0, 2**16, (NLIMBS, B), dtype=np.uint32))
     b1 = jnp.asarray(rng.integers(0, 2**16, (NLIMBS, B), dtype=np.uint32))
     for lane in (256, 1024):
         t = timeit(jax.jit(lambda a, b, lane=lane: run_1d(a, b, lane)),
-                   a1, b1)
+                   a1, b1, reps=4)
         per_mul_ns = t / (CHAIN * B) * 1e9
         print(f"1-D rows lane={lane}: {t*1e3:8.3f} ms"
               f"  ({per_mul_ns:6.2f} ns/row-mul)", flush=True)
@@ -90,7 +81,8 @@ def main():
         in_specs=[pl.BlockSpec((1, NLIMBS * 8, 128),
                                lambda i: (i, 0, 0))] * 2,
         out_specs=pl.BlockSpec((1, NLIMBS * 8, 128),
-                               lambda i: (i, 0, 0)))(a, b)), a8, b8)
+                               lambda i: (i, 0, 0)))(a, b)), a8, b8,
+               reps=4)
     per_mul_ns = t / (CHAIN * B) * 1e9
     print(f"(8,128) rows:        {t*1e3:8.3f} ms"
           f"  ({per_mul_ns:6.2f} ns/row-mul)", flush=True)

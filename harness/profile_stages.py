@@ -26,6 +26,7 @@ from eges_tpu.ops import bigint, ec
 from eges_tpu.ops.pallas_kernels import (
     pow_mod_pallas, recover_prelude_pallas, u1u2_pallas, y_fix_pallas,
 )
+from harness.profutil import header_line, timeit_sets
 
 B = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
 
@@ -44,17 +45,8 @@ def _through_ladder(sigs, hashes):
     return ec.strauss_gR(u1, u2, x, y), ok
 
 
-def timeit(fn, sets):
-    out = fn(*sets[0])
-    jax.block_until_ready(out)
-    reps = len(sets) - 1
-    t0 = time.perf_counter()
-    for i in range(1, len(sets)):
-        jax.block_until_ready(fn(*sets[i]))
-    return (time.perf_counter() - t0) / reps
-
-
 def main():
+    print(header_line(source="profile_stages"), flush=True)
     print("device:", jax.devices()[0], flush=True)
     sigs, hashes, _, _ = example_batch(B, invalid_every=17)
 
@@ -74,7 +66,7 @@ def main():
         jf = jax.jit(fn)
         jax.block_until_ready(jf(*sets[0]))
         comp = time.perf_counter() - t0
-        t = timeit(jf, sets)
+        t = timeit_sets(jf, sets)
         print(f"{name:16s} compile {comp:6.1f}s  per-call {t*1e3:8.2f} ms"
               f"  (+{(t-prev)*1e3:7.2f} ms)", flush=True)
         prev = t

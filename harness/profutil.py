@@ -1,0 +1,134 @@
+"""Shared timing + provenance boilerplate for the profiling harnesses.
+
+Before this module, every ``harness/profile_*.py`` script carried its
+own ``timeit`` variant and its own ad-hoc ``print("device:", ...)``
+stamp, and none of them recorded platform/revision provenance — so two
+artifacts from different checkouts were indistinguishable.  The
+continuous profiling plane (``eges_tpu/utils/profiler.py``) and the
+one-shot scripts now emit the SAME artifact header::
+
+    # eges-profile-v1 {"git_rev": ..., "platform_detail": ..., ...}
+
+The three timing protocols the scripts converged on (see the r4
+postmortem in profile_floor.py's docstring for why they differ) live
+here once:
+
+* :func:`timeit` — steady-state per-call seconds over repeated
+  identical operands, blocking every rep (an async backend cannot
+  return early);
+* :func:`timeit_sets` — pre-built never-repeated argument sets, set 0
+  as warmup (profile_stages protocol);
+* :func:`timeit_unique` — a generator yields fresh operands per rep
+  (profile_kernels2 protocol: the tunnel memoizes repeat content).
+
+Stdlib-only at import time; ``jax`` is imported lazily inside the
+timing helpers so header/provenance consumers (the node service's
+periodic ``profile.folded`` dump) stay JAX-free.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import statistics
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def git_rev() -> str | None:
+    """Current commit hash straight from ``.git`` (no subprocess — the
+    harnesses stay import-light and a missing git binary must not fail
+    a measurement)."""
+    try:
+        head = os.path.join(_REPO, ".git", "HEAD")
+        with open(head, "r", encoding="utf-8") as fh:
+            ref = fh.read().strip()
+        if ref.startswith("ref: "):
+            with open(os.path.join(_REPO, ".git", *ref[5:].split("/")),
+                      "r", encoding="utf-8") as fh:
+                return fh.read().strip()[:40] or None
+        return ref[:40] or None
+    except OSError:
+        return None
+
+
+def _mod_version(name: str) -> str | None:
+    """Version of an ALREADY-IMPORTED module — a provenance helper must
+    never be the thing that drags jax into a process."""
+    mod = sys.modules.get(name)
+    if mod is None:
+        return None
+    v = getattr(mod, "__version__", None)
+    return str(v) if v is not None else None
+
+
+def artifact_header(**extra) -> dict:
+    """The shared provenance stamp: platform detail, git revision,
+    python + jax/jaxlib versions (when loaded), plus caller extras."""
+    hdr = {
+        "platform_detail": "%s-%s" % (sys.platform, platform.machine()),
+        "python": platform.python_version(),
+        "git_rev": git_rev(),
+        "jax": _mod_version("jax"),
+        "jaxlib": _mod_version("jaxlib"),
+    }
+    hdr.update(extra)
+    return hdr
+
+
+def header_line(**extra) -> str:
+    """The header as the one-line ``# eges-profile-v1`` comment every
+    profiling artifact leads with."""
+    return ("# eges-profile-v1 "
+            + json.dumps(artifact_header(**extra), sort_keys=True))
+
+
+def median_ms(xs: list[float]) -> float:
+    return round(statistics.median(xs) * 1e3, 2)
+
+
+def timeit(fn, *args, reps: int = 10) -> float:
+    """Steady-state per-call seconds: one warmup call, then ``reps``
+    timed calls over the same operands, each blocked to completion."""
+    import jax
+
+    jax.block_until_ready(fn(*args))
+    # analysis: allow-determinism(microbenchmark timing; harness-only, never journaled)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        jax.block_until_ready(fn(*args))
+    # analysis: allow-determinism(microbenchmark timing; harness-only, never journaled)
+    return (time.perf_counter() - t0) / reps
+
+
+def timeit_sets(fn, sets) -> float:
+    """Per-call seconds over pre-built argument sets; ``sets[0]`` is
+    the warmup, the rest are timed (never-repeated-content protocol)."""
+    import jax
+
+    jax.block_until_ready(fn(*sets[0]))
+    # analysis: allow-determinism(microbenchmark timing; harness-only, never journaled)
+    t0 = time.perf_counter()
+    for i in range(1, len(sets)):
+        jax.block_until_ready(fn(*sets[i]))
+    # analysis: allow-determinism(microbenchmark timing; harness-only, never journaled)
+    return (time.perf_counter() - t0) / (len(sets) - 1)
+
+
+def timeit_unique(fn, gen, reps: int = 6) -> float:
+    """Per-call seconds with fresh operands per rep from ``gen()`` —
+    the protocol for backends that memoize repeat content."""
+    import jax
+
+    jax.block_until_ready(fn(*gen()))
+    argsets = [gen() for _ in range(reps)]
+    jax.block_until_ready(argsets)
+    # analysis: allow-determinism(microbenchmark timing; harness-only, never journaled)
+    t0 = time.perf_counter()
+    for a in argsets:
+        jax.block_until_ready(fn(*a))
+    # analysis: allow-determinism(microbenchmark timing; harness-only, never journaled)
+    return (time.perf_counter() - t0) / reps

@@ -98,12 +98,15 @@ def _run_cluster(n=4, blocks=6):
 def test_observatory_replay_summary_identical_to_live(tmp_path):
     cluster = _run_cluster()
     by_node = observatory.collect_live(cluster)
-    assert sorted(by_node) == ["node0", "node1", "node2", "node3"]
+    # run_sim profiles by default: the continuous profiler's dedicated
+    # stream rides collect_live as a pseudo-node, like chaos' "faults"
+    assert sorted(by_node) == ["node0", "node1", "node2", "node3",
+                               "profiler"]
     live = observatory.summarize(by_node)
 
     outdir = str(tmp_path / "dumps")
     paths = observatory.dump_journals(by_node, outdir)
-    assert len(paths) == 4
+    assert len(paths) == 5
     replayed = observatory.summarize(observatory.load_journals(outdir))
 
     assert replayed == live  # the acceptance criterion, bit-for-bit
@@ -114,7 +117,8 @@ def test_observatory_replay_summary_identical_to_live(tmp_path):
     assert live["election"]["p50_ms"] is not None
     assert live["ack_quorum"]["count"] >= 6
     assert live["election_timeline"], "no election timeline entries"
-    assert set(live["commit_lag"]) == set(by_node)
+    # the profiler stream commits no blocks, so it has no lag entry
+    assert set(live["commit_lag"]) == set(by_node) - {"profiler"}
     for lag in live["commit_lag"].values():
         assert lag["mean_s"] >= 0.0
     # render() must handle a real summary without raising
@@ -126,7 +130,7 @@ def test_observatory_replay_summary_identical_to_live(tmp_path):
 HEALTH_KEYS = {"height", "headHash", "lag", "role", "electionsWon",
                "electionsLost", "txpoolPending", "deferredDepth",
                "members", "minTtl", "lastCommitAge", "stalled", "journal",
-               "sloAlerts"}
+               "sloAlerts", "profiler"}
 
 
 def test_thw_health_complete_on_every_node_and_over_http():

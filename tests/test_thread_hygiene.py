@@ -34,11 +34,16 @@ THREADS = 8
 
 def test_worker_threads_are_daemons_and_join_on_close():
     from harness.collector import ClusterCollector, CollectorServer
+    from eges_tpu.utils.profiler import SamplingProfiler
 
     base = set(threading.enumerate())
     sched = scheduler_for(NativeBatchVerifier(), window_ms=2.0)
     col = ClusterCollector()
     srv = CollectorServer(col)
+    # the continuous profiler's sampler walks every other thread's
+    # frames: it must obey the same daemon + join-on-stop discipline
+    prof = SamplingProfiler(hz=97.0)
+    assert prof.start()
     try:
         # wake the scheduler's dispatch/lane workers with one real row
         msg = (1).to_bytes(4, "big") * 8
@@ -61,9 +66,10 @@ def test_worker_threads_are_daemons_and_join_on_close():
     finally:
         sched.close()
         srv.close()
+        prof.stop()
 
-    # close() JOINS the workers — daemonhood alone is not enough, a
-    # still-running drain loop after close would race teardown
+    # close()/stop() JOINS the workers — daemonhood alone is not
+    # enough, a still-running drain loop after close would race teardown
     deadline = time.monotonic() + 10.0
     while time.monotonic() < deadline:
         leftover = [t for t in threading.enumerate()
