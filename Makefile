@@ -3,9 +3,10 @@
 # a fresh clone with no remote), then the fast test suite.
 BASE := $(shell git rev-parse --verify -q origin/main || echo HEAD)
 
-.PHONY: check analyze race taint test anatomy-smoke ledger-smoke profile
+.PHONY: check analyze race taint test anatomy-smoke ledger-smoke profile \
+	devstats
 
-check: analyze race taint test anatomy-smoke ledger-smoke profile
+check: analyze race taint test anatomy-smoke ledger-smoke profile devstats
 
 analyze:
 	python -m harness.analysis --github --diff $(BASE)
@@ -42,3 +43,10 @@ ledger-smoke:
 # sampler's exact totals (eges_tpu/utils/profiler.py --selftest)
 profile:
 	JAX_PLATFORMS=cpu python -m eges_tpu.utils.profiler --selftest
+
+# device-efficiency smoke: roofline parsing/interpolation fixtures,
+# then a mesh sim whose journaled device_efficiency stream must
+# reassemble to a consistent goodput decomposition
+# (eges_tpu/utils/devstats.py --selftest)
+devstats:
+	JAX_PLATFORMS=cpu python -m eges_tpu.utils.devstats --selftest

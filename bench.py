@@ -871,6 +871,88 @@ def _profile_stage() -> dict | None:
         return None
 
 
+def _devstats_stage() -> dict | None:
+    """Device-efficiency stage: a fixed burst schedule driven straight
+    through the mesh scheduler, reduced to the goodput ratio (useful
+    rows / padded device rows) the devstats ledger accounts.  The
+    schedule is chosen so the ratio is EXACT under any legal window
+    split: eight bursts of 64 rows (power-of-two, any binary split sums
+    to the same padded total) plus one 40-row tail that always rounds
+    to a 64-row padded footprint — 552 useful rows on 576 padded rows,
+    0.9583.  Gated by ``harness/check_regression.py``: a scheduler
+    change that starts over-padding (bucket inflation, premature
+    flushes, lost coalescing) moves the ratio and fails the round even
+    when raw verifies/s holds.
+
+    Runs in the PARENT like ``_profile_stage``: the native mesh
+    verifier imports no JAX.  Hedging is disabled (a hedge loser would
+    add wall-clock-dependent waste rows) and the adaptive controller is
+    off by default, so the recorded windows are a pure function of the
+    submit sizes.  ``device_mem_peak_bytes`` rides along: the HBM peak
+    watermark from ``sample_memory()``, 0 on hosts without a device
+    backend (lower-is-better gate arms the first time a real chip
+    reports)."""
+    try:
+        from eges_tpu.core.types import Transaction
+        from eges_tpu.crypto.scheduler import (SchedulerConfig,
+                                               VerifierScheduler)
+        from eges_tpu.crypto.verify_host import NativeMeshVerifier
+        from eges_tpu.utils import devstats
+
+        bursts, rows, tail = 8, 64, 40
+        priv = bytes([11]) * 32
+        signed = [Transaction(nonce=i, gas_price=1, gas_limit=21000,
+                              to=bytes(20), value=0).signed(priv)
+                  for i in range(bursts * rows + tail)]
+        parts = [t.signature_parts() for t in signed]
+        if any(p is None for p in parts):
+            return None
+        entries = [(h, sig) for sig, h in parts]
+
+        devstats.DEFAULT.rebase()
+        sched = VerifierScheduler(
+            NativeMeshVerifier(2),
+            config=SchedulerConfig(window_ms=5.0, max_batch=rows,
+                                   hedge=False))
+        try:
+            for b in range(bursts):
+                rec = sched.recover_signers(
+                    entries[b * rows:(b + 1) * rows])
+                if any(r is None for r in rec):
+                    return None
+            rec = sched.recover_signers(entries[bursts * rows:])
+            if any(r is None for r in rec):
+                return None
+        finally:
+            sched.close()
+
+        mem = devstats.sample_memory(devstats.DEFAULT)
+        snap = devstats.DEFAULT.snap()
+        total_rows = total_bucket = windows = peak = 0
+        for d in snap["devices"].values():
+            total_rows += d["rows"]
+            total_bucket += d["bucket_rows"]
+            windows += d["windows"]
+            m = d.get("mem")
+            if m:
+                peak = max(peak, int(m.get("peak_bytes", 0)))
+        if not total_bucket:
+            return None
+        return {
+            "goodput_ratio": round(total_rows / total_bucket, 4),
+            "rows": total_rows,
+            "bucket_rows": total_bucket,
+            "pad_rows": total_bucket - total_rows,
+            "windows": windows,
+            "devices": len(snap["devices"]),
+            "device_mem_peak_bytes": peak,
+            "mem_devices": len(mem) if isinstance(mem, dict) else 0,
+        }
+    # analysis: allow-swallow(optional bench stage; a failed leg reports null)
+    except Exception:
+        return None
+
+
 def _platform_detail(probe_state: dict, best: dict) -> dict:
     """Requested-vs-actual backend stamp for every history line: the
     bench always WANTS the accelerator, so when a line was measured on
@@ -976,6 +1058,7 @@ def main() -> None:
     ledger_bench = _ledger_stage()
     adaptive_bench = _adaptive_stage()
     profile_bench = _profile_stage()
+    devstats_bench = _devstats_stage()
 
     best: dict = {}      # kind -> best stage result for that backend
     # kind -> {batch(str): {p50_ms, p99_ms}} — every stage's tails, not
@@ -1284,6 +1367,26 @@ def main() -> None:
         line.update(_provenance())
         print(json.dumps(line), flush=True)
         _append_history(line)
+    if devstats_bench:
+        # parent-side stage: the fixed burst schedule through the mesh
+        # scheduler — goodput_ratio gated on any drop (over-padding
+        # regression) and device_mem_peak_bytes gated lower-is-better
+        # (HBM watermark creep on real backends; 0 on host-only runs)
+        for metric, unit in (("goodput_ratio", "ratio"),
+                             ("device_mem_peak_bytes", "bytes")):
+            line = {"metric": metric, "value": devstats_bench[metric],
+                    "unit": unit,
+                    "rows": devstats_bench["rows"],
+                    "bucket_rows": devstats_bench["bucket_rows"],
+                    "pad_rows": devstats_bench["pad_rows"],
+                    "windows": devstats_bench["windows"],
+                    "devices": devstats_bench["devices"],
+                    "mem_devices": devstats_bench["mem_devices"],
+                    "platform_detail":
+                        _platform_detail(probe_state, best)}
+            line.update(_provenance())
+            print(json.dumps(line), flush=True)
+            _append_history(line)
 
     # trend the static-analysis counts alongside the perf series: one
     # findings_by_rule/unsuppressed_by_rule line per bench round, the

@@ -369,6 +369,10 @@ class VerifierScheduler:
         # cheap-reject cost math over them) under-count a warm-cache
         # flood as free — drained into flight["cache_rows"]
         self._cache_rows_pending = 0  # guarded-by: _lock
+        # in-flight-deduped rows since the last recorded window — the
+        # same drain discipline as cache rows, feeding the goodput
+        # ledger's waste decomposition (utils/devstats.py)
+        self._dedup_rows_pending = 0  # guarded-by: _lock
         self._kick = False  # guarded-by: _lock
         self._closed = False
         # set once the dispatch loop exits
@@ -497,6 +501,7 @@ class VerifierScheduler:
                     # it if this caller is consensus-critical)
                     row[0].append(fut)
                     self._stats["coalesced_rows"] += 1
+                    self._dedup_rows_pending += 1
                     if klass == "consensus":
                         row[2] = "consensus"
                 else:
@@ -1283,6 +1288,16 @@ class VerifierScheduler:
                     elif not won:
                         from eges_tpu.utils.metrics import DEFAULT as metrics
                         metrics.counter("verifier.hedge_wasted").inc()
+                        # a loser window burned a full padded bucket on
+                        # its lane for nothing — bill the waste to the
+                        # device-efficiency ledger at the padded size
+                        from eges_tpu.utils import devstats
+                        pad = getattr(lane.target, "_pad", None) \
+                            or getattr(self._verifier, "_pad", None) \
+                            or bucket_round
+                        devstats.DEFAULT.observe_hedge_waste(
+                            lane.index, p.rows,
+                            pad(p.rows) if p.rows > 1 else 1)
                 if won:
                     self._record_window(lane, p, mesh)
         except BaseException as exc:
@@ -1370,6 +1385,8 @@ class VerifierScheduler:
                     origin_rows[rec] = origin_rows.get(rec, 0) + 1
             cache_rows = self._cache_rows_pending
             self._cache_rows_pending = 0
+            dedup_rows = self._dedup_rows_pending
+            self._dedup_rows_pending = 0
             for k, r in zip(keys, p.results):
                 self._cache_put(k, r)
             self._stats["batches"] += 1
@@ -1389,6 +1406,9 @@ class VerifierScheduler:
             # volume that never forms a window of its own (the
             # under-count bug this field closes)
             flight["cache_rows"] = cache_rows
+            # in-flight-deduped rows merged into this window's rows —
+            # the free-work companion the goodput decomposition renders
+            flight["dedup_rows"] = dedup_rows
             flight["window"] = self._flight_seq
             self._flight_seq += 1
             if (self._flights.maxlen is not None
@@ -1443,6 +1463,17 @@ class VerifierScheduler:
                 metrics.counter(
                     f"verifier.mesh_straggler_diverts"
                     f";device={lane.index}").inc()
+        # device-efficiency ledger (utils/devstats.py): deterministic
+        # count deltas only — the goodput numerator/denominator this
+        # window contributed, journaled on the next devstats tick.
+        # Host-served windows (singleton or breaker/straggler divert)
+        # padded no device bucket, so they land in the rescue column.
+        from eges_tpu.utils import devstats
+        devstats.DEFAULT.observe_window(
+            lane.index, rows, bucket,
+            cache_rows=cache_rows, dedup_rows=dedup_rows,
+            diverted=bool(p.diverted or rows == 1),
+            hedged=flight["hedged"])
         tracing.DEFAULT.record_span(
             "verifier.sched_dispatch", dt, rows=rows, bucket=bucket,
             reason=p.reason, occupancy=round(rows / bucket, 4),

@@ -499,7 +499,21 @@ class BatchVerifier:
         (SURVEY §5 metrics; VERDICT item 7): aggregate + per-bucket
         device time, pad waste, compile-cache behavior, and — under the
         debug-timing flag only, since measuring them forces the
-        H2D-vs-compute sync — the transfer halves."""
+        H2D-vs-compute sync — the transfer halves.
+
+        The split-phase pipeline (``stage_recover``/``commit_recover``/
+        ``collect_recover``, plus ``_DeviceTarget``'s copies) funnels
+        through this same method from ``collect_recover``, so the
+        overlapped path records every family the legacy ``verify()``
+        path does — ``pad_waste``, ``padded_rows``, per-bucket
+        ``device_seconds`` — and the goodput math over them never
+        undercounts by path.  The one DELIBERATE divergence is timing
+        semantics: in the pipelined path ``t0 -> t1`` spans
+        stage -> dispatch without a fence (fencing there would destroy
+        the overlap the pipeline exists for), so the debug-timing
+        ``h2d_seconds``/``d2h_seconds`` split is only meaningful on the
+        legacy path and the pipelined path leaves ``debug_timing``
+        untouched rather than emitting a misleading split."""
         from eges_tpu.utils import tracing
         from eges_tpu.utils.metrics import DEFAULT as metrics
 

@@ -141,6 +141,11 @@ class _TelemetryPusher:
     def tick(self) -> None:  # thread-entry:service-loop
         """Sample, journal the sample, evaluate the local SLO engine,
         and push the journal tail as one envelope."""
+        # refresh HBM watermark gauges first (utils/devstats.py) so the
+        # registry sample below carries them; absent on backends
+        # without memory_stats()
+        from eges_tpu.utils import devstats as devstats_mod
+        devstats_mod.sample_memory()
         payload = self.sampler.sample()
         sample = self.node.journal.record(
             "telemetry_sample", step=self.sampler.steps, metrics=payload)
@@ -401,6 +406,13 @@ class NodeService:
         from eges_tpu.utils import profiler as profiler_mod
         if profiler_mod.DEFAULT.start():
             self.log.geec("profiler started", hz=profiler_mod.DEFAULT.hz)
+        # device-efficiency plane (utils/devstats.py): baseline the
+        # process-wide goodput ledger at service start and point the
+        # on-demand trace armer at the datadir, so thw_device_trace
+        # captures land as device_trace.NNN next to profile.folded
+        from eges_tpu.utils import devstats as devstats_mod
+        devstats_mod.DEFAULT.rebase()
+        devstats_mod.DEFAULT.trace.dir = self.cfg.datadir
         if self._verifier_mode == "jax" and self._raw_verifier is not None:
             # warm the smallest recover graph NOW: the first jit compile
             # can take minutes on a small host, and letting it happen
@@ -506,7 +518,13 @@ class NodeService:
         A real node's journal is not a determinism-checked stream, so
         the report lands inline — sims use a dedicated stream instead
         (sim/cluster.py enable_profiling)."""
+        from eges_tpu.utils import devstats as devstats_mod
         from eges_tpu.utils import profiler as profiler_mod
+        # one device-efficiency delta per dump interval, same inline
+        # placement as the profiler report (and independent of whether
+        # the sampler is running — the goodput ledger has no thread)
+        devstats_mod.sample_memory()
+        devstats_mod.DEFAULT.journal_snapshot(self.node.journal)
         prof = profiler_mod.DEFAULT
         if not prof.running:
             return
@@ -543,8 +561,7 @@ class NodeService:
         # lands in journal.jsonl), then join the sampler — a
         # still-walking sampler would race interpreter shutdown
         from eges_tpu.utils import profiler as profiler_mod
-        if profiler_mod.DEFAULT.running:
-            self._dump_profile()
+        self._dump_profile()
         profiler_mod.DEFAULT.stop()
         try:
             self.node.journal.dump(

@@ -48,10 +48,10 @@ RPC_METHODS = frozenset({
     "eth_getTransactionCount", "eth_getTransactionReceipt",
     "eth_newBlockFilter", "eth_newFilter", "eth_sendRawTransaction",
     "eth_subscribe", "eth_uninstallFilter", "eth_unsubscribe",
-    "net_version", "thw_flight", "thw_health", "thw_journal",
-    "thw_ledger", "thw_membership", "thw_metrics",
-    "thw_pendingGeecTxns", "thw_profile", "thw_register", "thw_status",
-    "thw_traces", "web3_clientVersion",
+    "net_version", "thw_device_trace", "thw_devices", "thw_flight",
+    "thw_health", "thw_journal", "thw_ledger", "thw_membership",
+    "thw_metrics", "thw_pendingGeecTxns", "thw_profile",
+    "thw_register", "thw_status", "thw_traces", "web3_clientVersion",
 })
 
 
@@ -64,6 +64,14 @@ def _profiler_stats() -> dict:
     dropped, overhead estimate) — all zeros/False when disabled."""
     from eges_tpu.utils import profiler as profiler_mod
     return profiler_mod.DEFAULT.stats()
+
+
+def _devstats_stats() -> dict:
+    """The device-efficiency ledger's health block (window/row volume,
+    cumulative goodput, trace armer state) — zeros until a scheduler
+    window has been recorded."""
+    from eges_tpu.utils import devstats as devstats_mod
+    return devstats_mod.DEFAULT.stats()
 
 
 def _block_json(b: Block, full: bool) -> dict:
@@ -417,6 +425,47 @@ class RpcServer:
             out = profiler_mod.DEFAULT.snapshots(limit=limit)
             out.reverse()
             return out
+        if method == "thw_devices":
+            # device-efficiency delta snapshots (utils/devstats.py):
+            # per-device window/row/waste counts with per-bucket split,
+            # NEWEST FIRST like thw_profile; params: [] | [limit] |
+            # [{"limit": n}].  Empty until a scheduler window has been
+            # recorded and a snapshot taken.
+            from eges_tpu.utils import devstats as devstats_mod
+            limit = 64
+            if params:
+                p = params[0]
+                if isinstance(p, dict):
+                    limit = int(p.get("limit", limit))
+                else:
+                    limit = int(p)
+            limit = clamp_rpc_limit(limit)
+            out = devstats_mod.DEFAULT.snapshots(limit=limit)
+            out.reverse()
+            return out
+        if method == "thw_device_trace":
+            # arm an on-demand jax.profiler device trace spanning the
+            # next N recorded windows (utils/devstats.py); the capture
+            # lands as a versioned device_trace.NNN artifact next to
+            # profile.folded.  params: [] | [windows] |
+            # [{"windows": n, "dir": path, "disarm": true}]; the window
+            # count clamps to [1, 4096] like every list limit.  Safe
+            # without jax — the armer reports an error state instead of
+            # tracing.
+            from eges_tpu.utils import devstats as devstats_mod
+            armer = devstats_mod.DEFAULT.trace
+            windows, outdir = 4, None
+            if params:
+                p = params[0]
+                if isinstance(p, dict):
+                    if p.get("disarm"):
+                        return armer.disarm()
+                    windows = int(p.get("windows", windows))
+                    outdir = p.get("dir")
+                else:
+                    windows = int(p)
+            windows = clamp_rpc_limit(windows)
+            return armer.arm(windows, outdir=outdir)
         if method.startswith("debug_"):
             return self._debug(method, params)
         raise RpcError(-32601, f"method {method} not found")
@@ -476,6 +525,9 @@ class RpcServer:
             # continuous sampling profiler: rate, sample volume, loss,
             # and the self-cost estimate the <5% overhead guard pins
             "profiler": _profiler_stats(),
+            # device-efficiency ledger: window/row volume, cumulative
+            # goodput ratio, and the on-demand trace armer state
+            "devstats": _devstats_stats(),
         }
 
     # -- read-only EVM execution (ref: internal/ethapi/api.go Call) -------

@@ -113,6 +113,12 @@ class SimCluster:
         self.profiler = None
         self.profile_journal = None
         self._profile_interval = 0.0
+        # device-efficiency plane (enable_devstats): per-device
+        # device_efficiency count deltas ride journals() as "devstats"
+        # — a dedicated stream like "profiler", never enabled by the
+        # chaos determinism scenarios
+        self.devstats_journal = None
+        self._devstats_interval = 0.0
         for i in range(n_nodes):
             name = f"node{i}"
             ncfg = NodeConfig(
@@ -276,7 +282,13 @@ class SimCluster:
         return self.telemetry_journal
 
     def _telemetry_tick(self, reschedule: bool = True) -> None:
+        from eges_tpu.utils import devstats as devstats_mod
+
         now = self.clock.now()
+        # refresh HBM watermark gauges (no-op on host-only runs) so the
+        # registry sample below carries them — the sim analogue of the
+        # real node's pre-sample hook in node/service.py
+        devstats_mod.sample_memory()
         payload = self._telemetry_sampler.sample()
         self.telemetry_journal.record(
             "telemetry_sample", step=self._telemetry_sampler.steps,
@@ -344,6 +356,44 @@ class SimCluster:
         self.profiler.stop()
         self.profiler.journal_snapshot(self.profile_journal, force=True)
 
+    # -- device-efficiency plane (utils/devstats.py) ---------------------
+
+    def enable_devstats(self, *, interval_s: float = 5.0):
+        """Journal per-device ``device_efficiency`` count deltas every
+        ``interval_s`` of VIRTUAL time into a dedicated "devstats"
+        stream (the goodput ledger is process-wide like the metrics
+        registry, so the cluster journals once).
+
+        The ledger is rebased first so windows recorded by earlier
+        runs in the same process never leak into the first tick.  Pair
+        with ``mesh_devices=N`` at construction to give the scheduler
+        real per-device lanes to account.  Returns the journal."""
+        from eges_tpu.utils import devstats as devstats_mod
+        from eges_tpu.utils.journal import Journal
+
+        self.devstats_journal = Journal("devstats", clock=self.clock.now)
+        self._devstats_interval = interval_s
+        devstats_mod.DEFAULT.rebase()
+        self.clock.call_later(interval_s, self._devstats_tick)
+        return self.devstats_journal
+
+    def _devstats_tick(self, reschedule: bool = True) -> None:
+        from eges_tpu.utils import devstats as devstats_mod
+
+        devstats_mod.sample_memory()
+        devstats_mod.DEFAULT.journal_snapshot(self.devstats_journal)
+        if reschedule:
+            self.clock.call_later(self._devstats_interval,
+                                  self._devstats_tick)
+
+    def stop_devstats(self) -> None:
+        """Journal the final delta outside the periodic schedule so
+        windows recorded after the last tick still reach the collector
+        fold.  No-op when the plane is off."""
+        if self.devstats_journal is None:
+            return
+        self._devstats_tick(reschedule=False)
+
     def journals(self) -> dict[str, list[dict]]:
         """Per-node consensus event journals, keyed by sim node name —
         the live-poll source ``harness/observatory.py`` merges (the
@@ -363,4 +413,6 @@ class SimCluster:
             out["slo"] = self.slo_journal.events()
         if self.profile_journal is not None:
             out["profiler"] = self.profile_journal.events()
+        if self.devstats_journal is not None:
+            out["devstats"] = self.devstats_journal.events()
         return out

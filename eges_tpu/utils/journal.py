@@ -110,6 +110,15 @@ EVENT_TYPES = frozenset({
     # into determinism-checked streams; chaos scenarios never enable
     # the plane
     "profiler_report",
+    # device-efficiency observatory (eges_tpu/utils/devstats.py): one
+    # per-device delta of deterministic window/row/waste counts per
+    # devstats tick — goodput numerators/denominators plus the
+    # per-bucket split.  Journaled into the dedicated "devstats" stream
+    # created by SimCluster.enable_devstats() (or a real node's
+    # journal); chaos determinism scenarios never enable the plane.
+    # The optional "mem" block carries point-in-time HBM watermarks
+    # and is absent on backends without memory_stats().
+    "device_efficiency",
 })
 
 # The registered ``_breakdown`` phase vocabulary (consensus/node.py);

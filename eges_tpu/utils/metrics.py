@@ -103,6 +103,11 @@ METRIC_FAMILIES = frozenset({
     # eges_tpu/utils/profiler.py — continuous sampling profiler
     "profiler.dropped", "profiler.hz", "profiler.overhead_pct",
     "profiler.reports", "profiler.samples",
+    # eges_tpu/utils/devstats.py — device-efficiency observatory; the
+    # goodput and HBM-watermark families carry a ``;device=N`` label
+    "devstats.goodput_ratio", "devstats.mem_bytes_in_use",
+    "devstats.mem_limit_bytes", "devstats.mem_peak_bytes",
+    "devstats.reports", "devstats.trace_captures",
 })
 
 # One-line help string per registered family, emitted as ``# HELP``
@@ -216,6 +221,13 @@ METRIC_HELP = {
     "profiler.overhead_pct": "Profiler self-cost as % of elapsed wall time.",
     "profiler.reports": "profiler_report events folded by the collector.",
     "profiler.samples": "Thread stack samples captured by the CPU profiler.",
+    "devstats.goodput_ratio":
+        "Useful rows over padded device rows per lane (last tick).",
+    "devstats.mem_bytes_in_use": "Device HBM bytes currently in use.",
+    "devstats.mem_limit_bytes": "Device HBM allocation limit in bytes.",
+    "devstats.mem_peak_bytes": "Device HBM peak bytes-in-use watermark.",
+    "devstats.reports": "device_efficiency events folded by the collector.",
+    "devstats.trace_captures": "On-demand device trace captures completed.",
 }
 
 

@@ -18,12 +18,19 @@ path, and the adaptive-scheduler stage's ``sched_p99_window_ms`` /
 — p99 window latency and per-class queue wait under the bursty
 workload — and ``host_cpu_share_of_verify_pct`` — the continuous
 profiler's phase-attributed split: the share of pipeline CPU samples
-spent in host-side pool phases rather than the verify window) gate in
+spent in host-side pool phases rather than the verify window — and
+``device_mem_peak_bytes`` — the devstats stage's HBM peak watermark,
+0 on host-only runs so the gate arms the first time a real backend
+reports) gate in
 the opposite direction: a RISE past the threshold fails, so a broken
 artifact store, a commit-path latency regression, provenance cost
 creeping onto the hot path, a controller that stops shrinking the
-window under burn, or ingest overhead growing relative to verify
-compute cannot hide behind a healthy steady-state throughput number.  Metrics in
+window under burn, ingest overhead growing relative to verify
+compute, or a growing device-memory footprint cannot hide behind a
+healthy steady-state throughput number.  The devstats stage's
+``goodput_ratio`` (useful rows / padded device rows over a fixed burst
+schedule — exactly 552/576 unless the scheduler starts over-padding)
+gates in the default direction: any drop past the threshold fails.  Metrics in
 ``ZERO_TOLERANCE`` (``slo_false_positive_alerts`` — alerts fired by
 the burn-rate SLO engine on a calm, fault-free sim) gate on the
 newest value alone: it must be exactly 0, even with a single history
@@ -64,6 +71,7 @@ _DEFAULT_HISTORY = os.path.join(
 # metrics where smaller is the win (durations): the gate fails on a
 # RISE past the threshold instead of a drop
 LOWER_IS_BETTER = frozenset({"cold_start_seconds", "commit_p99_ms",
+                             "device_mem_peak_bytes",
                              "host_cpu_share_of_verify_pct",
                              "ledger_overhead_pct",
                              "sched_p99_window_ms",

@@ -29,6 +29,7 @@ import threading
 from eges_tpu.utils.metrics import DEFAULT as metrics
 from eges_tpu.utils.timeseries import SeriesStore, fold_payload
 from eges_tpu.utils.ledger import LedgerAssembler
+from eges_tpu.utils.devstats import DevstatsAssembler
 from eges_tpu.utils.profiler import ProfileAssembler
 from harness.anatomy import AnatomyAssembler
 from harness.slo import SLOEngine
@@ -69,6 +70,10 @@ class ClusterCollector:
         # (sample counts are deterministic functions of the stream even
         # though the sampled stacks behind them are wall-clock)
         self.profile = ProfileAssembler()
+        # device-efficiency fold: per-device device_efficiency count
+        # deltas — goodput/waste/roofline are pure functions of the
+        # stream, so live push and --replay agree byte-for-byte
+        self.devstats = DevstatsAssembler()
         self._buffer: list[dict] = []  # guarded-by: _lock
         self._event_counts: dict[str, int] = {}  # guarded-by: _lock
         self.envelopes = 0  # guarded-by: _lock
@@ -116,6 +121,7 @@ class ClusterCollector:
             self.anatomy.ingest(ev)
             self.ledger.ingest(ev)
             self.profile.ingest(ev)
+            self.devstats.ingest(ev)
             self.slo.ingest(ev)
 
     def _step(self, sample: dict, ts: float) -> None:
@@ -161,6 +167,7 @@ class ClusterCollector:
             "anatomy": self.anatomy.report(),
             "ledger": self.ledger.report(),
             "profile": self.profile.report(),
+            "devstats": self.devstats.report(),
         }
 
     def report_json(self) -> str:

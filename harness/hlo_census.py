@@ -6,43 +6,59 @@ compiles ecrecover_batch at a given batch (warm persistent cache),
 prints the optimized-HLO entry instruction count, and itemizes every
 while loop (trip count x body size) and the biggest computations --
 the itemized bill for the ~1.9 s of XLA glue around the fused kernels.
+
+Output leads with the shared ``# eges-profile-v1`` provenance stamp
+(harness/profutil.py) so a census from one checkout/backend is
+distinguishable from another, like every other profiling artifact.
 """
 
 import collections
+import os
 import re
 import sys
+import tempfile
 import time
 
-sys.path.insert(0, "/root/repo")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+from harness.profutil import header_line
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(_REPO, ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
 from eges_tpu.crypto.verifier import ecrecover_batch
 
 B = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
 
+print(header_line(source="hlo-census", batch=B), flush=True)
+
 sigs = jnp.zeros((B, 65), jnp.uint8)
 hashes = jnp.zeros((B, 32), jnp.uint8)
 
-t0 = time.time()
+# analysis: allow-determinism(one-shot census timing; harness-only, never journaled)
+t0 = time.perf_counter()
 comp = jax.jit(ecrecover_batch).lower(sigs, hashes).compile()
-print(f"compile {time.time()-t0:.1f}s on {jax.devices()[0]}", flush=True)
+# analysis: allow-determinism(one-shot census timing; harness-only, never journaled)
+print(f"compile {time.perf_counter()-t0:.1f}s on {jax.devices()[0]}",
+      flush=True)
 
 txt = comp.as_text()
-with open(f"/tmp/recover_hlo_{B}.txt", "w") as f:
+with open(os.path.join(tempfile.gettempdir(),
+                       f"recover_hlo_{B}.txt"), "w") as f:
     f.write(txt)
 print("HLO bytes:", len(txt), flush=True)
 
 # parse computations
 comps = {}  # name -> list of instruction lines
 cur = None
+entry = None
 for line in txt.splitlines():
-    m = re.match(r"^(%?[\w\.\-]+)\s.*{$", line.strip()) if line and not line.startswith(" ") else None
     if line and not line.startswith(" ") and "{" in line:
         m2 = re.search(r"^(ENTRY\s+)?%?([\w\.\-]+)", line.strip())
         cur = m2.group(2) if m2 else None
@@ -50,7 +66,8 @@ for line in txt.splitlines():
         if line.strip().startswith("ENTRY"):
             entry = cur
         continue
-    if cur is not None and line.strip().startswith("%") or (cur and re.match(r"\s+(ROOT\s+)?[\w\.\-%]+\s*=", line)):
+    if cur is not None and line.strip().startswith("%") or (
+            cur and re.match(r"\s+(ROOT\s+)?[\w\.\-%]+\s*=", line)):
         comps[cur].append(line.strip())
 
 entry_ops = comps.get(entry, [])
@@ -74,7 +91,8 @@ for cname, lines in comps.items():
         m = re.search(r"body=%?([\w\.\-]+), condition=%?([\w\.\-]+)", l)
         if m:
             b = m.group(1)
-            print(f"  while in {cname}: body={b} body_ops={len(comps.get(b, []))}")
+            print(f"  while in {cname}: body={b} "
+                  f"body_ops={len(comps.get(b, []))}")
 
 # biggest computations by instruction count
 sizes = sorted(((len(v), k) for k, v in comps.items()), reverse=True)[:15]

@@ -39,6 +39,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
+from eges_tpu.utils import devstats as devstats_mod
 from eges_tpu.utils import journal as journal_mod
 from eges_tpu.utils import ledger as ledger_mod
 from eges_tpu.utils import profiler as profiler_mod
@@ -55,7 +56,7 @@ CONSUMED = ("election_started", "election_won", "election_lost",
             "fault_trigger", "fault_breaker", "verifier_mesh_dispatch",
             "verifier_aot_load", "telemetry_sample",
             "slo_pending", "slo_firing", "slo_resolved",
-            "profiler_report")
+            "profiler_report", "device_efficiency")
 
 _SLO = ("slo_pending", "slo_firing", "slo_resolved")
 
@@ -131,6 +132,9 @@ def summarize(by_node: dict[str, list[dict]],
     # continuous-profiler report counts per stream; the attribution
     # itself is folded by profiler.assemble below
     profiler_reports: dict[str, int] = {}
+    # device-efficiency report counts per stream; the goodput/roofline
+    # fold itself comes from devstats.assemble below
+    devstats_reports: dict[str, int] = {}
     # forward compatibility: journals written by a NEWER build may carry
     # event types this parser has never heard of — count and skip them
     # instead of letting a per-type branch trip over missing attrs
@@ -149,6 +153,9 @@ def summarize(by_node: dict[str, list[dict]],
                 continue
             if typ == "profiler_report":
                 profiler_reports[name] = profiler_reports.get(name, 0) + 1
+                continue
+            if typ == "device_efficiency":
+                devstats_reports[name] = devstats_reports.get(name, 0) + 1
                 continue
             if typ in _SLO:
                 slo_alerts.append((
@@ -292,11 +299,15 @@ def summarize(by_node: dict[str, list[dict]],
         "profiler_reports": {
             name: profiler_reports[name]
             for name in sorted(profiler_reports)},
+        "devstats_reports": {
+            name: devstats_reports[name]
+            for name in sorted(devstats_reports)},
         "unknown_events": {
             typ: unknown_events[typ] for typ in sorted(unknown_events)},
         "anatomy": anatomy_mod.assemble(by_node),
         "ledger": ledger_mod.assemble(by_node),
         "profile": profiler_mod.assemble(by_node),
+        "devstats": devstats_mod.assemble(by_node),
     }
 
 
@@ -561,6 +572,77 @@ def render_profile(rep: dict) -> str:
     return "\n".join(out)
 
 
+def render_devices(rep: dict, width: int = 30) -> str:
+    """Text view of a device-efficiency report
+    (``DevstatsAssembler.report`` / ``devstats.assemble``): per-lane
+    goodput bars, the waste decomposition (pad/cache/dedup/hedge plus
+    host rescues), HBM watermarks when the backend reports them, and
+    the fraction-of-roofline anchored to the captured TPU bench."""
+    tot = rep.get("totals") or {}
+    out = ["device efficiency — %d window(s), %d report(s), "
+           "%d device(s)" % (tot.get("windows", 0),
+                             rep.get("reports", 0),
+                             len(rep.get("devices") or {}))]
+    if not tot.get("windows"):
+        out.append("  (no device windows recorded — scheduler idle or "
+                   "plane disabled)")
+        return "\n".join(out)
+    gp = tot.get("goodput_ratio")
+    if gp is not None:
+        bar = "#" * int(round(gp * width))
+        out.append("  cluster goodput: %6.2f%%  |%-*s|  "
+                   "(%d useful rows / %d padded device rows)" % (
+                       100.0 * gp, width, bar,
+                       tot.get("rows", 0), tot.get("bucket_rows", 0)))
+    waste = rep.get("waste") or {}
+    out.append("  waste decomposition (rows):")
+    for key, label in (("pad_rows", "padding burned"),
+                       ("cache_rows", "cache served (free)"),
+                       ("dedup_rows", "in-flight deduped (free)"),
+                       ("hedge_wasted_rows", "hedge losers burned"),
+                       ("diverted_rows", "host rescued")):
+        out.append("    %-26s %8d" % (label, int(waste.get(key, 0))))
+    out.append("  per-lane goodput:")
+    for dev, d in sorted((rep.get("devices") or {}).items(),
+                         key=lambda kv: int(kv[0])):
+        gp = d.get("goodput_ratio")
+        bar = "#" * int(round((gp or 0.0) * width))
+        frac = d.get("fraction_of_roofline")
+        rate = d.get("rows_per_s")
+        out.append(
+            "    lane %-3s %4d window(s)  %6d rows  "
+            "goodput %s  |%-*s|%s%s" % (
+                dev, d.get("windows", 0), d.get("rows", 0),
+                ("%6.2f%%" % (100.0 * gp)) if gp is not None else "     -",
+                width, bar,
+                ("  %s rows/s" % rate) if rate is not None else "",
+                ("  %5.2f%% of roofline" % (100.0 * frac))
+                if frac is not None else ""))
+        mem = d.get("mem")
+        if mem:
+            out.append(
+                "             HBM: in use %s B  peak %s B  limit %s B"
+                % (mem.get("bytes_in_use", "-"),
+                   mem.get("peak_bytes", "-"),
+                   mem.get("limit_bytes", "-")))
+        for bucket, b in sorted((d.get("buckets") or {}).items(),
+                                key=lambda kv: int(kv[0])):
+            ceil = b.get("ceiling_rows_per_s")
+            bgp = b.get("goodput_ratio")
+            out.append(
+                "             bucket %-6s %4d window(s)  %6d rows  "
+                "goodput %s%s" % (
+                    bucket, b.get("windows", 0), b.get("rows", 0),
+                    ("%6.2f%%" % (100.0 * bgp))
+                    if bgp is not None else "     -",
+                    ("  ceiling %.1f rows/s" % ceil)
+                    if ceil is not None else ""))
+    src = rep.get("roofline_source")
+    if src:
+        out.append("  roofline ceilings from %s" % src)
+    return "\n".join(out)
+
+
 # -- collection -----------------------------------------------------------
 
 def collect_live(cluster) -> dict[str, list[dict]]:
@@ -605,15 +687,21 @@ def run_sim(nodes: int = 4, blocks: int = 6, seconds: float = 600.0,
     ``0`` to disable) so a bare ``python -m harness.observatory``
     renders the per-phase CPU attribution table; the sampler is joined
     before journals are collected, so the summary stays a pure
-    function of the returned events."""
+    function of the returned events.  The device-efficiency plane
+    rides along too: a 2-lane JAX-free host mesh gives the shared
+    scheduler real per-device window lanes to account, so the device
+    section renders goodput/waste/roofline on a bare run."""
     from eges_tpu.sim.cluster import SimCluster
 
-    cluster = SimCluster(nodes, seed=seed, txn_per_block=5, txpool=True)
+    cluster = SimCluster(nodes, seed=seed, txn_per_block=5, txpool=True,
+                         mesh_devices=2)
     cluster.enable_profiling(hz=profile_hz)
+    cluster.enable_devstats(interval_s=30.0)
     cluster.start()
     _inject_pool_load(cluster)
     cluster.run(seconds, stop_condition=lambda: cluster.min_height() >= blocks)
     cluster.stop_profiling()
+    cluster.stop_devstats()
     return cluster
 
 
@@ -719,6 +807,8 @@ def render(summary: dict, net: dict | None = None) -> str:
         out.append(render_ledger(summary["ledger"]))
     if summary.get("profile") is not None:
         out.append(render_profile(summary["profile"]))
+    if summary.get("devstats") is not None:
+        out.append(render_devices(summary["devstats"]))
     return "\n".join(out)
 
 

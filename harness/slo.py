@@ -38,6 +38,8 @@ QUEUE_WAIT_BAD_MS = 500.0     # coalescing window wait above this
 COLD_START_BAD_S = 30.0       # AOT prewarm slower than this
 INVALID_SIG_RATIO_BAD = 0.5   # rejects dominate admits in a snapshot
 INGRESS_MIN_ATTEMPTS = 4      # snapshots with fewer attempts abstain
+GOODPUT_FLOOR = 0.02          # useful/padded device rows below this
+DEVSTATS_MIN_WINDOWS = 2      # ticks with fewer windows abstain
 
 
 @dataclass(frozen=True)
@@ -80,6 +82,9 @@ DEFAULT_OBJECTIVES = (
     Objective("invalid_sig_reject_ratio",
               "ingest rejects stay a small share of pool admissions",
               budget=0.25, fast_window_s=60.0, slow_window_s=240.0),
+    Objective("device_headroom",
+              "device lanes keep useful rows above the goodput floor",
+              budget=0.5, fast_window_s=60.0, slow_window_s=240.0),
 )
 
 
@@ -169,6 +174,22 @@ class SLOEngine:
                     self.observe("invalid_sig_reject_ratio", ts,
                                  rejects / attempts
                                  > INVALID_SIG_RATIO_BAD)
+        elif etype == "device_efficiency":
+            # per-tick device-efficiency delta (utils/devstats.py):
+            # bad when this device's tick ran mostly padding — the
+            # same floor discipline as verifier_occupancy, over the
+            # tick aggregate instead of a single window.  Ticks with
+            # few windows (or none that padded a bucket) abstain so a
+            # lone probe window cannot burn the budget.
+            rows = ev.get("rows")
+            bucket_rows = ev.get("bucket_rows")
+            windows = ev.get("windows")
+            if (isinstance(rows, int) and isinstance(bucket_rows, int)
+                    and isinstance(windows, int)
+                    and windows >= DEVSTATS_MIN_WINDOWS
+                    and bucket_rows > 0):
+                self.observe("device_headroom", ts,
+                             rows / bucket_rows < GOODPUT_FLOOR)
         elif etype == "telemetry_sample":
             payload = ev.get("metrics")
             if isinstance(payload, dict):

@@ -1,0 +1,358 @@
+"""Tier-1 coverage for the device-efficiency observatory
+(``eges_tpu/utils/devstats.py``).
+
+Five contracts pinned here:
+
+* **Roofline anchoring**: the per-bucket ceilings parse out of the
+  captured TPU bench's free-text scaling note (headline value
+  overriding its note-rounded bucket), and ``roofline_ceiling``
+  interpolates/clamps between them deterministically.
+* **Goodput math**: hand-computed window fixtures driven through a
+  :class:`GoodputLedger` journal, assemble, and report the exact
+  ratios — diverted windows in the rescue column, hedge losers billed
+  at padded size, cache/dedup rows in the free column.
+* **Memory degradation**: backends without ``memory_stats()`` (or
+  returning ``None``, the CPU contract) publish NOTHING — absent, not
+  fake zeros — while dict-returning devices land exact watermarks.
+* **Snapshot ring + RPC**: ``thw_devices`` pages deltas newest-first
+  with the clamped limit contract every thw_* list RPC shares, and
+  ``thw_device_trace`` arms/disarms the trace armer with the same
+  clamp on its window count.
+* **Collector plane**: the live-push and ``--replay`` collector folds
+  agree byte-for-byte on the devstats section (counts are a pure
+  function of the journaled stream), and the observatory renders both
+  empty and populated reports.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from eges_tpu.utils import devstats
+from eges_tpu.utils.devstats import (
+    DevstatsAssembler, DeviceTraceArmer, GoodputLedger, load_roofline,
+    roofline_ceiling, sample_memory,
+)
+from eges_tpu.utils.journal import Journal
+
+
+# -- roofline anchoring ---------------------------------------------------
+
+def test_roofline_parses_capture_note(tmp_path):
+    cap = {"note": "scaling: 3.7k/s @256, 12.9k/s @1024, 54.3k/s @16384",
+           "batch": 16384, "value": 54296.9}
+    path = tmp_path / "cap.json"
+    path.write_text(json.dumps(cap))
+    roof = load_roofline(str(path))
+    assert roof["source"] == "cap.json"
+    # the headline value overrides the note's rounded 54.3k
+    assert roof["ceilings"] == {256: 3700.0, 1024: 12900.0,
+                                16384: 54296.9}
+    # parse results are cached per path
+    assert load_roofline(str(path)) is roof
+
+    missing = load_roofline(str(tmp_path / "nope.json"))
+    assert missing["ceilings"] == {}
+
+
+def test_roofline_from_repo_capture():
+    roof = load_roofline()
+    assert roof["source"] == devstats.ROOFLINE_FILE
+    assert roof["ceilings"][256] == 3700.0
+    assert roof["ceilings"][16384] == 54296.9
+
+
+def test_roofline_ceiling_interpolation():
+    ceilings = {256: 1000.0, 1024: 3000.0}
+    assert roofline_ceiling(ceilings, 256) == 1000.0  # exact
+    # log2-midpoint of [256, 1024] is 512: halfway up the segment
+    assert roofline_ceiling(ceilings, 512) == pytest.approx(2000.0)
+    # below the smallest capture: linear scale toward zero
+    assert roofline_ceiling(ceilings, 128) == pytest.approx(500.0)
+    # above the largest: clamped — the chip does not get faster
+    assert roofline_ceiling(ceilings, 8192) == 3000.0
+    assert roofline_ceiling({}, 256) is None
+    assert roofline_ceiling(ceilings, 0) is None
+
+
+# -- goodput math (hand-computed fixtures) --------------------------------
+
+def _fixture_ledger() -> GoodputLedger:
+    """Two lanes: lane 0 runs two device windows (10/16 + 20/32 padded
+    rows, 3 cache + 2 dedup companions) and one hedge loss billed at
+    bucket 16; lane 1 records one diverted singleton (host rescue)."""
+    led = GoodputLedger()
+    led.observe_window(0, 10, 16, cache_rows=3)
+    led.observe_window(0, 20, 32, dedup_rows=2, hedged=True)
+    led.observe_hedge_waste(0, 5, 16)
+    led.observe_window(1, 1, 1, diverted=True)
+    return led
+
+
+def test_goodput_ledger_exact_ratios():
+    led = _fixture_ledger()
+    journal = Journal("devstats")
+    assert led.journal_snapshot(journal) == 2  # one event per device
+
+    asm = DevstatsAssembler()
+    for ev in journal.events():
+        asm.ingest(ev)
+    rep = asm.report()
+
+    tot = rep["totals"]
+    assert tot["windows"] == 3
+    assert tot["rows"] == 30            # diverted row excluded
+    assert tot["bucket_rows"] == 48     # 16 + 32; divert pads nothing
+    assert tot["pad_rows"] == 18
+    assert tot["goodput_ratio"] == round(30 / 48, 4)
+    assert rep["waste"] == {"pad_rows": 18, "cache_rows": 3,
+                            "dedup_rows": 2, "hedge_wasted_rows": 16,
+                            "diverted_rows": 1}
+
+    d0 = rep["devices"]["0"]
+    assert d0["goodput_ratio"] == round(30 / 48, 4)
+    assert d0["hedge_windows"] == 1
+    assert d0["hedge_wasted_windows"] == 1
+    assert d0["hedge_wasted_rows"] == 16  # billed at padded size
+    assert d0["buckets"]["16"] == {
+        "windows": 1, "rows": 10, "bucket_rows": 16,
+        "goodput_ratio": 0.625,
+        "ceiling_rows_per_s": d0["buckets"]["16"]["ceiling_rows_per_s"],
+    }
+    assert d0["buckets"]["32"]["goodput_ratio"] == 0.625
+    # per-bucket split sums back to the device totals
+    assert sum(b["rows"] for b in d0["buckets"].values()) == d0["rows"]
+
+    d1 = rep["devices"]["1"]
+    assert d1["diverted_windows"] == 1 and d1["diverted_rows"] == 1
+    assert d1["rows"] == 0 and d1["goodput_ratio"] is None
+
+
+def test_snapshot_deltas_and_rebase():
+    led = _fixture_ledger()
+    snap = led.snap()
+    assert snap["seq"] == 0
+    assert set(snap["devices"]) == {"0", "1"}
+    assert snap["devices"]["0"]["rows"] == 30
+    assert snap["devices"]["0"]["buckets"] == {"16": [1, 10, 16],
+                                              "32": [1, 20, 32]}
+    # the delta baseline advanced: an idle period snaps to no devices
+    assert led.snap()["devices"] == {}
+    # ...and an idle tick journals nothing (no empty payload)
+    assert led.journal_snapshot(Journal("devstats")) == 0
+
+    led.observe_window(0, 8, 16)
+    snap = led.snap()
+    assert snap["devices"]["0"]["rows"] == 8  # delta, not cumulative
+    assert led.stats()["rows"] == 38          # stats stay cumulative
+
+    # rebase() = baseline-at-enable: pre-enable windows never leak
+    led.observe_window(0, 4, 16)
+    led.rebase()
+    assert led.snap()["devices"] == {}
+
+
+def test_snapshot_ring_is_bounded():
+    led = GoodputLedger(snapshots=3)
+    for i in range(5):
+        led.observe_window(0, 1 + i, 16)
+        led.snap()
+    snaps = led.snapshots()
+    assert len(snaps) == 3
+    seqs = [s["seq"] for s in snaps]
+    assert seqs == sorted(seqs) and seqs[-1] == 4
+    assert led.snapshots(limit=2) == snaps[-2:]
+
+
+# -- HBM telemetry degradation --------------------------------------------
+
+class _Dev:
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_stats(self):
+        if isinstance(self._stats, Exception):
+            raise self._stats
+        return self._stats
+
+
+def test_sample_memory_degrades_to_absent():
+    led = GoodputLedger()
+    devices = [
+        object(),                       # no memory_stats attribute
+        _Dev(None),                     # CPU contract: returns None
+        _Dev(RuntimeError("backend")),  # erroring backend
+        _Dev({"bytes_in_use": 100, "peak_bytes_in_use": 200,
+              "bytes_limit": 1000}),
+    ]
+    out = sample_memory(led, devices=devices)
+    # only the dict-returning device published; absent, not fake zeros
+    assert out == {3: {"bytes_in_use": 100, "peak_bytes": 200,
+                       "limit_bytes": 1000}}
+    led.observe_window(3, 4, 16)
+    snap = led.snap()
+    assert snap["devices"]["3"]["mem"]["peak_bytes"] == 200
+
+    # all-degraded: nothing published, nothing stashed
+    assert sample_memory(led, devices=[object(), _Dev(None)]) == {}
+
+
+def test_sample_memory_without_jax(monkeypatch):
+    import sys as _sys
+    monkeypatch.delitem(_sys.modules, "jax", raising=False)
+    assert sample_memory(GoodputLedger()) == {}
+
+
+# -- trace armer ----------------------------------------------------------
+
+def test_trace_armer_degrades_without_jax_profiler(monkeypatch):
+    import sys as _sys
+
+    class _BrokenProfiler:
+        @staticmethod
+        def start_trace(path):
+            raise RuntimeError("no backend")
+
+    class _FakeJax:
+        profiler = _BrokenProfiler()
+
+    monkeypatch.setitem(_sys.modules, "jax", _FakeJax())
+    armer = DeviceTraceArmer()
+    st = armer.arm(2)
+    assert st["state"] == "armed" and st["armed_windows"] == 2
+    armer.step()  # first armed window tries to start and fails
+    st = armer.status()
+    assert st["state"].startswith("error:")
+    assert st["active"] is False and st["armed_windows"] == 0
+    armer.step()  # idle again: cheap no-op
+    assert armer.status()["captures"] == 0
+
+    st = armer.disarm()
+    assert st["state"] == "idle" and st["armed_windows"] == 0
+
+
+# -- thw_devices / thw_device_trace RPC -----------------------------------
+
+@pytest.fixture
+def rpc_with_ledger(monkeypatch):
+    from eges_tpu.rpc.server import RpcServer
+    from eges_tpu.sim.cluster import SimCluster
+
+    c = SimCluster(2, seed=5)
+    c.start()
+    c.run(120, stop_condition=lambda: c.min_height() >= 1)
+    for sn in c.nodes:
+        sn.node.stop()
+
+    led = GoodputLedger()
+    for i in range(3):
+        led.observe_window(0, 8 + i, 16)
+        led.snap()
+    # the RPC surfaces read the process-wide DEFAULT; point them at the
+    # instance under test for the duration
+    monkeypatch.setattr(devstats, "DEFAULT", led)
+    return RpcServer(c.nodes[0].chain, node=c.nodes[0].node), led
+
+
+def test_thw_devices_rpc_and_health_block(rpc_with_ledger):
+    rpc, led = rpc_with_ledger
+    out = rpc.dispatch("thw_devices", [])
+    assert len(out) == 3
+    assert [s["seq"] for s in out] == [2, 1, 0]  # newest first
+    assert out[0]["devices"]["0"]["rows"] == 10
+    assert rpc.dispatch("thw_devices", [2]) == out[:2]
+    assert rpc.dispatch("thw_devices", [{"limit": 1}]) == out[:1]
+    # limit clamps into [1, 4096], same contract as thw_profile
+    assert len(rpc.dispatch("thw_devices", [0])) == 1
+    assert len(rpc.dispatch("thw_devices", [10 ** 6])) == 3
+
+    health = rpc.dispatch("thw_health", [])
+    blk = health["devstats"]
+    assert blk["windows"] == 3 and blk["rows"] == 27
+    assert blk["goodput_ratio"] == round(27 / 48, 4)
+    assert blk["snapshots"] == 3
+    assert blk["trace"]["state"] == "idle"
+
+
+def test_thw_device_trace_rpc_clamps_and_disarms(rpc_with_ledger,
+                                                 tmp_path):
+    rpc, led = rpc_with_ledger
+    st = rpc.dispatch("thw_device_trace",
+                      [{"windows": 3, "dir": str(tmp_path)}])
+    assert st["state"] == "armed" and st["armed_windows"] == 3
+    assert st["dir"] == str(tmp_path)
+    # window count clamps into [1, 4096] like every list limit
+    assert rpc.dispatch("thw_device_trace", [0])["armed_windows"] == 1
+    assert (rpc.dispatch("thw_device_trace", [10 ** 6])["armed_windows"]
+            == 4096)
+    st = rpc.dispatch("thw_device_trace", [{"disarm": True}])
+    assert st["state"] == "idle" and st["armed_windows"] == 0
+    assert led.trace.status()["active"] is False
+
+
+# -- collector fold: live push == replay ----------------------------------
+
+def test_devstats_section_live_push_matches_replay():
+    from harness.collector import ClusterCollector
+    from eges_tpu.sim.cluster import SimCluster
+
+    col = ClusterCollector()
+    cluster = SimCluster(3, seed=0, txn_per_block=4, txpool=True,
+                         mesh_devices=2)
+    cluster.enable_telemetry(sink=col.ingest, interval_s=0.05)
+    cluster.enable_devstats(interval_s=0.05)
+    cluster.start()
+    cluster.run(600.0, stop_condition=lambda: cluster.min_height() >= 3)
+    assert cluster.min_height() >= 3, cluster.heights()
+    for sn in cluster.nodes:
+        sn.node.stop()
+    # the final devstats delta must be journaled BEFORE the final
+    # telemetry push so the last envelope ships it to the live fold
+    cluster.stop_devstats()
+    cluster.flush_telemetry()
+    col.finalize()
+
+    live = col.report()["devstats"]
+    assert live["reports"] >= 1
+    assert live["totals"]["windows"] > 0
+    assert live["totals"]["bucket_rows"] >= live["totals"]["rows"]
+
+    # counts are a pure function of the journaled stream: the offline
+    # replay agrees with the live push exactly
+    replay = ClusterCollector.replay(cluster.journals())
+    assert (json.dumps(replay.report()["devstats"], sort_keys=True)
+            == json.dumps(live, sort_keys=True))
+
+
+# -- observatory rendering ------------------------------------------------
+
+def test_observatory_renders_empty_and_populated_devices():
+    from harness import observatory
+
+    empty = DevstatsAssembler().report()
+    text = observatory.render_devices(empty)
+    assert "no device windows recorded" in text
+
+    led = _fixture_ledger()
+    journal = Journal("devstats")
+    led.journal_snapshot(journal)
+    asm = DevstatsAssembler()
+    for ev in journal.events():
+        asm.ingest(ev)
+    text = observatory.render_devices(asm.report())
+    assert "device efficiency" in text
+    assert "cluster goodput" in text
+    assert "padding burned" in text
+    assert "cache served (free)" in text       # the under-count fix
+    assert "host rescued" in text
+    assert "lane 0" in text and "lane 1" in text
+    assert "roofline ceilings from" in text
+
+    # the summarize path consumes device_efficiency events and render()
+    # embeds the device section
+    summary = observatory.summarize({"devstats": journal.events()})
+    assert summary["devstats_reports"] == {"devstats": 2}
+    assert summary["devstats"]["totals"]["rows"] == 30
+    assert "device efficiency" in observatory.render(summary)

@@ -98,15 +98,16 @@ def _run_cluster(n=4, blocks=6):
 def test_observatory_replay_summary_identical_to_live(tmp_path):
     cluster = _run_cluster()
     by_node = observatory.collect_live(cluster)
-    # run_sim profiles by default: the continuous profiler's dedicated
-    # stream rides collect_live as a pseudo-node, like chaos' "faults"
-    assert sorted(by_node) == ["node0", "node1", "node2", "node3",
-                               "profiler"]
+    # run_sim profiles by default: the continuous profiler's and the
+    # device-efficiency plane's dedicated streams ride collect_live as
+    # pseudo-nodes, like chaos' "faults"
+    assert sorted(by_node) == ["devstats", "node0", "node1", "node2",
+                               "node3", "profiler"]
     live = observatory.summarize(by_node)
 
     outdir = str(tmp_path / "dumps")
     paths = observatory.dump_journals(by_node, outdir)
-    assert len(paths) == 5
+    assert len(paths) == 6
     replayed = observatory.summarize(observatory.load_journals(outdir))
 
     assert replayed == live  # the acceptance criterion, bit-for-bit
@@ -117,8 +118,9 @@ def test_observatory_replay_summary_identical_to_live(tmp_path):
     assert live["election"]["p50_ms"] is not None
     assert live["ack_quorum"]["count"] >= 6
     assert live["election_timeline"], "no election timeline entries"
-    # the profiler stream commits no blocks, so it has no lag entry
-    assert set(live["commit_lag"]) == set(by_node) - {"profiler"}
+    # the profiler/devstats streams commit no blocks: no lag entries
+    assert set(live["commit_lag"]) == set(by_node) - {"profiler",
+                                                      "devstats"}
     for lag in live["commit_lag"].values():
         assert lag["mean_s"] >= 0.0
     # render() must handle a real summary without raising
@@ -130,7 +132,7 @@ def test_observatory_replay_summary_identical_to_live(tmp_path):
 HEALTH_KEYS = {"height", "headHash", "lag", "role", "electionsWon",
                "electionsLost", "txpoolPending", "deferredDepth",
                "members", "minTtl", "lastCommitAge", "stalled", "journal",
-               "sloAlerts", "profiler"}
+               "sloAlerts", "profiler", "devstats"}
 
 
 def test_thw_health_complete_on_every_node_and_over_http():
