@@ -321,7 +321,7 @@ _PROBE_SRC = (
 )
 
 
-def _probe_tpu(timeout_s: float) -> dict | None:
+def _probe_tpu(timeout_s: float) -> dict | None:  # api: _probe_tpu
     """Ask a killable child what platform JAX sees.
 
     The axon tunnel's failure mode is a HANG, not an error —
@@ -837,9 +837,10 @@ def _profile_stage() -> dict | None:
                 pool = TxPool(_WallClock(), verifier=sched,
                               max_batch=rows)
                 try:
+                    from eges_tpu.ingress import admit_remotes
                     for b in range(batches):
-                        pool.add_remotes(
-                            signed[b * rows:(b + 1) * rows])
+                        admit_remotes(
+                            pool, signed[b * rows:(b + 1) * rows])
                 finally:
                     sched.close()
                 if pool.stats["admitted"] == 0:
@@ -1392,9 +1393,10 @@ def main() -> None:
     # findings_by_rule/unsuppressed_by_rule line per bench round, the
     # history harness/check_regression.py --analysis gates on — any
     # rise in a rule fails, and rules absent from the previous line
-    # count as zero, so the device-hygiene rules (host-sync,
-    # recompile-hazard, transfer-hygiene, dtype-promotion) gate from
-    # their first recorded line onward
+    # count as zero, so newly added rules — the device-hygiene pass,
+    # then the architecture pass (layer-violation, import-cycle,
+    # private-reach, perimeter-breach) — gate from their first
+    # recorded line onward
     analysis_history = os.environ.get(
         "ANALYSIS_HISTORY", os.path.join(_REPO, "harness",
                                          "analysis_history.jsonl"))

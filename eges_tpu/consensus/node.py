@@ -357,7 +357,7 @@ class GeecNode:
     # inbound dispatch
     # ------------------------------------------------------------------
 
-    def on_gossip(self, data: bytes) -> None:
+    def on_gossip(self, data: bytes) -> None:  # ingress-entry
         ctx, data = tracing.extract(data)
         # ingress provenance: every cost this datagram incurs (pool
         # admits/rejects, verifier rows, deferred/duplicate drops) bills
@@ -415,7 +415,7 @@ class GeecNode:
         elif code == M.GOSSIP_TXNS:
             self._handle_txns(msg)
 
-    def on_direct(self, data: bytes) -> None:
+    def on_direct(self, data: bytes) -> None:  # ingress-entry
         ctx, data = tracing.extract(data)
         src = ledger.current_peer()
         with self._lock, tracing.DEFAULT.activate(ctx), \
@@ -463,7 +463,7 @@ class GeecNode:
         elif code == M.UDP_STATE:
             self._handle_state_chunk(msg)
 
-    def on_geec_txn(self, payload: bytes) -> None:
+    def on_geec_txn(self, payload: bytes) -> None:  # ingress-entry
         """UDP txn ingest (ref: consensus/geec/geec_api.go:28-41)."""
         from eges_tpu.core.types import geec_txn
         from eges_tpu.utils.metrics import DEFAULT as metrics
@@ -1160,7 +1160,7 @@ class GeecNode:
 
     _TXN_SEEN_CAP = 1 << 16
 
-    def submit_txns(self, txns) -> None:  # thread-entry (RPC worker)
+    def submit_txns(self, txns) -> None:  # thread-entry (RPC worker); ingress-entry:bounded
         """Local ingress (RPC eth_sendRawTransaction): admit to our pool
         via the journaled local path (they survive a restart, ref:
         core/tx_pool.go journal); admitted txns are broadcast via the
@@ -1173,7 +1173,7 @@ class GeecNode:
             else:
                 self.broadcast_txns(txns)
 
-    def broadcast_txns(self, txns) -> None:  # thread-entry (RPC worker)
+    def broadcast_txns(self, txns) -> None:  # thread-entry (RPC worker); ingress-entry:bounded
         """Gossip txns to peers with relay-once dedup."""
         with self._lock:
             fresh = [t for t in txns if t.hash not in self._txn_seen]
@@ -1840,7 +1840,7 @@ class GeecNode:
     # blockLoop geec_state.go:1132-1180)
     # ------------------------------------------------------------------
 
-    def _on_new_block(self, blk: Block) -> None:
+    def _on_new_block(self, blk: Block) -> None:  # api: _on_new_block
         with self._lock:
             self._timeout_times = 0
             self._arm_block_timeout()

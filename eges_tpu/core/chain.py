@@ -262,7 +262,7 @@ class BlockChain:
         self.store = store if store is not None else MemoryStore()
         self.verifier = verifier
         if engine is None:
-            from eges_tpu.consensus.engine import GeecEngine
+            from eges_tpu.core.engine import GeecEngine
             engine = GeecEngine()
         self.engine = engine
         self._listeners = list(listeners)
@@ -394,7 +394,7 @@ class BlockChain:
                 f"non-sequential insert: {header.number} onto {self._head.number}")
         if header.parent_hash != self._head.hash:
             raise ChainError("unknown ancestor")
-        from eges_tpu.consensus.engine import EngineError
+        from eges_tpu.core.engine import EngineError
         try:
             self.engine.verify_header(self, header)
         except EngineError as e:

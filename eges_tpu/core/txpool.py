@@ -93,7 +93,7 @@ class TxPool:
 
     # -- ingest -----------------------------------------------------------
 
-    def add_remotes(self, txns) -> None:  # thread-entry (RPC via add_locals)
+    def add_remotes(self, txns) -> None:  # thread-entry (RPC via add_locals); ingress-entry:bounded
         """Queue remote txns for batched admission
         (ref: TxPool.AddRemotes core/tx_pool.go:551)."""
         fresh = 0
@@ -358,7 +358,7 @@ class TxPool:
 
     # -- local-txn journal (ref: core/tx_pool.go newTxJournal) ------------
 
-    def add_locals(self, txns) -> None:  # thread-entry (RPC worker)
+    def add_locals(self, txns) -> None:  # thread-entry (RPC worker); ingress-entry:bounded
         """Admit locally-submitted txns AND journal them so they survive
         a node restart (remote gossip txns are not journaled).  Only
         FRESH txns journal — resubmitting the same txn N times must not

@@ -109,7 +109,7 @@ def verify_batch(sigs: jnp.ndarray, hashes: jnp.ndarray, pubs: jnp.ndarray):
     return ec.ecdsa_verify_point(z, r, s, qx, qy)
 
 
-def _jax_export():
+def _jax_export():  # api: _jax_export
     """The ``jax.export`` module (moved out of experimental over jax
     releases), or ``None`` when this jax has neither spelling — every
     AOT consumer then falls through to plain jit."""
@@ -144,7 +144,7 @@ def make_sharded_ecrecover(mesh: jax.sharding.Mesh, axis: str = "dp"):
     valid signatures costs one scalar collective instead of a host
     gather.  Built on the generic :mod:`eges_tpu.parallel` layer.
     """
-    from eges_tpu.parallel import shard_rows
+    from eges_tpu.parallel import shard_rows  # analysis: allow-layer-violation(mesh-collective seam; extracted with the ROADMAP-1 multi-host fabric)
 
     return shard_rows(ecrecover_batch, mesh, axis, n_in=2, n_out=3,
                       tally_out=2)
@@ -238,7 +238,7 @@ class BatchVerifier:
         if name is None:
             name = self._collective
             if name == "auto":
-                from eges_tpu.parallel.ring import preferred_collective
+                from eges_tpu.parallel.ring import preferred_collective  # analysis: allow-layer-violation(mesh-collective seam; extracted with the ROADMAP-1 multi-host fabric)
                 name = preferred_collective(self._ndev, bucket)
             if self._ndev <= 1:
                 name = "psum"  # a 1-wide ring is just overhead
@@ -254,7 +254,7 @@ class BatchVerifier:
         fn = self._collective_fns.get(name)
         if fn is None:
             if name == "ring":
-                from eges_tpu.parallel.ring import ring_tally
+                from eges_tpu.parallel.ring import ring_tally  # analysis: allow-layer-violation(mesh-collective seam; extracted with the ROADMAP-1 multi-host fabric)
                 fn = ring_tally(ecrecover_batch, self._mesh, self._axis,
                                 n_in=2, n_out=3, tally_out=2)
             else:
@@ -874,7 +874,7 @@ class MeshBatchVerifier(BatchVerifier):
                  debug_timing: bool | None = None,
                  collective: str = "auto"):
         if mesh is None:
-            from eges_tpu.parallel import data_parallel_mesh
+            from eges_tpu.parallel import data_parallel_mesh  # analysis: allow-layer-violation(mesh-collective seam; extracted with the ROADMAP-1 multi-host fabric)
             mesh = data_parallel_mesh(axis=axis)
         super().__init__(mesh=mesh, axis=axis, min_bucket=min_bucket,
                          debug_timing=debug_timing, collective=collective)

@@ -14,7 +14,7 @@ from __future__ import annotations
 import numpy as np
 
 
-def _count_host_rows(n: int) -> None:
+def _count_host_rows(n: int) -> None:  # api: _count_host_rows
     """Count host-fallback recoveries so ``thw_metrics`` can report the
     on-device verify share (BASELINE.md north star: > 95% of verifies on
     TPU; the device side counts ``verifier.rows``)."""

@@ -18,6 +18,7 @@ from eges_tpu.consensus.config import ChainGeecConfig, NodeConfig
 from eges_tpu.consensus.node import GeecNode
 from eges_tpu.core.chain import BlockChain, FileStore, make_genesis
 from eges_tpu.crypto import secp256k1 as secp
+from eges_tpu.ingress import direct_sink, gossip_sink, txn_sink
 from eges_tpu.net.transports import (
     AsyncioClock, DirectPlane, GeecTxnService, GossipPlane, SocketTransport,
 )
@@ -126,7 +127,7 @@ class _TelemetryPusher:
         self._sock = None
         self.engine = None
         try:
-            from harness.slo import SLOEngine
+            from harness.slo import SLOEngine  # analysis: allow-layer-violation(optional burn-rate SLO instrumentation hook)
             self.engine = SLOEngine()
             node.slo_engine = self.engine
         except ImportError:
@@ -265,7 +266,7 @@ class NodeService:
                              log=self._node_log)
 
         self.direct = DirectPlane(ncfg.consensus_ip, ncfg.consensus_port,
-                                  self.node.on_direct)
+                                  direct_sink(self.node))
         # gossip-plane auth secret (the RLPx role): operator-provided, or
         # derived from the genesis hash — isolating networks and blocking
         # casual frame injection even without an explicit secret
@@ -314,19 +315,20 @@ class NodeService:
         # scoring, not concurrency.
         from eges_tpu.consensus import messages as M
         from eges_tpu.net.transports import Protocol
+        gossip = gossip_sink(self.node)
         protocols = [
             Protocol("geec", (1,),
                      {M.GOSSIP_VALIDATE_REQ, M.GOSSIP_QUERY,
                       M.GOSSIP_REGISTER_REQ, M.GOSSIP_CONFIRM_BLOCK},
-                     self.node.on_gossip),
+                     gossip),
             Protocol("sync", (1,),
                      {M.GOSSIP_GET_BLOCKS, M.GOSSIP_BLOCKS_REPLY,
                       M.GOSSIP_GET_HEADERS, M.GOSSIP_HEADERS_REPLY},
-                     self.node.on_gossip),
-            Protocol("txn", (1,), {M.GOSSIP_TXNS}, self.node.on_gossip),
+                     gossip),
+            Protocol("txn", (1,), {M.GOSSIP_TXNS}, gossip),
         ]
         self.gossip = GossipPlane(cfg.gossip_ip, cfg.gossip_port,
-                                  list(cfg.peers), self.node.on_gossip,
+                                  list(cfg.peers), gossip,
                                   secret=secret,
                                   keypair=(priv, secp.privkey_to_pubkey(priv)),
                                   allow_v1_peers=cfg.allow_v1_peers,
@@ -363,7 +365,7 @@ class NodeService:
         self.txn_service = None
         if ncfg.geec_txn_port:
             self.txn_service = GeecTxnService(
-                ncfg.consensus_ip, ncfg.geec_txn_port, self.node.on_geec_txn)
+                ncfg.consensus_ip, ncfg.geec_txn_port, txn_sink(self.node))
 
         from eges_tpu.core.txpool import TxPool
         self.txpool = TxPool(
@@ -530,7 +532,7 @@ class NodeService:
             return
         prof.journal_snapshot(self.node.journal)
         try:
-            from harness.profutil import artifact_header
+            from harness.profutil import artifact_header  # analysis: allow-layer-violation(profiler artifact emission; instrumentation hook)
             header = artifact_header(source="node-service")
         except ImportError:  # installed without the harness tree
             header = {"source": "node-service"}

@@ -125,7 +125,7 @@ def _k_carry_tail(cols, xp=jnp):
     return out
 
 
-def _k_mul(a, b, xp=jnp):
+def _k_mul(a, b, xp=jnp):  # api: _k_mul
     """Schoolbook 16x16 product columns + delta folds + carry tail
     (mirrors ``big_mul_cols`` + ``FieldP._reduce_cols``)."""
     mask = xp.uint32(MASK)

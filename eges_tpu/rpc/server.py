@@ -189,7 +189,7 @@ class RpcServer:
                      for (a, ts, d) in getattr(r, "logs", ())],
         }
 
-    def dispatch(self, method: str, params: list):
+    def dispatch(self, method: str, params: list):  # ingress-entry:bounded
         if method == "eth_blockNumber":
             return _hex(self.chain.height())
         if method == "eth_getBlockByNumber":
@@ -861,7 +861,7 @@ class RpcServer:
 
     # -- JSON-RPC plumbing ------------------------------------------------
 
-    def _handle_body(self, body: bytes) -> bytes:
+    def _handle_body(self, body: bytes) -> bytes:  # ingress-entry:bounded
         try:
             req = json.loads(body)
         except json.JSONDecodeError:
@@ -885,7 +885,7 @@ class RpcServer:
                             "error": {"code": -32603, "message": str(e)}})
         return json.dumps(out if batch else out[0]).encode()
 
-    async def _handle_conn(self, reader: asyncio.StreamReader,
+    async def _handle_conn(self, reader: asyncio.StreamReader,  # ingress-entry
                            writer: asyncio.StreamWriter) -> None:
         try:
             while True:
@@ -1004,7 +1004,7 @@ class RpcServer:
             if fin:
                 return first_opcode, buf
 
-    async def _handle_ws(self, reader, writer, headers: dict) -> None:
+    async def _handle_ws(self, reader, writer, headers: dict) -> None:  # ingress-entry
         import base64
         import hashlib
 
@@ -1119,7 +1119,7 @@ class RpcServer:
 
     IPC_LIMIT = 16 * 1024 * 1024  # max request line (large raw txns)
 
-    async def _handle_ipc(self, reader: asyncio.StreamReader,
+    async def _handle_ipc(self, reader: asyncio.StreamReader,  # ingress-entry
                           writer: asyncio.StreamWriter) -> None:
         """IPC framing: newline-delimited raw JSON-RPC (no HTTP
         envelope), matching geth's geth.ipc convention."""

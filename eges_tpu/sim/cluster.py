@@ -15,6 +15,7 @@ from eges_tpu.consensus.config import BootstrapNode, ChainGeecConfig, NodeConfig
 from eges_tpu.consensus.node import GeecNode
 from eges_tpu.core.chain import BlockChain, make_genesis
 from eges_tpu.crypto import secp256k1 as secp
+from eges_tpu.ingress import direct_sink, gossip_sink
 from eges_tpu.sim.simnet import SimClock, SimNet, SkewedClock
 
 
@@ -143,7 +144,8 @@ class SimCluster:
                 # no transport join, no gossip — until start_deferred()
                 transport = self.net.join(name, ncfg.consensus_ip,
                                           ncfg.consensus_port,
-                                          node.on_gossip, node.on_direct)
+                                          gossip_sink(node),
+                                          direct_sink(node))
                 node.transport = transport
             self.nodes.append(SimNode(name=name, priv=privs[i],
                                       addr=addrs[i], chain=chain, node=node,
@@ -163,7 +165,7 @@ class SimCluster:
         ncfg = sn.node.cfg
         sn.node.transport = self.net.join(
             sn.name, ncfg.consensus_ip, ncfg.consensus_port,
-            sn.node.on_gossip, sn.node.on_direct)
+            gossip_sink(sn.node), direct_sink(sn.node))
         sn.node.start()
 
     def crash(self, i: int) -> None:
@@ -202,7 +204,8 @@ class SimCluster:
             node.txpool = TxPool(sn.clock, verifier=self.verifier)
         node.transport = self.net.join(sn.name, ncfg.consensus_ip,
                                        ncfg.consensus_port,
-                                       node.on_gossip, node.on_direct)
+                                       gossip_sink(node),
+                                       direct_sink(node))
         sn.node = node
         sn.crashed = False
         # AOT prewarm before serving: a jax-backed verifier reloads its

@@ -638,7 +638,7 @@ class DevstatsAssembler:
 def assemble(by_node: dict[str, list[dict]]) -> dict:
     """Batch-mode fold over per-stream event lists (the observatory
     ``--replay`` path); mirrors ``profiler.assemble``."""
-    from harness.collector import _order_key
+    from harness.collector import _order_key  # analysis: allow-layer-violation(selftest assembles sim journals; not a runtime dependency)
 
     asm = DevstatsAssembler()
     merged: list[dict] = []
@@ -658,7 +658,7 @@ def _selftest() -> int:
     the devstats plane enabled, then assert the journaled
     ``device_efficiency`` events reassemble into a consistent goodput
     report anchored to the captured roofline."""
-    from eges_tpu.sim.cluster import SimCluster
+    from eges_tpu.sim.cluster import SimCluster  # analysis: allow-layer-violation(selftest drives a sim cluster; not a runtime dependency)
 
     roof = load_roofline()
     assert roof["ceilings"], "roofline scaling row failed to parse"

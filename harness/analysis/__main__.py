@@ -34,9 +34,12 @@ def _changed_files(root: str, base: str) -> set[str] | None:
 
 def _sarif(report) -> dict:
     """SARIF 2.1.0 log of the unsuppressed findings — the GitHub
-    code-scanning upload format, one result per finding, one reusable
-    rule entry per distinct rule id."""
-    rules = sorted({f.rule for f in report.unsuppressed})
+    code-scanning upload format.  The driver's ``rules`` table
+    enumerates EVERY registered rule exactly once (not just the rules
+    that fired), so ``ruleIndex`` is stable across runs and a clean
+    run still publishes the full rule inventory."""
+    rules = list(core.RULES)
+    index = {r: i for i, r in enumerate(rules)}
     return {
         "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
         "version": "2.1.0",
@@ -49,7 +52,7 @@ def _sarif(report) -> dict:
             }},
             "results": [{
                 "ruleId": f.rule,
-                "ruleIndex": rules.index(f.rule),
+                "ruleIndex": index[f.rule],
                 "level": "error",
                 "message": {"text": f.message},
                 "locations": [{"physicalLocation": {
@@ -69,10 +72,14 @@ def main(argv: list[str] | None = None) -> int:
         prog="python -m harness.analysis",
         description="AST static analysis: lock-discipline, lock-order/"
                     "fail-under-lock, future-lifecycle, determinism, "
-                    "jit-purity, vocabulary, robustness-hygiene, and "
+                    "jit-purity, vocabulary, robustness-hygiene, "
                     "the device-hygiene pass (host-sync, "
                     "recompile-hazard, transfer-hygiene, "
-                    "dtype-promotion) over the verifier hot path.")
+                    "dtype-promotion) over the verifier hot path, "
+                    "the ingress-taint pass, and the "
+                    "architecture-conformance pass (layer-violation, "
+                    "import-cycle, private-reach, perimeter-breach) "
+                    "against the declared layer map.")
     ap.add_argument("paths", nargs="*", default=list(core.DEFAULT_PATHS),
                     help="directories/files to scan (default: eges_tpu "
                          "harness)")
@@ -120,7 +127,13 @@ def main(argv: list[str] | None = None) -> int:
             print(f"cannot resolve --diff base {args.diff!r}",
                   file=sys.stderr)
             return 2
-        report.findings = [f for f in report.findings if f.path in changed]
+        # membership, not just the anchor: a multi-file finding (an
+        # import cycle) must fire when ANY member file changed, even
+        # though it is anchored on the lexicographically-first module
+        report.findings = [
+            f for f in report.findings
+            if f.path in changed
+            or any(p in changed for p in f.related_paths)]
         # scoping is a reporting filter only: stale-baseline entries are
         # still judged against the full-tree findings above
 

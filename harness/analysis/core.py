@@ -44,7 +44,33 @@ RULES = (
     "lockset-race", "check-then-act", "escape",          # lockset
     "taint-alloc", "taint-cardinality", "taint-loop",    # taint
     "unchecked-decode",                                  # taint
+    "layer-violation", "import-cycle",                   # layers
+    "private-reach", "perimeter-breach",                 # layers
     "waiver-expired",                                    # core (runner)
+)
+
+# checker module -> the rule ids it owns, in run order.  ``--rules``
+# slices use this to run ONLY the owning checkers (the race slice must
+# not pay for the taint fixpoint); ``waiver-expired`` is the runner's
+# own and always runs.
+CHECKERS = (
+    ("lock_discipline", ("lock-discipline",)),
+    ("lock_order", ("lock-order", "fail-under-lock")),
+    ("future_lifecycle", ("future-lifecycle",)),
+    ("determinism", ("determinism",)),
+    ("jit_purity", ("jit-purity",)),
+    ("vocabulary", ("vocabulary",)),
+    ("robustness", ("swallow", "thread-join", "socket-timeout",
+                    "unbounded-queue", "no-print")),
+    ("host_sync", ("host-sync",)),
+    ("recompile", ("recompile-hazard",)),
+    ("transfer", ("transfer-hygiene",)),
+    ("dtypes", ("dtype-promotion",)),
+    ("lockset", ("lockset-race", "check-then-act", "escape")),
+    ("taint", ("taint-alloc", "taint-cardinality", "taint-loop",
+               "unchecked-decode")),
+    ("layers", ("layer-violation", "import-cycle", "private-reach",
+                "perimeter-breach")),
 )
 
 _WAIVER_RE = re.compile(r"#\s*analysis:\s*(.+)$")
@@ -61,6 +87,9 @@ class Finding:
     message: str
     waived: bool = False
     baselined: bool = False
+    # other files this finding spans (cycle members …): ``--diff``
+    # keeps a finding when ANY of them changed, not just the anchor
+    related_paths: tuple = ()
 
     def fingerprint(self) -> tuple[str, str, str, str]:
         return (self.rule, self.path, self.symbol, self.message)
@@ -73,7 +102,8 @@ class Finding:
     def as_json(self) -> dict:
         return {"rule": self.rule, "path": self.path, "line": self.line,
                 "symbol": self.symbol, "message": self.message,
-                "waived": self.waived, "baselined": self.baselined}
+                "waived": self.waived, "baselined": self.baselined,
+                "related_paths": list(self.related_paths)}
 
 
 class SourceFile:
@@ -171,6 +201,23 @@ class SourceFile:
         return m.group(1) or ""
 
 
+def _walk_sources(root: str, paths: tuple[str, ...]):
+    """Absolute paths of every ``.py`` file a scan covers, in walk
+    order — shared by Project and the parse-once cache fingerprint."""
+    for top in paths:
+        top_abs = os.path.join(root, top)
+        if os.path.isfile(top_abs) and top_abs.endswith(".py"):
+            yield top_abs
+            continue
+        for dirpath, dirnames, filenames in os.walk(top_abs):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git",
+                                        ".jax_cache")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
 class Project:
     """All scanned sources plus cross-file lookups checkers share."""
 
@@ -178,18 +225,8 @@ class Project:
         self.root = root
         self.files: list[SourceFile] = []
         self.errors: list[str] = []
-        for top in paths:
-            top_abs = os.path.join(root, top)
-            if os.path.isfile(top_abs) and top_abs.endswith(".py"):
-                self._add(top_abs)
-                continue
-            for dirpath, dirnames, filenames in os.walk(top_abs):
-                dirnames[:] = [d for d in dirnames
-                               if d not in ("__pycache__", ".git",
-                                            ".jax_cache")]
-                for fn in sorted(filenames):
-                    if fn.endswith(".py"):
-                        self._add(os.path.join(dirpath, fn))
+        for abspath in _walk_sources(root, paths):
+            self._add(abspath)
 
     def _add(self, abspath: str) -> None:
         rel = os.path.relpath(abspath, self.root)
@@ -229,6 +266,43 @@ def _strip_frozenset(node: ast.expr) -> ast.expr:
             and len(node.args) == 1):
         return node.args[0]
     return node
+
+
+# -- parse-once project cache -------------------------------------------
+#
+# The analysis gate runs as several slices (analyze / race / taint /
+# layers); driven from one process (harness.analysis.gate) they share
+# a single parsed Project through this memo instead of re-parsing the
+# ~100-file tree per slice.  Keyed on the scan spec, validated against
+# a (path, mtime_ns, size) fingerprint so an edited file invalidates
+# the entry.  A disk cache was measured and rejected: unpickling the
+# ASTs costs more than re-parsing them.
+
+_PROJECT_CACHE: dict[tuple, tuple[tuple, "Project"]] = {}
+
+
+def _tree_fingerprint(root: str, paths: tuple[str, ...]) -> tuple:
+    fp = []
+    for abspath in _walk_sources(root, paths):
+        try:
+            st = os.stat(abspath)
+        except OSError:
+            continue
+        fp.append((abspath, st.st_mtime_ns, st.st_size))
+    return tuple(fp)
+
+
+def load_project(root: str, paths: tuple[str, ...]) -> "Project":
+    """A parsed Project for (root, paths) — memoized on file mtimes, so
+    repeated runs in one process parse the tree exactly once."""
+    key = (os.path.abspath(root), tuple(paths))
+    fingerprint = _tree_fingerprint(root, paths)
+    hit = _PROJECT_CACHE.get(key)
+    if hit is not None and hit[0] == fingerprint:
+        return hit[1]
+    project = Project(root, paths)
+    _PROJECT_CACHE[key] = (fingerprint, project)
+    return project
 
 
 # -- baseline -----------------------------------------------------------
@@ -279,7 +353,8 @@ class Report:
                  elapsed_s: float, stale_baseline: list[dict],
                  errors: list[str],
                  expiring_waivers: list[dict] | None = None,
-                 guarded_by: int = 0, bounded_by: int = 0):
+                 guarded_by: int = 0, bounded_by: int = 0,
+                 checker_seconds: dict[str, float] | None = None):
         self.findings = findings
         self.files = files
         self.elapsed_s = elapsed_s
@@ -293,6 +368,9 @@ class Report:
         self.guarded_by = guarded_by
         # `# bounded-by:` annotations — the declared ingress bounds
         self.bounded_by = bounded_by
+        # wall time per checker module (plus "parse"), for the 30 s
+        # analysis-gate budget: the slice that blew it is named
+        self.checker_seconds = checker_seconds or {}
 
     @property
     def unsuppressed(self) -> list[Finding]:
@@ -324,26 +402,45 @@ class Report:
             "waivers_expiring_30d": self.expiring_waivers,
             "guarded_by_annotations": self.guarded_by,
             "bounded_by_annotations": self.bounded_by,
+            "checker_seconds": {k: round(v, 3) for k, v
+                                in sorted(self.checker_seconds.items())},
         }
 
 
 def run(root: str, paths: tuple[str, ...] = DEFAULT_PATHS,
         rules: tuple[str, ...] | None = None,
         baseline_path: str | None = DEFAULT_BASELINE) -> Report:
-    from harness.analysis import (
-        determinism, dtypes, future_lifecycle, host_sync, jit_purity,
-        lock_discipline, lock_order, lockset, recompile, robustness,
-        taint, transfer, vocabulary,
-    )
+    import importlib
 
     t0 = time.monotonic()
-    project = Project(root, paths)
+    project = load_project(root, paths)
+    checker_seconds: dict[str, float] = {
+        "parse": time.monotonic() - t0}
+    # per-checker finding cache, keyed on the (memoized, immutable)
+    # project: consecutive slices in one gate process run each checker
+    # at most once.  Suppression flags are per-run state (a baselined
+    # finding in one slice must not look baselined to a --no-baseline
+    # slice), so cached findings are handed out as flag-reset copies.
+    cache: dict[str, list[Finding]] = getattr(
+        project, "_finding_cache", None) or {}
+    project._finding_cache = cache
     findings: list[Finding] = []
-    for checker in (lock_discipline, lock_order, future_lifecycle,
-                    determinism, jit_purity, vocabulary, robustness,
-                    host_sync, recompile, transfer, dtypes, lockset,
-                    taint):
-        findings.extend(checker.check(project))
+    for name, owned in CHECKERS:
+        # rule-sliced runs pay only for the owning checkers: the race
+        # slice must not fund the taint fixpoint or the layer graph
+        if rules is not None and not set(owned) & set(rules):
+            continue
+        if name not in cache:
+            checker = importlib.import_module(
+                "harness.analysis." + name)
+            tc = time.monotonic()
+            cache[name] = checker.check(project)
+            checker_seconds[name] = time.monotonic() - tc
+        else:
+            checker_seconds[name] = 0.0  # served from the cache
+        findings.extend(
+            dataclasses.replace(f, waived=False, baselined=False)
+            for f in cache[name])
 
     # waiver expiry: the clock is overridable so tests stay
     # deterministic; an expired waiver both stops suppressing and is a
@@ -415,7 +512,8 @@ def run(root: str, paths: tuple[str, ...] = DEFAULT_PATHS,
         1 for src in project.files for ln in src.lines
         if "bounded-by:" in ln.partition("#")[2])
     return Report(findings, len(project.files), time.monotonic() - t0,
-                  stale, project.errors, expiring, guarded, bounded)
+                  stale, list(project.errors), expiring, guarded,
+                  bounded, checker_seconds)
 
 
 def _plus_days(day: str, days: int) -> str:

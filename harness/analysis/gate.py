@@ -1,0 +1,64 @@
+"""One-process driver for the static-analysis slices in ``make check``.
+
+``make analyze`` / ``make race`` / ``make taint`` / ``make layers``
+remain usable standalone, but chaining them as separate processes
+re-parses the tree and re-imports the framework four times.  This
+driver runs the same four slices — same flags, same exit semantics —
+inside one interpreter, where :func:`core.load_project`'s parse-once
+memoization and the per-checker finding cache make each checker run
+exactly once for the whole gate.  That is what keeps the full analysis
+gate (including the layer rules) inside the 30 s budget.
+
+Exit status is the worst slice status (2 beats 1 beats 0), after ALL
+slices have run — a race finding must not mask a taint finding.
+
+Usage::
+
+    python -m harness.analysis.gate [--diff BASE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from harness.analysis.__main__ import main as run_slice
+
+# (name, extra argv) — mirrors the Makefile targets; the diff-scoped
+# full pass first, then the whole-tree no-baseline rule slices
+SLICES = (
+    ("analyze", []),
+    ("race", ["--no-baseline",
+              "--rules", "lockset-race,check-then-act,escape,"
+                         "waiver-expired"]),
+    ("taint", ["--no-baseline",
+               "--rules", "taint-alloc,taint-cardinality,taint-loop,"
+                          "unchecked-decode"]),
+    ("layers", ["--no-baseline",
+                "--rules", "layer-violation,import-cycle,"
+                           "private-reach,perimeter-breach"]),
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m harness.analysis.gate",
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--diff", metavar="BASE", default=None,
+                    help="diff-scope the full 'analyze' slice to files "
+                         "changed since this git rev (the rule slices "
+                         "always gate the whole tree)")
+    args = ap.parse_args(argv)
+
+    worst = 0
+    for name, extra in SLICES:
+        slice_argv = ["--github"] + list(extra)
+        if name == "analyze" and args.diff is not None:
+            slice_argv += ["--diff", args.diff]
+        print(f"--- analysis gate: {name} ---", flush=True)
+        worst = max(worst, run_slice(slice_argv))
+    return worst
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,11 +18,15 @@ pure-AST symbol tables and edge resolution from ``hotpath.py``.
   an unchecked content-length, an uncapped collection).
 
 **Sources.**  A ``# ingress-entry`` comment on a ``def`` line seeds its
-non-self params RAW; known handler names (``on_gossip``, ``on_direct``,
-``deliver_gossip``, ``_handle_conn`` …) seed RAW by name; the RPC
-dispatch surface (``dispatch``, ``_handle_body``, ``submit_txns``,
-``broadcast_txns``) seeds BOUNDED — the transport layer has already
-length-capped the frame, but every value in it is attacker-chosen.
+non-self params RAW; ``# ingress-entry:bounded`` seeds them BOUNDED —
+the transport layer has already length-capped the frame, but every
+value in it is attacker-chosen.  Known handler names (``on_gossip``,
+``on_direct``, ``deliver_gossip``, ``_handle_conn`` …) seed RAW by
+name and the RPC dispatch surface (``dispatch``, ``_handle_body``,
+``submit_txns``, ``broadcast_txns``) BOUNDED, as a safety net; the
+marks are the canonical source of truth — the perimeter checker
+(``harness/analysis/layers.py``) reads the SAME marks, so the taint
+and architecture passes agree on what the ingress surface is.
 
 **Propagation.**  Assignments, attribute loads off tainted values,
 BinOp/BoolOp/collection displays (join), subscripts, and calls.
@@ -227,7 +231,11 @@ class _Analyzer:
             name = info.qual.rpartition(".")[2]
             comment = info.mod.src.line_comment(info.node.lineno)
             level = None
-            if "ingress-entry" in comment:
+            if "ingress-entry:bounded" in comment:
+                # length-capped transport, attacker-chosen values —
+                # the dispatch/admission family's contract
+                level = BOUNDED
+            elif "ingress-entry" in comment:
                 level = RAW
             elif name in _RAW_ENTRIES:
                 level = RAW

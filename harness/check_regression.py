@@ -44,7 +44,9 @@ against the previous one, and ANY rise in unsuppressed findings for any
 rule fails — zero tolerance, no threshold: suppressions are explicit
 (waiver/baseline), so a rise always means un-reviewed debt landed.
 Rules absent from the previous line count as zero, so a newly added
-rule gates from its first appearance.  The reverse is NOT symmetric:
+rule gates from its first appearance — that is how the architecture
+rules (layer-violation, import-cycle, private-reach, perimeter-breach)
+entered the gate on day one, with no grace window.  The reverse is NOT symmetric:
 a rule present in the previous line but missing from the newest one
 fails outright — a renamed or deleted rule would otherwise silently
 stop gating while its findings kept accumulating.

@@ -35,7 +35,7 @@ from harness.anatomy import AnatomyAssembler
 from harness.slo import SLOEngine
 
 
-def _order_key(ev: dict) -> tuple:
+def _order_key(ev: dict) -> tuple:  # api: _order_key
     return (float(ev.get("ts", 0.0)), str(ev.get("node", "")),
             int(ev.get("seq", 0)), str(ev.get("type", "")))
 

@@ -39,6 +39,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
+from eges_tpu.ingress import admit_remotes
 from eges_tpu.utils import devstats as devstats_mod
 from eges_tpu.utils import journal as journal_mod
 from eges_tpu.utils import ledger as ledger_mod
@@ -725,7 +726,7 @@ def _inject_pool_load(cluster, rows: int = 96) -> None:
     txns = [Transaction(nonce=i, gas_limit=21_000, to=bytes(20),
                         value=0).signed(priv, chain_id=1)
             for i in range(rows)]
-    pool.add_remotes(txns)
+    admit_remotes(pool, txns)
 
 
 # -- rendering ------------------------------------------------------------

@@ -1,0 +1,7 @@
+"""Member of the seeded eager 3-cycle (alpha -> beta -> gamma -> alpha)."""
+
+from pkg.beta import beat
+
+
+def pulse(x):
+    return beat(x)

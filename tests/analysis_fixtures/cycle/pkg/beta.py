@@ -1,0 +1,7 @@
+"""Second member of the seeded cycle — plain-import edge form."""
+
+import pkg.gamma
+
+
+def beat(x):
+    return pkg.gamma.spin(x)

@@ -1,0 +1,7 @@
+"""Clean twin: the back-edge to epsilon is lazy — the sanctioned
+cycle-breaking idiom, so no eager cycle exists here."""
+
+
+def later(x):
+    from pkg.epsilon import ping
+    return ping(x)

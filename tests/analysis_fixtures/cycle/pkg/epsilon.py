@@ -1,0 +1,11 @@
+"""Eagerly imports delta; delta only reaches back lazily."""
+
+from pkg.delta import later
+
+
+def ping(x):
+    return x
+
+
+def relay(x):
+    return later(x)

@@ -1,0 +1,7 @@
+"""Third member — `from pkg import alpha` closes the cycle."""
+
+from pkg import alpha
+
+
+def spin(x):
+    return alpha.pulse(x)

@@ -1,0 +1,7 @@
+"""L1 module: a downward import is the sanctioned direction."""
+
+from pkg.prims.clean import base
+
+
+def serve(x):
+    return base(x)

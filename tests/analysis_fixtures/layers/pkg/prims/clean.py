@@ -1,0 +1,5 @@
+"""L0 leaf with no dependencies — the downward-import target."""
+
+
+def base(x):
+    return x + 1

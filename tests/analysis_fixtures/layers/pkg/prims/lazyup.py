@@ -1,0 +1,12 @@
+"""L0 module: lazy upward imports still count — direction, not timing."""
+
+import importlib
+
+
+def fetch():
+    from pkg.top import app
+    return app
+
+
+def fetch_by_name():
+    return importlib.import_module("pkg.top.app")

@@ -1,0 +1,14 @@
+"""L0 module: one seeded upward import, one waived, one typing-only."""
+
+from typing import TYPE_CHECKING
+
+from pkg.top.app import run_app
+
+from pkg.top.app import hook  # analysis: allow-layer-violation(fixture: deliberate instrumentation hook)
+
+if TYPE_CHECKING:
+    from pkg.top.app import AppType
+
+
+def low(x: "AppType"):
+    return run_app, hook, x

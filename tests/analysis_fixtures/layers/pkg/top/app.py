@@ -1,0 +1,15 @@
+"""L2 module: imports downward only."""
+
+from pkg.mid.svc import serve
+
+
+class AppType:
+    pass
+
+
+def run_app(x):
+    return serve(x)
+
+
+def hook(x):
+    return x

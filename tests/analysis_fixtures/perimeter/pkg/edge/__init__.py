@@ -1,0 +1,14 @@
+"""Blessed ingress facade for the fixture tree.
+
+``unregistered_entry`` is deliberately missing from INGRESS_ENTRIES —
+the registration rule must catch it."""
+
+INGRESS_ENTRIES = frozenset({
+    "recv_frame",
+    "RawFrame",
+    "stray_entry",
+})
+
+
+def recv_via(door, data):
+    return door.recv_frame(data)

@@ -1,0 +1,17 @@
+"""Outside the perimeter: every breach mode, plus a waived one."""
+
+from pkg.edge.door import recv_frame
+from pkg.edge.door import RawFrame
+
+
+def drive(door, data):
+    door.recv_frame(data)
+    return RawFrame(data)
+
+
+# analysis: allow-perimeter-breach(fixture: waiver flip)
+from pkg.edge.door import recv_frame as _waived_recv  # noqa: E402
+
+
+def drive_waived(data):
+    return _waived_recv(data)

@@ -1,0 +1,5 @@
+"""A mark drifting outside the declared perimeter is itself a hole."""
+
+
+def stray_entry(data):  # ingress-entry
+    return data

@@ -1,0 +1,7 @@
+"""Clean twin: routes through the facade's blessed API."""
+
+from pkg.edge import recv_via
+
+
+def drive(door, data):
+    return recv_via(door, data)

@@ -1,0 +1,17 @@
+"""Implementation package: private names, one blessed per kind."""
+
+
+def _hidden(x):
+    return x + 1
+
+
+def _exported(x):  # api: _exported
+    return x + 2
+
+
+class Widget:
+    def _poke(self):
+        return 3
+
+    def _blessed_poke(self):  # api: _blessed_poke
+        return 4

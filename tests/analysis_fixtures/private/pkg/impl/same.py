@@ -1,0 +1,7 @@
+"""Same-package twin: reaching _hidden from inside pkg.impl is fine."""
+
+from pkg.impl.core import _hidden
+
+
+def wrap(x):
+    return _hidden(x)

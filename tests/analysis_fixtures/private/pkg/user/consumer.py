@@ -1,0 +1,21 @@
+"""Cross-package consumer: every private-reach mode, plus the blessed
+and waived counter-examples."""
+
+import pkg.impl.core
+from pkg.impl.core import _hidden
+from pkg.impl.core import _exported
+
+
+def use(widget, x):
+    pkg.impl.core._hidden(x)
+    widget._poke()
+    widget._blessed_poke()
+    return _exported(x) + _hidden(x)
+
+
+# analysis: allow-private-reach(fixture: waiver flip)
+from pkg.impl.core import _hidden as _h  # noqa: E402
+
+
+def use_waived(x):
+    return _h(x)

@@ -482,6 +482,197 @@ def test_bounded_by_and_waiver_flip(tmp_path):
     assert orig.bounded_by == 1
 
 
+# -- architecture conformance (layers / cycles / privacy / perimeter) ----
+
+LAYER_RULES = ("layer-violation", "import-cycle", "private-reach",
+               "perimeter-breach")
+
+
+def test_layer_violation_eager_lazy_and_exemptions():
+    rep = _run_fixture("layers", paths=("pkg",), rules=LAYER_RULES)
+    assert rep.errors == []
+    hits = {(f.path, f.line) for f in rep.unsuppressed}
+    assert hits == {("pkg/prims/low.py", 5),       # eager upward import
+                    ("pkg/prims/lazyup.py", 7),    # lazy in-function
+                    ("pkg/prims/lazyup.py", 12),   # importlib string form
+                    }, [f.render() for f in rep.unsuppressed]
+    # messages name BOTH layers, so the fix direction is obvious
+    for f in rep.unsuppressed:
+        assert "L0-prims" in f.message and "L2-top" in f.message
+    # the waived instrumentation hook is recorded but does not gate
+    assert {f.line for f in rep.findings
+            if f.waived and f.path == "pkg/prims/low.py"} == {7}
+    # TYPE_CHECKING-gated imports never execute and stay quiet
+    assert not any(f.line == 10 for f in rep.findings
+                   if f.path == "pkg/prims/low.py")
+    # downward imports (mid -> prims, top -> mid) are the sanctioned
+    # direction
+    assert not any(f.path.startswith(("pkg/mid/", "pkg/top/"))
+                   for f in rep.findings)
+
+
+def test_manifest_errors_are_loud_not_silent(tmp_path):
+    # a module under a declared root that matches no layer package is a
+    # manifest error (exit 2), never a silent skip
+    root = tmp_path / "tree"
+    (root / "pkg").mkdir(parents=True)
+    (root / "ARCHITECTURE.toml").write_text(
+        'roots = ["pkg"]\n\n[[layer]]\nname = "only"\n'
+        'packages = ["pkg"]\n')
+    (root / "pkg" / "__init__.py").write_text("")
+    (root / "pkg" / "stray.py").write_text("X = 1\n")
+    rep = run(str(root), paths=("pkg",), rules=LAYER_RULES,
+              baseline_path=None)
+    assert any("pkg.stray" in e and "matches no layer package" in e
+               for e in rep.errors), rep.errors
+    proc = subprocess.run(
+        [sys.executable, "-m", "harness.analysis", "--root", str(root),
+         "--no-baseline", "pkg"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+
+    # an unparseable manifest is equally loud (separate root: projects
+    # are memoized per process, and only .py edits invalidate the memo)
+    bad = tmp_path / "tree2"
+    (bad / "pkg").mkdir(parents=True)
+    (bad / "pkg" / "__init__.py").write_text("")
+    (bad / "ARCHITECTURE.toml").write_text("layers = {bogus}\n")
+    rep = run(str(bad), paths=("pkg",), rules=LAYER_RULES,
+              baseline_path=None)
+    assert any("architecture manifest" in e for e in rep.errors), rep.errors
+
+
+def test_import_cycle_anchor_members_and_lazy_twin():
+    rep = _run_fixture("cycle", paths=("pkg",), rules=LAYER_RULES)
+    assert rep.errors == []
+    assert len(rep.unsuppressed) == 1, [
+        f.render() for f in rep.unsuppressed]
+    f = rep.unsuppressed[0]
+    assert f.rule == "import-cycle"
+    # anchored on the lexicographically-first member — fingerprints stay
+    # stable no matter which edge changed
+    assert f.path == "pkg/alpha.py"
+    assert f.symbol == "cycle:pkg.alpha,pkg.beta,pkg.gamma"
+    # every member is recorded, so --diff matches on membership
+    assert f.related_paths == ("pkg/alpha.py", "pkg/beta.py",
+                               "pkg/gamma.py")
+    assert "pkg.alpha -> pkg.beta -> pkg.gamma -> pkg.alpha" in f.message
+    # delta <-> epsilon is broken by a lazy import: no cycle
+    assert not any("delta" in f2.symbol for f2 in rep.findings)
+
+
+def test_cli_diff_reports_cycle_when_any_member_changes(tmp_path):
+    import shutil
+    root = str(tmp_path / "tree")
+    shutil.copytree(os.path.join(FIXTURES, "cycle"), root)
+    _git(root, "init", "-q")
+    _git(root, "add", ".")
+    _git(root, "commit", "-qm", "seed")
+
+    def cli(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "harness.analysis", "--root", root,
+             "--no-baseline", *extra, "pkg"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+
+    # nothing changed since HEAD: the scoped run passes
+    assert cli("--diff", "HEAD").returncode == 0
+
+    # touching a NON-anchor member surfaces the cycle, reported at its
+    # anchor file — membership decides scope, not anchor identity
+    with open(os.path.join(root, "pkg", "gamma.py"), "a") as fh:
+        fh.write("\n# touched\n")
+    proc = cli("--diff", "HEAD")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "pkg/alpha.py" in proc.stdout
+    _git(root, "commit", "-aqm", "touch member")
+
+    # touching a file outside the cycle stays clean
+    with open(os.path.join(root, "pkg", "delta.py"), "a") as fh:
+        fh.write("\n# touched\n")
+    assert cli("--diff", "HEAD").returncode == 0
+
+
+def test_private_reach_modes_blessing_and_waiver():
+    rep = _run_fixture("private", paths=("pkg",), rules=LAYER_RULES)
+    assert rep.errors == []
+    got = {(f.line, f.symbol) for f in rep.unsuppressed}
+    assert got == {
+        (5, "pkg.user.consumer -> pkg.impl.core._hidden"),   # import
+        (10, "pkg.user.consumer -> pkg.impl.core._hidden"),  # module attr
+        (11, "pkg.user.consumer -> pkg.impl.core._poke"),    # obj._method
+    }, [f.render() for f in rep.unsuppressed]
+    # `# api:` blessings and same-package reach stay quiet
+    assert not any("_exported" in f.symbol or "_blessed_poke" in f.symbol
+                   for f in rep.findings)
+    assert not any(f.path == "pkg/impl/same.py" for f in rep.findings)
+    # the inline waiver flips the aliased re-import out of the gate
+    assert {f.line for f in rep.findings if f.waived} == {17}
+
+
+def test_api_blessing_is_load_bearing(tmp_path):
+    import shutil
+    root = str(tmp_path / "private")
+    shutil.copytree(os.path.join(FIXTURES, "private"), root)
+    p = os.path.join(root, "pkg", "impl", "core.py")
+    src = open(p).read()
+    with open(p, "w") as fh:
+        fh.write(src.replace("  # api: _exported", "")
+                 .replace("  # api: _blessed_poke", ""))
+    rep = run(root, paths=("pkg",), rules=LAYER_RULES, baseline_path=None)
+    syms = {f.symbol for f in rep.unsuppressed}
+    assert "pkg.user.consumer -> pkg.impl.core._exported" in syms, syms
+    assert "pkg.user.consumer -> pkg.impl.core._blessed_poke" in syms, syms
+
+
+def test_perimeter_breach_modes_facade_and_stray_mark():
+    rep = _run_fixture("perimeter", paths=("pkg",), rules=LAYER_RULES)
+    assert rep.errors == []
+    got = {(f.path, f.line) for f in rep.unsuppressed}
+    assert got == {
+        ("pkg/inner/breach.py", 3),   # imports the entry fn
+        ("pkg/inner/breach.py", 4),   # imports the raw-ingress type
+        ("pkg/inner/breach.py", 8),   # bound-method reference
+        ("pkg/inner/breach.py", 9),   # constructs the raw type
+        ("pkg/inner/leak.py", 4),     # mark outside the perimeter
+        ("pkg/edge/__init__.py", 1),  # unregistered mark in the facade
+    }, [f.render() for f in rep.unsuppressed]
+    by_sym = {f.symbol: f.message for f in rep.unsuppressed}
+    assert "INGRESS_ENTRIES:unregistered_entry" in by_sym
+    assert "pkg.inner.leak.stray_entry" in by_sym
+    # the facade route and the perimeter's own internals stay quiet
+    assert not any(f.path == "pkg/inner/ok.py" for f in rep.findings)
+    assert not any(f.path == "pkg/edge/door.py" for f in rep.findings)
+    assert {f.line for f in rep.findings if f.waived} == {13}
+
+
+def test_report_checker_seconds_and_project_memoization():
+    from harness.analysis import core
+    root = os.path.join(FIXTURES, "cycle")
+    rep = run(root, paths=("pkg",), baseline_path=None)
+    assert "parse" in rep.checker_seconds
+    assert "layers" in rep.checker_seconds
+    assert rep.summary_json()["checker_seconds"]["layers"] >= 0
+    # parse-once: a second load in this process reuses the same Project
+    p1 = core.load_project(root, ("pkg",))
+    p2 = core.load_project(root, ("pkg",))
+    assert p1 is p2
+    # touching a file invalidates the memo
+    path = os.path.join(root, "pkg", "delta.py")
+    st = os.stat(path)
+    os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 1))
+    assert core.load_project(root, ("pkg",)) is not p2
+
+
+def test_cli_gate_driver_runs_all_slices():
+    proc = subprocess.run(
+        [sys.executable, "-m", "harness.analysis.gate"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for name in ("analyze", "race", "taint", "layers"):
+        assert f"--- analysis gate: {name} ---" in proc.stdout
+
+
 # -- the CI gate over the real tree --------------------------------------
 
 def test_repo_tree_has_zero_unsuppressed_findings():
@@ -520,8 +711,15 @@ def test_cli_gate_exit_codes_and_summary(tmp_path):
                                              "taint-cardinality",
                                              "taint-loop",
                                              "unchecked-decode",
+                                             "layer-violation",
+                                             "import-cycle",
+                                             "private-reach",
+                                             "perimeter-breach",
                                              "waiver-expired"}
     assert line["waivers_expiring_30d"] == []
+    # per-checker wall time, for attributing a blown 30 s gate budget
+    assert set(line["checker_seconds"]) >= {"parse", "taint", "layers"}
+    assert all(v >= 0 for v in line["checker_seconds"].values())
     # the real tree carries explicit guarded-by contracts, and the
     # trend line counts them so a mass deletion is visible
     assert line["guarded_by_annotations"] > 0
@@ -551,6 +749,10 @@ def test_cli_gate_exit_codes_and_summary(tmp_path):
     ("taintcard", "pkg"),      # seeded unbounded key/label minting
     ("taintloop", "pkg"),      # seeded unvalidated wire iteration
     ("decode", "pkg"),         # seeded length-gate-free parsers
+    ("layers", "pkg"),         # seeded upward (eager+lazy) imports
+    ("cycle", "pkg"),          # seeded eager 3-cycle
+    ("private", "pkg"),        # seeded cross-package private reach
+    ("perimeter", "pkg"),      # seeded ingress-perimeter breaches
 ])
 def test_cli_exits_nonzero_on_each_seeded_concurrency_bug(tree, paths):
     proc = subprocess.run(
@@ -606,13 +808,22 @@ def test_cli_sarif_output(tmp_path):
     assert doc["version"] == "2.1.0"
     run_ = doc["runs"][0]
     assert run_["tool"]["driver"]["name"] == "eges-analysis"
-    assert [r["id"] for r in run_["tool"]["driver"]["rules"]] == [
-        "taint-alloc"]
+    # the driver rules table enumerates EVERY registered rule exactly
+    # once (SARIF consumers key severity/metadata off it), not just the
+    # rules that happened to fire on this tree
+    from harness.analysis.core import RULES
+    rule_ids = [r["id"] for r in run_["tool"]["driver"]["rules"]]
+    assert rule_ids == list(RULES)
+    assert {"layer-violation", "import-cycle", "private-reach",
+            "perimeter-breach"} <= set(rule_ids)
     locs = {(res["ruleId"],
              res["locations"][0]["physicalLocation"]["region"]["startLine"])
             for res in run_["results"]}
     assert locs == {("taint-alloc", 13), ("taint-alloc", 14),
                     ("taint-alloc", 15), ("taint-alloc", 24)}
+    # every result's ruleIndex points back at its row in the table
+    for res in run_["results"]:
+        assert rule_ids[res["ruleIndex"]] == res["ruleId"]
     # a clean tree still writes a valid log, with zero results
     proc = subprocess.run(
         [sys.executable, "-m", "harness.analysis", "--sarif", out],
@@ -730,6 +941,14 @@ def test_check_regression_analysis_gate(tmp_path):
     # a rule that DISAPPEARS from the newest line fails outright: a
     # renamed or deleted checker must not silently stop gating
     write({"swallow": 0, "lockset-race": 0}, {"swallow": 0})
+    assert gate([hist, "--analysis"]) == 1
+
+    # the architecture rules gate from day one: a rise in any of the
+    # four fails even while every other count is flat
+    write({"layer-violation": 0, "import-cycle": 0, "private-reach": 0,
+           "perimeter-breach": 0},
+          {"layer-violation": 1, "import-cycle": 0, "private-reach": 0,
+           "perimeter-breach": 0})
     assert gate([hist, "--analysis"]) == 1
 
     # torn/non-summary lines are skipped, like the bench history loader
