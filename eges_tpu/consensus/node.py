@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+from collections import deque
 from dataclasses import dataclass
 
 from eges_tpu.consensus import messages as M
@@ -158,7 +159,12 @@ class GeecNode:
         self.empty_block_list: list[int] = []
         self.pending_regs: dict[bytes, Registration] = {}
         self.registered = self.coinbase in self.membership
-        self.pending_geec_txns: list[Transaction] = []
+        # deque, not list: the flood path sheds oldest-first and a
+        # list.pop(0) there is O(backlog) per shed row.  The cap check
+        # stays explicit (no maxlen=) — eviction must bill the ledger
+        # and bump the dropped counter, and chaos scenarios retune the
+        # cap per instance at runtime.
+        self.pending_geec_txns: deque[Transaction] = deque()
         self._proposal_geec_txns: list[Transaction] = []
         self._txn_seen: set[bytes] = set()
         self._sync_target = 0
@@ -182,9 +188,18 @@ class GeecNode:
         self.geec_txn_sink = None  # app-layer callback for confirmed geec txns
         self.txpool = None  # optional TxPool; proposals drain it
         #                     (property: attaching one wires the journal)
+        # columnar ingest hook (ROADMAP item 5): an injectable
+        # txns -> TxColumns extractor (eges_tpu.ingress.columns_of).
+        # Injected rather than imported — consensus sits below the
+        # ingress package in the layer map — by whatever wires the node
+        # (sim/cluster.py, the node runner).  When set, multi-txn
+        # gossip bundles admit window-granular via add_remotes_window;
+        # singletons keep the legacy per-tx path.
+        self.columnarize = None
 
-        # deferred messages for future working blocks (Wait() analogue)
-        self._deferred: list[tuple[int, object]] = []  # (blk_num, thunk)
+        # deferred messages for future working blocks (Wait() analogue);
+        # deque for the same O(1) oldest-first shedding as above
+        self._deferred: deque[tuple[int, object]] = deque()  # (blk_num, thunk)
 
         # proposer phase state
         self._phase = IDLE
@@ -474,8 +489,9 @@ class GeecNode:
         with self._lock:
             if len(self.pending_geec_txns) >= self.GEEC_PENDING_MAX:
                 # backlog full: shed the oldest so a txn flood cannot
-                # pin memory ahead of the next proposal drain
-                self.pending_geec_txns.pop(0)
+                # pin memory ahead of the next proposal drain — O(1)
+                # on the deque even at flood scale
+                self.pending_geec_txns.popleft()
                 metrics.counter("consensus.geec_txn_dropped").inc()
                 ledger.charge(drops=1)
             self.pending_geec_txns.append(geec_txn(payload))
@@ -486,7 +502,7 @@ class GeecNode:
         if len(self._deferred) >= self.DEFER_MAX:
             # depth cap: a peer stuffing far-future waits evicts the
             # oldest deferral instead of growing the queue unboundedly
-            self._deferred.pop(0)
+            self._deferred.popleft()
             metrics.counter("consensus.deferred_dropped").inc()
             ledger.charge(drops=1)
         self._deferred.append((blk, thunk))
@@ -497,8 +513,8 @@ class GeecNode:
 
     def _drain_deferred(self) -> None:
         ready = [(b, t) for (b, t) in self._deferred if b <= self.wb.blk_num]
-        self._deferred = [(b, t) for (b, t) in self._deferred
-                          if b > self.wb.blk_num]
+        self._deferred = deque((b, t) for (b, t) in self._deferred
+                               if b > self.wb.blk_num)
         from eges_tpu.utils.metrics import DEFAULT as metrics
         metrics.gauge("consensus.deferred_depth").set(len(self._deferred))
         if ready:
@@ -638,8 +654,8 @@ class GeecNode:
         regs = tuple(self.pending_regs[a] for a in
                      sorted(self.pending_regs)[: self.ccfg.max_reg_per_blk])
         n = min(len(self.pending_geec_txns), self.cfg.txn_per_block)
-        geec_txns = tuple(self.pending_geec_txns[:n])
-        self.pending_geec_txns = self.pending_geec_txns[n:]
+        geec_txns = tuple(self.pending_geec_txns.popleft()
+                          for _ in range(n))
         # remember the drained txns so an aborted proposal re-queues them
         # instead of silently dropping UDP-ingested transactions
         self._proposal_geec_txns = list(geec_txns)
@@ -828,7 +844,7 @@ class GeecNode:
             # an aborted proposal returns its geec txns to the front of
             # the queue; duplicates vs a block that actually included
             # them are removed again at ingest time
-            self.pending_geec_txns = drained + self.pending_geec_txns
+            self.pending_geec_txns.extendleft(reversed(drained))
         self._proposal_geec_txns = []
         self._cancel_timer("election")
         self._cancel_timer("validate")
@@ -1198,7 +1214,12 @@ class GeecNode:
             # network-wide fan-out amplification (the reference relays
             # only pool-accepted txns, eth/handler.go:742-759)
             self._ensure_pool_relay()
-            self.txpool.add_remotes(fresh)
+            if self.columnarize is not None and len(fresh) > 1:
+                # wire-speed path: one columnar extraction + one
+                # window-granular admission for the whole bundle
+                self.txpool.add_remotes_window(self.columnarize(fresh))
+            else:
+                self.txpool.add_remotes(fresh)
         else:
             # pool-less follower: relay with dedup so txns still
             # propagate through it (marked seen either way)
@@ -1860,8 +1881,9 @@ class GeecNode:
             # (the abort below would otherwise re-queue them after this
             # dedup already ran)
             included = {t.hash for t in blk.geec_txns}
-            self.pending_geec_txns = [
-                t for t in self.pending_geec_txns if t.hash not in included]
+            self.pending_geec_txns = deque(
+                t for t in self.pending_geec_txns
+                if t.hash not in included)
             if self._proposal_geec_txns:
                 self._proposal_geec_txns = [
                     t for t in self._proposal_geec_txns

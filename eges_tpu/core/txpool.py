@@ -23,6 +23,22 @@ from eges_tpu.utils import ledger
 from eges_tpu.utils import tracing
 
 
+class _WindowChunk:
+    """A columnar window's fresh rows queued for the verify flush.
+
+    Rides the same ``_queue`` as scalar ``Transaction`` entries so
+    mixed arrivals (windows from gossip, singletons from RPC) flush in
+    strict arrival order; ``rows`` indexes the still-live rows of the
+    shared ``TxColumns`` and shrinks in place when a flush slice splits
+    the chunk at a ``max_batch`` boundary."""
+
+    __slots__ = ("cols", "rows")
+
+    def __init__(self, cols, rows):
+        self.cols = cols
+        self.rows = rows  # list of row indices into cols, arrival order
+
+
 class TxPool:
     def __init__(self, clock, verifier=None, *, window_ms: float = 5.0,
                  max_batch: int = 1024, max_pending: int = 100_000,
@@ -55,10 +71,17 @@ class TxPool:
         self._by_hash: dict[bytes, tuple[bytes, int]] = {}  # guarded-by: _lock
         self._dead: set[bytes] = set()  # guarded-by: _lock
         self._known: set[bytes] = set()  # guarded-by: _lock
-        self._queue: list[Transaction] = []  # guarded-by: _lock
+        # verify queue: scalar Transactions interleaved with columnar
+        # _WindowChunk entries in strict arrival order (mixed arrivals
+        # must flush exactly like an all-scalar stream); _queue_rows is
+        # the ROW count (a chunk is many rows), the unit max_batch and
+        # the flush trigger are denominated in
+        self._queue: list = []  # guarded-by: _lock
+        self._queue_rows = 0  # guarded-by: _lock
+        self._window_chunks = 0  # guarded-by: _lock
         self._timer = None
         self.stats = {"admitted": 0, "rejected": 0, "duplicate": 0,  # guarded-by: _lock
-                      "batches": 0}
+                      "batches": 0, "replaced": 0}
         # distributed-tracing linkage: per-txn SpanContext captured at
         # ingest.  The flush runs on a clock callback where contextvars
         # don't survive, so the context is carried here explicitly and
@@ -117,21 +140,118 @@ class TxPool:
                     metrics.DEFAULT.counter("txpool.known_clears").inc()
                 self._known.add(h)
                 self._queue.append(t)
+                self._queue_rows += 1
+                # one capacity probe covers all three bookkeeping maps:
+                # they fill together here and the thread-hygiene counter
+                # reconciliation assumes a uniform cap across them
                 if len(self._ingest_ctx) < self._INGEST_CTX_CAP:
                     self._ingest_ctx[h] = ctx
-                if len(self._ingest_t) < self._INGEST_CTX_CAP:
                     self._ingest_t[h] = self.clock.now()
-                rec = ledger.current()
-                if rec is not None and \
-                        len(self._ingest_origin) < self._INGEST_CTX_CAP:
-                    self._ingest_origin[h] = rec
+                    rec = ledger.current()
+                    if rec is not None:
+                        self._ingest_origin[h] = rec
                 fresh += 1
             sp.set_attr("fresh", fresh)
-            if len(self._queue) >= self.max_batch:
+            if self._queue_rows >= self.max_batch:
                 self._flush()
             elif self._queue and self._timer is None:
                 self._timer = self.clock.call_later(self.window_ms / 1e3,
                                                     self._on_window)
+
+    def add_remotes_window(self, cols) -> None:  # thread-entry (gossip relay); ingress-entry:bounded
+        """Columnar window admission: ONE lock hold and ONE tracing span
+        for the whole window, dedup against ``_known`` via set ops, and
+        per-window (not per-tx) bookkeeping — the batched sibling of
+        :meth:`add_remotes` with row-for-row identical admission
+        outcomes, journal events and ledger billing (the differential
+        test's contract).  ``cols`` is an ``ingress.columnar.TxColumns``
+        duck type: this layer consumes the arrays, it never imports the
+        decoder (core stays below ingress in the layer map)."""
+        with self._lock, \
+                tracing.DEFAULT.span("txpool.ingest", owner=self.owner) as sp:
+            ctx = sp.context()
+            hashes = cols.hashes
+            n_undec = cols.n - int(cols.decoded.sum())
+            if n_undec:
+                # no identity survives a failed decode: billed to the
+                # deliverer as pure waste, dropped pre-queue (the legacy
+                # path never sees such rows — its codec drops them)
+                ledger.charge(drops=n_undec)
+                from eges_tpu.utils import metrics
+                metrics.DEFAULT.counter("txpool.window_undecoded").inc(
+                    n_undec)
+            hs = hashes if not n_undec else \
+                [h for h in hashes if h is not None]
+            known = self._known
+            dup = 0
+            if len(known) + len(hs) < self._KNOWN_CAP:
+                # fast path: the cap cannot trip mid-window, so dedup is
+                # two C-level set ops instead of a per-row probe loop
+                uniq = set(hs)
+                if len(uniq) == len(hs):
+                    dups = uniq & known
+                    if dups:
+                        dup = len(dups)
+                        fresh_rows = [i for i, h in enumerate(hashes)
+                                      if h is not None and h not in dups]
+                    elif not n_undec:
+                        fresh_rows = list(range(cols.n))  # bounded-by: cols.n == len of ONE delivered gossip window (pre-decode INGRESS_MAX_BYTES datagram cap upstream)
+                    else:
+                        fresh_rows = [i for i, h in enumerate(hashes)
+                                      if h is not None]
+                    known.update(uniq)
+                else:
+                    fresh_rows = self._dedup_rows_slow(hashes)
+                    dup = len(hs) - len(fresh_rows)
+            else:
+                # cap boundary: replicate the per-row coarse-clear
+                # semantics exactly (a clear mid-window re-admits
+                # earlier duplicates, same as the scalar path would)
+                fresh_rows = self._dedup_rows_slow(hashes)
+                dup = len(hs) - len(fresh_rows)
+            if dup:
+                self.stats["duplicate"] += dup
+                # ambient charge, aggregated: N same-origin unit drops
+                # at one timestamp equal one summed drop charge
+                ledger.charge(drops=dup)
+            if fresh_rows:
+                now = self.clock.now()
+                rec = ledger.current()
+                room = self._INGEST_CTX_CAP - len(self._ingest_ctx)
+                book = fresh_rows[:room] if room < len(fresh_rows) \
+                    else fresh_rows
+                if book:
+                    self._ingest_ctx.update((hashes[i], ctx) for i in book)
+                    self._ingest_t.update((hashes[i], now) for i in book)
+                    if rec is not None:
+                        self._ingest_origin.update(
+                            (hashes[i], rec) for i in book)
+                self._queue.append(_WindowChunk(cols, fresh_rows))
+                self._window_chunks += 1
+                self._queue_rows += len(fresh_rows)
+            sp.set_attr("fresh", len(fresh_rows) if fresh_rows else 0)
+            if self._queue_rows >= self.max_batch:
+                self._flush()
+            elif self._queue and self._timer is None:
+                self._timer = self.clock.call_later(self.window_ms / 1e3,
+                                                    self._on_window)
+
+    def _dedup_rows_slow(self, hashes) -> list[int]:
+        """Per-row dedup replica of the scalar loop — the path taken
+        when the window carries intra-window duplicates or could trip
+        the ``_KNOWN_CAP`` coarse clear mid-window."""
+        fresh_rows = []
+        known = self._known
+        for i, h in enumerate(hashes):
+            if h is None or h in known:
+                continue
+            if len(known) >= self._KNOWN_CAP:
+                known.clear()
+                from eges_tpu.utils import metrics
+                metrics.DEFAULT.counter("txpool.known_clears").inc()
+            known.add(h)
+            fresh_rows.append(i)
+        return fresh_rows
 
     def _on_window(self) -> None:
         with self._lock:
@@ -142,10 +262,14 @@ class TxPool:
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
+        if self._window_chunks:
+            self._flush_mixed()
+            return
         batch, self._queue = self._queue[: self.max_batch], \
             self._queue[self.max_batch:]
         if not batch:
             return
+        self._queue_rows -= len(batch)
         self.stats["batches"] += 1
         parts = [t.signature_parts() for t in batch]
         senders: list[bytes | None] = [None] * len(batch)
@@ -172,11 +296,153 @@ class TxPool:
         if self._queue:
             self._flush()
 
-    def _ledger_charge(self, h: bytes, **counts) -> None:
+    def _flush_mixed(self) -> None:
+        """Row-granular flush for a queue holding columnar window
+        chunks (possibly interleaved with scalar txns): each
+        ``max_batch``-row slice makes ONE ``recover_signers_window``
+        call over arrays gathered straight out of the columns — no
+        per-row ``signature_parts``, no per-row entry tuples — and
+        ``Transaction`` objects materialize only for rows that admit.
+        Outcome order matches the scalar ``_flush`` row for row."""
+        import numpy as np
+
+        while self._queue:
+            take: list = []
+            rows_n = 0
+            qi = 0
+            consumed_chunks = 0
+            while qi < len(self._queue) and rows_n < self.max_batch:
+                item = self._queue[qi]
+                if isinstance(item, _WindowChunk):
+                    need = self.max_batch - rows_n
+                    if len(item.rows) <= need:
+                        take.append(item)
+                        rows_n += len(item.rows)
+                        consumed_chunks += 1
+                        qi += 1
+                    else:  # split: head flushes now, tail stays queued
+                        take.append(_WindowChunk(item.cols,
+                                                 item.rows[:need]))
+                        item.rows = item.rows[need:]
+                        rows_n += need
+                else:
+                    take.append(item)
+                    rows_n += 1
+                    qi += 1
+            self._queue = self._queue[qi:]
+            self._window_chunks -= consumed_chunks
+            self._queue_rows -= rows_n
+            self.stats["batches"] += 1
+            # flat row map in arrival order; gather valid rows' arrays
+            flat: list = []  # (cols|txn, row_index|None) per output row
+            vh, vs, vpos = [], [], []
+            for item in take:
+                if isinstance(item, _WindowChunk):
+                    c, rs = item.cols, item.rows
+                    base = len(flat)
+                    flat.extend((c, i) for i in rs)
+                    rs_arr = np.asarray(rs, dtype=np.int64)
+                    mask = c.valid[rs_arr]
+                    sel = rs_arr[mask]
+                    if sel.size:
+                        vh.append(c.sighash[sel])
+                        vs.append(c.sig[sel])
+                        vpos.extend(
+                            (base + np.nonzero(mask)[0]).tolist())
+                else:
+                    pos = len(flat)
+                    flat.append((item, None))
+                    p = item.signature_parts()
+                    if p is not None:
+                        sig, h = p
+                        vh.append(np.frombuffer(h, np.uint8)
+                                  .reshape(1, 32))
+                        vs.append(np.frombuffer(sig, np.uint8)
+                                  .reshape(1, 65))
+                        vpos.append(pos)
+            senders: list = [None] * len(flat)
+            if vpos:
+                from eges_tpu.crypto.verify_host import \
+                    recover_signers_window
+                rec = recover_signers_window(
+                    vh[0] if len(vh) == 1 else np.concatenate(vh),
+                    vs[0] if len(vs) == 1 else np.concatenate(vs),
+                    self.verifier)
+                for pos, sender in zip(vpos, rec):
+                    senders[pos] = sender
+            rej: list = []
+            # ONE admit span for the whole slice's window rows (spans
+            # are ring-buffer telemetry, never journaled — admission
+            # outcomes, billing and relay order stay per-row identical
+            # to the scalar path); scalar interlopers keep their own
+            # per-row span via _admit
+            wcm = wsp = None
+            amb = ledger.current()  # stable for the whole slice
+            try:
+                for j, (obj, li) in enumerate(flat):
+                    sender = senders[j]
+                    if sender is None:
+                        self.stats["rejected"] += 1
+                        rej.append(obj.hash if li is None
+                                   else obj.hashes[li])
+                    elif li is None:
+                        self._admit(obj, sender)
+                    else:
+                        t = obj.txn(li)
+                        if wcm is None:
+                            ctx = self._ingest_ctx.get(t.hash) \
+                                or tracing.DEFAULT.current_context()
+                            wcm = tracing.DEFAULT.span(
+                                "txpool.admit_window", parent=ctx,
+                                owner=self.owner, rows=len(flat))
+                            wsp = wcm.__enter__()
+                        self._admit_traced(t, sender, wsp, batched=True,
+                                           amb=amb)
+            finally:
+                if wcm is not None:
+                    wcm.__exit__(None, None, None)
+                    # slice-deferred housekeeping (see _admit_traced)
+                    self._maybe_compact()
+                    self._depth_gauge()
+            if rej:
+                self._ledger_charge_many(rej, rejects=1)
+
+    def _ledger_charge_many(self, hashes, **counts) -> None:
+        """Aggregated flush billing: ONE ``charge()`` per (ledger,
+        origin) group — N same-origin unit outcomes at one virtual
+        timestamp sum to the same ledger state as N unit charges (the
+        decay is lazy, applied per charge timestamp)."""
+        amb = ledger.current()
+        groups: dict = {}
+        order: list = []
+        for h in hashes:
+            rec = self._ingest_origin.pop(h, None) or amb
+            if rec is None:
+                continue
+            key = (id(rec[0]), rec[1])
+            slot = groups.get(key)
+            if slot is None:
+                groups[key] = [rec, 1]
+                order.append(key)
+            else:
+                slot[1] += 1
+        for key in order:
+            (led, origin), n = groups[key]
+            led.charge(origin, **{k: v * n for k, v in counts.items()})
+
+    # sentinel: "caller did not pre-resolve the ambient ledger pair"
+    _NO_AMB = object()
+
+    def _ledger_charge(self, h: bytes, _amb=_NO_AMB, **counts) -> None:
         """Charge a flush outcome to the origin captured at ingest (the
         flush runs on a clock callback with no ambient binding); falls
-        back to the ambient pair, no-op when neither exists."""
-        rec = self._ingest_origin.pop(h, None) or ledger.current()
+        back to the ambient pair, no-op when neither exists.  ``_amb``
+        lets a window flush resolve :func:`ledger.current` once per
+        slice instead of per row — the ambient binding cannot change
+        mid-flush (one clock callback, one thread)."""
+        rec = self._ingest_origin.pop(h, None)
+        if rec is None:
+            rec = ledger.current() if _amb is self._NO_AMB else _amb
         if rec is not None:
             led, origin = rec
             led.charge(origin, **counts)
@@ -195,7 +461,14 @@ class TxPool:
                                   tx=t.hash.hex()[:16]) as sp:
             self._admit_traced(t, sender, sp)
 
-    def _admit_traced(self, t: Transaction, sender: bytes, sp) -> None:
+    def _admit_traced(self, t: Transaction, sender: bytes, sp,
+                      batched: bool = False, amb=_NO_AMB) -> None:
+        """Admission body.  ``batched=True`` (the window flush) defers
+        the per-row housekeeping that is slice-equivalent: the depth
+        gauge and ``_order`` compaction run once after the slice, and
+        the shared window span skips per-row outcome attrs (on a
+        shared span they are last-write-wins noise; the per-row
+        outcomes live in ``stats`` and the ledger either way)."""
         by_nonce = self.pending.setdefault(sender, {})
         old = by_nonce.get(t.nonce)
         if old is None and len(self._by_hash) >= self.max_pending:
@@ -203,8 +476,9 @@ class TxPool:
             # keeps the pool size constant and must stay possible even
             # when full (ref: core/tx_pool.go admits replacements)
             self.stats["rejected"] += 1
-            self._ledger_charge(t.hash, rejects=1, sender=sender)
-            sp.set_attr("outcome", "rejected")
+            self._ledger_charge(t.hash, amb, rejects=1, sender=sender)
+            if not batched:
+                sp.set_attr("outcome", "rejected")
             if not by_nonce:
                 del self.pending[sender]
             return
@@ -212,22 +486,24 @@ class TxPool:
             # price-bump replacement (ref: core/tx_pool.go:571+)
             if t.gas_price * 100 < old.gas_price * (100 + self.PRICE_BUMP_PCT):
                 self.stats["duplicate"] += 1
-                self._ledger_charge(t.hash, drops=1, sender=sender)
-                sp.set_attr("outcome", "duplicate")
+                self._ledger_charge(t.hash, amb, drops=1, sender=sender)
+                if not batched:
+                    sp.set_attr("outcome", "duplicate")
                 return
             self._by_hash.pop(old.hash, None)
             self._dead.add(old.hash)
-            self.stats["replaced"] = self.stats.get("replaced", 0) + 1
+            self.stats["replaced"] += 1
         by_nonce[t.nonce] = t
         self._order.append((sender, t))
         self._by_hash[t.hash] = (sender, t.nonce)
         if len(self._admit_t) < self._INGEST_CTX_CAP:
             self._admit_t[t.hash] = self.clock.now()
-        self._maybe_compact()
         self.stats["admitted"] += 1
-        self._ledger_charge(t.hash, admits=1, sender=sender)
-        self._depth_gauge()
-        sp.set_attr("outcome", "admitted")
+        self._ledger_charge(t.hash, amb, admits=1, sender=sender)
+        if not batched:
+            self._maybe_compact()
+            self._depth_gauge()
+            sp.set_attr("outcome", "admitted")
         if self.on_admitted is not None:
             # still inside the admit span: a broadcast hook fired here
             # injects this trace into the outbound gossip envelope

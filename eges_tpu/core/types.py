@@ -187,7 +187,18 @@ class Transaction:
 
     @property
     def hash(self) -> bytes:
-        return keccak256(self.encode())
+        # memoized: admission touches the hash several times per row
+        # (dedup keys, ledger billing, trace tags) and each recompute
+        # is a full RLP re-encode + keccak.  The instance is frozen, so
+        # the cached digest can never go stale; the columnar ingest
+        # decoder seeds it straight from the wire frame's keccak
+        # (keccak256(frame) == keccak256(encode()) because RLP is
+        # strictly canonical) so window rows never re-encode at all.
+        h = self._SENDER_CACHE.get("hash")
+        if h is None:
+            h = keccak256(self.encode())
+            self._SENDER_CACHE["hash"] = h
+        return h
 
     # -- signing ----------------------------------------------------------
 

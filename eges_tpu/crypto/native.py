@@ -44,6 +44,12 @@ def _load():
     lib.geec_ec_recover_batch.argtypes = [
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
         ctypes.c_char_p, ctypes.c_char_p]
+    try:  # variable-length keccak batch; absent in old builds
+        lib.geec_keccak256_multi.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64, ctypes.c_char_p]
+    except AttributeError:
+        pass
     try:  # election component (native/election.cpp); absent in old builds
         lib.geec_window_check.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
@@ -65,6 +71,21 @@ def keccak256(data: bytes) -> bytes:
     lib = _load()
     out = ctypes.create_string_buffer(32)
     lib.geec_keccak256(data, len(data), out)
+    return out.raw
+
+
+def keccak256_multi(data: bytes, offsets) -> bytes:
+    """``n`` variable-length messages packed back-to-back in ``data``
+    (message ``i`` spans ``offsets[i]..offsets[i+1]``; ``offsets`` has
+    n+1 entries) -> flat ``n*32`` digest bytes, ONE library call.  The
+    columnar ingest decoder's whole-window digest path; raises
+    AttributeError on libraries built before the entry existed (callers
+    fall back to per-message :func:`keccak256`)."""
+    lib = _load()
+    n = len(offsets) - 1
+    out = ctypes.create_string_buffer(32 * n)
+    offs = (ctypes.c_uint64 * (n + 1))(*offsets)
+    lib.geec_keccak256_multi(data, offs, n, out)
     return out.raw
 
 

@@ -114,6 +114,86 @@ _MISS = object()
 _bucket16 = bucket_round
 
 
+class _WindowRows:
+    """Result holder for one window-granular submission: N rows, ONE
+    completion — :meth:`VerifierScheduler.submit_window` returns one of
+    these instead of N per-row futures, so a 16k-row ingest window
+    costs one wait-side object and one wakeup.
+
+    Each row is occupied by a :class:`_WindowSlot` riding the normal
+    pending map; the backing future resolves with the full ``results``
+    list once every row has resolved.  Row failures are stored as
+    exception VALUES (never raised here) so one dead row cannot poison
+    its window — callers decide per row (``recover_window`` host-
+    diverts them, mirroring ``recover_signers``)."""
+
+    __slots__ = ("results", "_done", "_remaining", "_lock", "_fut",
+                 "_finished")
+
+    def __init__(self, n: int):
+        self.results: list = [None] * n
+        self._done = bytearray(n)
+        self._remaining = n
+        self._lock = threading.Lock()
+        self._fut: Future = Future()
+        self._finished = False
+
+    def _slot_set(self, idx: int, value) -> None:
+        with self._lock:
+            if self._done[idx]:
+                return  # exactly-once per row (hedge losers re-resolve)
+            self._done[idx] = 1
+            self.results[idx] = value
+            self._remaining -= 1
+        self._try_finish()
+
+    def prefill(self, idx: int, value) -> None:
+        """Construction-time row fill (cache hits, post-close rows) —
+        called before any slot of this window is visible to the lanes,
+        so the row lock is uncontended; taken anyway to keep every
+        write to the shared slots under the same lock.  The window
+        future completes later via :meth:`_try_finish`."""
+        with self._lock:
+            self._done[idx] = 1
+            self.results[idx] = value
+            self._remaining -= 1
+
+    def _try_finish(self) -> None:
+        with self._lock:
+            if self._remaining or self._finished:
+                return
+            self._finished = True
+        self._fut.set_result(self.results)
+
+    def result(self, timeout: float | None = None) -> list:
+        return self._fut.result(timeout)
+
+
+class _WindowSlot:
+    """Future duck-type occupying one row of a :class:`_WindowRows`.
+
+    Exposes exactly the surface the scheduler's resolution paths use on
+    a real ``Future`` — ``done()`` / ``set_result`` / ``set_exception``
+    — so window rows ride the pending map, dedup, lane dispatch, hedge
+    and close() drains unchanged.  Exceptions become stored row values
+    (see ``_WindowRows``)."""
+
+    __slots__ = ("_win", "_idx")
+
+    def __init__(self, win: _WindowRows, idx: int):
+        self._win = win
+        self._idx = idx
+
+    def done(self) -> bool:
+        return bool(self._win._done[self._idx])
+
+    def set_result(self, value) -> None:
+        self._win._slot_set(self._idx, value)
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._win._slot_set(self._idx, exc)
+
+
 @dataclass
 class SchedulerConfig:
     """Every real-time knob of the scheduler in one bundle.
@@ -396,6 +476,9 @@ class VerifierScheduler:
             "hedge_wasted": 0,
             # closed-loop controller + flight-ring loss accounting
             "adapt_decisions": 0, "flight_dropped": 0,
+            # window-granular admissions (submit_window): whole ingest
+            # windows entering in ONE lock hold instead of row-by-row
+            "window_submits": 0, "window_rows": 0,
         }
         # optional consensus event journal (utils/journal.py), attached
         # by the first owning node; flush decisions land in its stream
@@ -583,6 +666,110 @@ class VerifierScheduler:
                 addrs[i] = np.frombuffer(r, np.uint8)
                 ok[i] = True
         return addrs, ok
+
+    def submit_window(self, hashes: np.ndarray, sigs: np.ndarray,
+                      priority: str = "bulk") -> _WindowRows:
+        """Window-granular :meth:`submit`: a whole columnar ingest
+        window — ``hashes`` (n,32) / ``sigs`` (n,65) uint8 rows — enters
+        in ONE lock acquisition with a batched cache probe + in-flight
+        dedup sweep, and returns ONE :class:`_WindowRows` instead of N
+        row futures.  Cache/dedup accounting aggregates into single
+        counter bumps and the cache-hit/miss split bills the ambient
+        ingress origin as ONE ``charge()`` for the whole window (N unit
+        charges at one timestamp sum to the same ledger state).  Row
+        semantics — LRU touch, post-close inline recovery, class
+        promotion, trace/origin capture — match per-row submit exactly."""
+        from eges_tpu.utils import tracing
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+
+        n = len(hashes)
+        win = _WindowRows(n)
+        if n == 0:
+            win._try_finish()
+            return win
+        if hashes.shape[1] != 32 or sigs.shape[1] != 65:
+            raise ValueError("window arrays must be (n,32) and (n,65)")
+        klass = "consensus" if priority == "consensus" else "bulk"
+        n_hits = 0
+        with self._lock:
+            # analysis: allow-determinism(coalescing deadline is real-time by contract; chaos pins batching via max_batch kicks)
+            t_now = time.monotonic()
+            ctx = tracing.DEFAULT.current_context()
+            tid = ctx.trace_id if ctx is not None else None
+            rec = ledger.current()
+            added = False
+            for i in range(n):
+                key = (bytes(hashes[i]), bytes(sigs[i]))
+                hit = self._cache.get(key, _MISS)
+                if hit is not _MISS:
+                    self._cache.move_to_end(key)
+                    n_hits += 1
+                    self._cache_rows_pending += 1
+                    win.prefill(i, hit)
+                    continue
+                if self._closed:
+                    # post-close stragglers execute inline on the
+                    # caller — no lost rows, same as per-row submit
+                    v = self._host_recover(key)
+                    self._cache_put(key, v)
+                    win.prefill(i, v)
+                    continue
+                row = self._pending.get(key)
+                if row is not None:
+                    # in-flight dedup (intra-window duplicates land
+                    # here too: the first occurrence owns the batch
+                    # row, later ones share it)
+                    row[0].append(_WindowSlot(win, i))
+                    self._stats["coalesced_rows"] += 1
+                    self._dedup_rows_pending += 1
+                    if klass == "consensus":
+                        row[2] = "consensus"
+                else:
+                    self._pending[key] = [[_WindowSlot(win, i)], t_now,
+                                          klass]
+                    if (tid is not None and len(self._pending_trace)
+                            < self._PENDING_TRACE_CAP):
+                        self._pending_trace[key] = tid
+                    if (rec is not None and len(self._pending_origin)
+                            < self._PENDING_TRACE_CAP):
+                        self._pending_origin[key] = rec
+                    added = True
+            self._stats["cache_hits"] += n_hits
+            self._stats["cache_served_rows"] += n_hits
+            self._stats["cache_misses"] += n - n_hits
+            self._stats["window_submits"] += 1
+            self._stats["window_rows"] += n
+            if added:
+                self._ensure_thread()
+            if len(self._pending) >= self._flush_target():
+                self._kick = True
+            self._lock.notify_all()
+        if n_hits:
+            metrics.counter("verifier.cache_hits").inc(n_hits)
+        if n > n_hits:
+            metrics.counter("verifier.cache_misses").inc(n - n_hits)
+        ledger.charge(cache_hits=n_hits, cache_misses=n - n_hits)
+        win._try_finish()  # all-prefilled windows complete right here
+        return win
+
+    def recover_window(self, hashes: np.ndarray, sigs: np.ndarray,
+                       *, priority: str = "bulk") -> list:
+        """Synchronous window facade: :meth:`submit_window`, one kick,
+        one blocking wait — ``verify_host.recover_signers_window``
+        delegates here when the pool's verifier is a scheduler.  Rows a
+        torn-down scheduler failed fall back to host recovery, exactly
+        like :meth:`recover_signers`."""
+        win = self.submit_window(hashes, sigs, priority)
+        self.kick()
+        out = win.result()
+        fixed = None
+        for i, v in enumerate(out):
+            if isinstance(v, BaseException):
+                if fixed is None:
+                    fixed = list(out)
+                fixed[i] = self._host_recover(
+                    (bytes(hashes[i]), bytes(sigs[i])))
+        return fixed if fixed is not None else out
 
     def ecrecover(self, sigs: np.ndarray, hashes: np.ndarray):
         """Full-pubkey recovery delegates straight to the backing

@@ -560,15 +560,27 @@ class BatchVerifier:
         # before the lock, the registry is only mutated under it
         fn = (self._aot_execs.get(("recover", b))
               if self._sharded is None else None)
+        # wire-speed window fast path: a columnar gather that lands
+        # exactly on the bucket boundary arrives uint8-contiguous and
+        # needs no pad rows — upload the caller's arrays as-is and skip
+        # the staging memcpy (the call is synchronous, so the buffers
+        # are immutable until the compute fence below has consumed the
+        # upload; off-bucket batches still stage + zero-pad)
+        direct = (n == b and sigs.dtype == np.uint8
+                  and hashes.dtype == np.uint8
+                  and sigs.flags.c_contiguous and hashes.flags.c_contiguous)
         # pool checkout instead of a lock around the whole round trip:
         # the device wait below must never serialize other submitters
-        st = self._stage_acquire(b)
+        st = None if direct else self._stage_acquire(b)
         try:
-            ps, ph = st["sigs"], st["hashes"]
-            ps[:n] = sigs
-            ps[n:] = 0
-            ph[:n] = hashes
-            ph[n:] = 0
+            if direct:
+                ps, ph = sigs, hashes
+            else:
+                ps, ph = st["sigs"], st["hashes"]
+                ps[:n] = sigs
+                ps[n:] = 0
+                ph[:n] = hashes
+                ph[n:] = 0
             t0 = time.monotonic()
             ds, dh = self._to_device(ps, ph)
             if self.debug_timing:
@@ -588,7 +600,8 @@ class BatchVerifier:
         finally:
             # the fence above consumed the upload; the host buffers are
             # free for the next window
-            self._stage_release(b, st)
+            if st is not None:
+                self._stage_release(b, st)
         self._record_batch("ecrecover", n, b, cached, t0, t1, t2, t3)
         return out
 

@@ -267,3 +267,51 @@ def recover_signers(entries, verifier, priority: str = "bulk") -> list:
     for i in range(len(entries)):
         out.append(bytes(addrs[i]) if ok[i] else None)
     return out
+
+
+def recover_signers_window(hashes, sigs, verifier,
+                           priority: str = "bulk") -> list:
+    """Array-native :func:`recover_signers` for the columnar ingest
+    path: ``hashes`` (n,32) / ``sigs`` (n,65) uint8 arrays sliced
+    straight out of a ``TxColumns`` window, one 20-byte address or
+    ``None`` per row.  Per-row results are identical to
+    ``recover_signers([(h, sig), ...])`` — the difference is purely
+    mechanical: no per-row entry tuples, no per-row zero-fill copy, the
+    arrays land in the verifier's staging buffers as-is.  Dispatch
+    mirrors the entry path's three verifier shapes:
+
+    * a :class:`~eges_tpu.crypto.scheduler.VerifierScheduler` takes the
+      window whole (``recover_window`` — ONE lock hold, batched cache
+      probe, one window future);
+    * a plain batch verifier gets the arrays directly
+      (``recover_addresses`` — zero conversion);
+    * ``verifier=None`` falls back to per-row host recovery, same as
+      the entry path's nocgo role.
+    """
+    n = len(hashes)
+    if n == 0:
+        return []
+    if verifier is None:
+        from eges_tpu.crypto import secp256k1 as host
+
+        _count_host_rows(n)
+        out = []
+        for i in range(n):
+            try:
+                out.append(host.recover_address(bytes(hashes[i]),
+                                                bytes(sigs[i])))
+            # analysis: allow-swallow(invalid row reported as None —
+            # same mask-don't-raise contract as recover_signers)
+            except Exception:
+                out.append(None)
+        return out
+    if hasattr(verifier, "recover_window"):
+        return verifier.recover_window(hashes, sigs, priority=priority)
+    if hasattr(verifier, "recover_signers"):
+        # a scheduler-shaped verifier predating the window API: fall
+        # back to entry tuples so results stay identical
+        kw = {"priority": priority} if hasattr(verifier, "submit") else {}
+        return verifier.recover_signers(
+            [(bytes(hashes[i]), bytes(sigs[i])) for i in range(n)], **kw)
+    addrs, ok = verifier.recover_addresses(sigs, hashes)
+    return [bytes(addrs[i]) if ok[i] else None for i in range(n)]

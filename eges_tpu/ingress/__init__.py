@@ -16,10 +16,16 @@ rule ``perimeter-breach``) requires that
   the wrappers here.
 
 This package is deliberately import-weightless: no eager imports, the
-wrappers take the owning object as an argument.  ROADMAP item 5's
-wire-speed ingest rebuild lands inside this module boundary — the
-facade pre-digs it, so when the batched-ingest path replaces the
-per-datagram handlers, outside callers don't move.
+wrappers take the owning object as an argument (the columnar decoder
+in :mod:`eges_tpu.ingress.columnar` loads lazily through its own
+wrappers below).  ROADMAP item 5's wire-speed ingest rebuild now lives
+here: ``columnar.decode_window`` turns a whole gossip window of txn
+frames into numpy-backed columns (sighash32 / sig65 / txhash /
+gas_price / nonce) with O(1) Python-level transitions per window,
+``TxPool.add_remotes_window`` admits it with set-op dedup and
+per-window bookkeeping, and ``VerifierScheduler.submit_window`` takes
+the rows in one lock hold — the legacy per-tx path stays for
+singletons and as the differential-test oracle.
 """
 
 from __future__ import annotations
@@ -39,7 +45,10 @@ INGRESS_ENTRIES = frozenset({
     # sim/simnet.py — simulated delivery into the node sinks
     "_fire_gossip", "_fire_direct",
     # core/txpool.py — the admission seam (validated, capped batches)
-    "add_remotes", "add_locals",
+    "add_remotes", "add_locals", "add_remotes_window",
+    # ingress/columnar.py — the wire-speed columnar decoders (frames
+    # are transport-length-capped; oversized rows die pre-decode)
+    "decode_window", "columns_from_txns",
 })
 
 
@@ -93,3 +102,32 @@ def admit_remotes(pool, txns) -> None:
 def admit_locals(pool, txns) -> None:
     """Admit locally-submitted transactions into a txpool."""
     pool.add_locals(txns)
+
+
+# -- wire-speed columnar ingest (ROADMAP item 5) -------------------------
+
+def decode_txn_window(frames):
+    """Decode a whole window of raw txn frames into columnar arrays
+    (``ingress.columnar.TxColumns``): one canonical scan + one keccak
+    per frame, sighash preimages sliced straight out of the frame
+    bytes, ``Transaction`` construction deferred to admission time."""
+    from eges_tpu.ingress.columnar import decode_window
+
+    return decode_window(frames)
+
+
+def columns_of(txns):
+    """Columns for already-decoded ``Transaction`` objects — the gossip
+    relay path, where the codec decoded the bundle but admission should
+    still run window-granular."""
+    from eges_tpu.ingress.columnar import columns_from_txns
+
+    return columns_from_txns(txns)
+
+
+def admit_remotes_window(pool, cols) -> None:
+    """Admit one decoded columnar window into a txpool: one lock hold,
+    set-op dedup, one batched verify call per ``max_batch`` rows —
+    byte-identical admission outcomes to :func:`admit_remotes` over the
+    same rows."""
+    pool.add_remotes_window(cols)

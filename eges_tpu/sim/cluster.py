@@ -15,7 +15,7 @@ from eges_tpu.consensus.config import BootstrapNode, ChainGeecConfig, NodeConfig
 from eges_tpu.consensus.node import GeecNode
 from eges_tpu.core.chain import BlockChain, make_genesis
 from eges_tpu.crypto import secp256k1 as secp
-from eges_tpu.ingress import direct_sink, gossip_sink
+from eges_tpu.ingress import columns_of, direct_sink, gossip_sink
 from eges_tpu.sim.simnet import SimClock, SimNet, SkewedClock
 
 
@@ -40,7 +40,8 @@ class SimCluster:
                  verifier=None, mine=None, signed: bool = True,
                  alloc: dict | None = None, txpool: bool = False,
                  fast_sync: set | None = None, defer: set | None = None,
-                 mesh_devices: int | None = None, sched_config=None):
+                 mesh_devices: int | None = None, sched_config=None,
+                 columnar: bool = True):
         self.clock = SimClock()
         self.net = SimNet(self.clock, seed=seed, drop_rate=drop_rate)
         self.nodes: list[SimNode] = []
@@ -89,6 +90,7 @@ class SimCluster:
         self._ccfg = ccfg
         self._mine = mine
         self._txpool = txpool
+        self._columnar = columnar
         self._alloc = alloc
         # crashed nodes' journal history, preserved across the rebuild
         # so the observatory sees one continuous per-node stream
@@ -139,6 +141,13 @@ class SimCluster:
             if txpool:
                 from eges_tpu.core.txpool import TxPool
                 node.txpool = TxPool(node_clock, verifier=verifier)
+                if columnar:
+                    # the wire-speed ingest hook: relayed txn bundles go
+                    # through the columnar admission seam.  Injected here
+                    # (sim is L4) so the node (L2) never imports ingress
+                    # (L3).  columnar=False keeps the per-tx legacy path
+                    # — the differential test's oracle.
+                    node.columnarize = columns_of
             if i not in self._deferred:
                 # deferred nodes (late joiners) stay OFF the network —
                 # no transport join, no gossip — until start_deferred()
@@ -202,6 +211,8 @@ class SimCluster:
         if self._txpool:
             from eges_tpu.core.txpool import TxPool
             node.txpool = TxPool(sn.clock, verifier=self.verifier)
+            if self._columnar:
+                node.columnarize = columns_of
         node.transport = self.net.join(sn.name, ncfg.consensus_ip,
                                        ncfg.consensus_port,
                                        gossip_sink(node),

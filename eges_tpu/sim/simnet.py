@@ -149,7 +149,7 @@ class SimNet:
         self._links: dict[tuple[str, str], LinkRule] = {}
         self.stats = {"gossip": 0, "direct": 0, "dropped": 0,
                       "dead_letter": 0, "corrupted": 0, "duplicated": 0,
-                      "reordered": 0}
+                      "reordered": 0, "gossip_bytes": 0, "direct_bytes": 0}
 
     def join(self, node_id: str, ip: str, port: int, on_gossip, on_direct):
         transport = SimTransport(self, node_id)
@@ -250,6 +250,7 @@ class SimNet:
             self.clock.call_later(extra,
                                   (lambda f, d: lambda: f(d))(fire, data))
         self.stats[plane] += 1
+        self.stats[plane + "_bytes"] += len(data)
         self.clock.call_later(delay,
                               (lambda f, d: lambda: f(d))(fire, data))
 
@@ -264,6 +265,16 @@ class SimNet:
                        (lambda nid, src:
                         lambda d: self._fire_gossip(nid, d, src))
                        (node_id, sender_id))
+
+    def deliver_gossip_many(self, sender_id: str, frames) -> None:
+        """Inject one WINDOW of gossip datagrams from a (possibly
+        external) sender in a single call — the wire-speed ingest
+        test/chaos idiom.  Each frame rides the normal per-datagram
+        fault model (drop/corrupt/duplicate/reorder), so a window
+        injection is byte-identical to the equivalent loop of
+        :meth:`deliver_gossip` calls."""
+        for data in frames:
+            self.deliver_gossip(sender_id, data)
 
     def _fire_gossip(self, node_id: str, data: bytes,  # ingress-entry
                      sender_id: str = "") -> None:

@@ -44,7 +44,7 @@ METRIC_FAMILIES = frozenset({
     # sim/faults.py — deterministic fault injection
     "sim.faults_injected",
     # core/txpool.py
-    "txpool.known_clears", "txpool.pending",
+    "txpool.known_clears", "txpool.pending", "txpool.window_undecoded",
     # crypto/ verifiers
     "verifier.batches", "verifier.compile_cache_hits",
     "verifier.compile_cache_misses", "verifier.d2h_seconds",
@@ -145,6 +145,9 @@ METRIC_HELP = {
     "sim.faults_injected": "Scripted faults injected by the chaos harness.",
     "txpool.known_clears": "Coarse clears of the known-txn dedup set.",
     "txpool.pending": "Transactions pending in the pool.",
+    "txpool.window_undecoded": (
+        "Rows of a columnar ingest window dropped because the frame "
+        "failed to decode."),
     "verifier.batches": "Signature verification batches dispatched.",
     "verifier.compile_cache_hits": "Verifier JIT compile-cache hits.",
     "verifier.compile_cache_misses": "Verifier JIT compile-cache misses.",

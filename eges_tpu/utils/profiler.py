@@ -89,6 +89,7 @@ PROFILE_PHASES = frozenset({
 SPAN_PHASES = {
     "txpool.ingest": "pool_admit",
     "txpool.admit": "pool_admit",
+    "txpool.admit_window": "pool_admit",
 }
 
 # Host-vs-verify split used by the bench gate: what share of

@@ -799,10 +799,14 @@ def _scn_oversized_payload_flood(seed: int, fast: bool) -> dict:
     the cluster with (a) datagrams past INGRESS_MAX_BYTES — dropped for
     the price of a length check, before RLP ever runs — and (b)
     far-future GOSSIP_QUERY messages that stuff the defer queue until
-    the DEFER_MAX eviction path sheds oldest-first.  Consensus must
-    keep committing, every node's defer queue must end at or under its
-    cap, and the ingress ledger must bill both drop families to the
-    flooder — byte-deterministic across same-seed runs."""
+    the DEFER_MAX eviction path sheds oldest-first — plus (c) multi-txn
+    invalid-signature gossip windows that ride the COLUMNAR ingest path
+    (decode -> window dedup -> batched verify reject), so the cheap
+    whole-window reject is exercised under the same storm.  Consensus
+    must keep committing, every node's defer AND pool ingest queues
+    must end at or under their caps, and the ingress ledger must bill
+    every abuse family (drops, deferrals, rejects) to the flooder —
+    byte-deterministic across same-seed runs."""
     from eges_tpu.core.types import QueryBlockMsg, Transaction
     from eges_tpu.utils import ledger as ledger_mod
     from eges_tpu.utils.metrics import DEFAULT as metrics
@@ -846,6 +850,15 @@ def _scn_oversized_payload_flood(seed: int, fast: bool) -> dict:
         cluster.net.deliver_gossip("flooder", junk)
         # a burst of unique far-future queries: each one is a deferral
         base = 100_000 + wave[0] * 16
+        # a 16-row invalid-signature txn window: rides the columnar
+        # ingest (window dedup + batched verify) straight into the
+        # whole-window reject, billed per row to this flooder
+        bad = tuple(Transaction(nonce=base + i, gas_price=1,
+                                gas_limit=21000, to=bytes(20), value=0,
+                                v=27, r=0, s=1)
+                    for i in range(16))
+        cluster.net.deliver_gossip("flooder", M.pack_gossip(
+            M.GOSSIP_TXNS, M.TxnsMsg(txns=bad)))
         wave[0] += 1
         for i in range(16):
             cluster.net.deliver_gossip("flooder", M.pack_gossip(
@@ -881,6 +894,14 @@ def _scn_oversized_payload_flood(seed: int, fast: bool) -> dict:
                       "defer_queues_capped": all(
                           len(sn.node._deferred) <= sn.node.DEFER_MAX
                           for sn in cluster.nodes),
+                      # the columnar ingest queue never holds more than
+                      # one un-flushed window's worth of rows: the
+                      # max_batch threshold flushes anything beyond it
+                      "pool_ingest_queues_bounded": all(
+                          sn.node.txpool._queue_rows
+                          <= sn.node.txpool.max_batch
+                          for sn in cluster.nodes
+                          if sn.node.txpool is not None),
                   })
     # forensics: both drop families must bill to the flooder, who must
     # out-rank every honest origin on both (honest peers DO carry some
@@ -895,12 +916,17 @@ def _scn_oversized_payload_flood(seed: int, fast: bool) -> dict:
     checks = {
         "flooder_billed_drops": flooder.get("drops", 0.0) > 0,
         "flooder_billed_deferred": flooder.get("deferred", 0.0) > 0,
+        # the invalid-signature windows reject on the columnar path and
+        # bill back to their deliverer
+        "flooder_billed_rejects": flooder.get("rejects", 0.0) > 0,
         "flooder_top_offender": all(
             flooder.get("drops", 0.0) > o.get("drops", 0.0)
             and flooder.get("deferred", 0.0) > o.get("deferred", 0.0)
+            and flooder.get("rejects", 0.0) > o.get("rejects", 0.0)
             for o in honest),
         "honest_client_unblamed": (client.get("drops", 0.0) <= 0.0
                                    and client.get("deferred", 0.0) <= 0.0
+                                   and client.get("rejects", 0.0) <= 0.0
                                    and client.get("admits", 0.0) > 0),
     }
     res["ledger"] = {"origins": len(rows),

@@ -6,9 +6,12 @@ without one form their own group), compares each group's newest
 ``value`` against its previous one, and exits non-zero when ANY metric
 dropped more than the threshold (default 20%) — the CI tripwire for
 perf regressions that unit tests can't see.  The verifier bench's
-``secp256k1_ecrecover_verifies_per_sec_per_chip`` and the mesh stage's
-aggregate ``mesh_sharded_rows_per_s`` gate independently: a mesh
-dispatch regression cannot hide behind a healthy single-chip number.
+``secp256k1_ecrecover_verifies_per_sec_per_chip``, the mesh stage's
+aggregate ``mesh_sharded_rows_per_s`` and the wire-speed ingest
+stage's ``ingest_rows_per_s`` (the columnar datagram->pool pipeline,
+raced against a per-tx baseline) gate independently: a mesh dispatch
+or host-ingest regression cannot hide behind a healthy single-chip
+number.
 Metrics in ``LOWER_IS_BETTER`` (``cold_start_seconds`` — the AOT
 artifact store's deliverable — ``commit_p99_ms`` — the commit
 anatomy stage's end-to-end p99 — and ``ledger_overhead_pct`` — the

@@ -175,17 +175,17 @@ def test_geec_txns_requeued_on_abort():
     """ADVICE low: aborting a proposal returns drained geec txns."""
     node, addrs = mk_node()
     t1, t2 = geec_txn(b"payload-1"), geec_txn(b"payload-2")
-    node.pending_geec_txns = [t1, t2]
+    node.pending_geec_txns.extend([t1, t2])
     node._build_proposal(1)
-    assert node.pending_geec_txns == []
+    assert list(node.pending_geec_txns) == []
     node._abort_proposal()
-    assert node.pending_geec_txns == [t1, t2]
+    assert list(node.pending_geec_txns) == [t1, t2]
     # and a landed block that includes one of them dedups it
     blk = new_block(Header(parent_hash=node.chain.head().hash, number=1,
                            coinbase=addrs[1], time=1, trust_rand=3),
                     geec_txns=(t1,))
     node.chain.offer(blk)
-    assert node.pending_geec_txns == [t2]
+    assert list(node.pending_geec_txns) == [t2]
 
 
 def test_future_buffer_keeps_near_head_blocks():
