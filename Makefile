@@ -4,9 +4,9 @@
 BASE := $(shell git rev-parse --verify -q origin/main || echo HEAD)
 
 .PHONY: check gate analyze race taint layers test anatomy-smoke \
-	ledger-smoke profile devstats
+	ledger-smoke profile devstats statesync
 
-check: gate test anatomy-smoke ledger-smoke profile devstats
+check: gate test anatomy-smoke ledger-smoke profile devstats statesync
 
 # all four analysis slices (analyze + race + taint + layers) in ONE
 # process: the parsed Project and per-checker findings are memoized
@@ -60,6 +60,13 @@ ledger-smoke:
 # sampler's exact totals (eges_tpu/utils/profiler.py --selftest)
 profile:
 	JAX_PLATFORMS=cpu python -m eges_tpu.utils.profiler --selftest
+
+# state-sync smoke: the crash-and-rejoin chaos scenario must pass (the
+# restarted node anchors on a checkpoint and replays only the tail)
+# and two same-seed runs must dump byte-identical journals
+statesync:
+	JAX_PLATFORMS=cpu python harness/chaos.py \
+		--scenario rejoin_tail_bound --fast --check-determinism
 
 # device-efficiency smoke: roofline parsing/interpolation fixtures,
 # then a mesh sim whose journaled device_efficiency stream must

@@ -608,6 +608,54 @@ def _anatomy_stage() -> dict | None:
         return None
 
 
+def _rejoin_stage() -> dict | None:
+    """Snapshot-rejoin stage: a calm sim with the durable checkpoint
+    cadence on; one node crashes, the survivors run ahead, and the
+    restart is wall-clock timed.  The restarted node must anchor on the
+    newest root-verified checkpoint and replay only the tail, so the
+    history series ``rejoin_replayed_blocks`` and ``rejoin_seconds``
+    are both gated lower-is-better by ``harness/check_regression.py``
+    — a regression back to O(chain) boot replay fails the round.
+
+    Runs in the PARENT like ``_slo_stage``: the sim imports no JAX and
+    only the restart itself is measured on the wall clock."""
+    try:
+        from eges_tpu.sim.cluster import SimCluster
+        from eges_tpu.sim.faults import FaultInjector
+
+        t0 = time.monotonic()
+        cluster = SimCluster(4, seed=0, txn_per_block=2,
+                             checkpoint_every=4)
+        inj = FaultInjector(cluster)
+        cluster.start()
+        cluster.run(900.0,
+                    stop_condition=lambda: cluster.min_height() >= 12)
+        inj.fire_now("crash", node="node1")
+        # survivors extend the chain: the tail the restart must replay
+        cluster.run(240.0, stop_condition=lambda: min(
+            sn.chain.height() for sn in cluster.live_nodes()) >= 16)
+        t_restart = time.monotonic()
+        inj.fire_now("restart", node="node1")
+        rejoin_s = time.monotonic() - t_restart
+        evs = cluster.journals().get("node1", [])
+        rst = next((e for e in reversed(evs)
+                    if e.get("type") == "statesync_restart"), None)
+        for sn in cluster.live_nodes():
+            sn.node.stop()
+        if rst is None:
+            return None
+        return {
+            "replayed_blocks": int(rst.get("replayed", 0)),
+            "snapshot_blk": int(rst.get("snapshot_blk", 0)),
+            "height": int(rst.get("blk", 0)),
+            "rejoin_s": round(rejoin_s, 6),
+            "elapsed_s": round(time.monotonic() - t0, 2),
+        }
+    # analysis: allow-swallow(optional bench stage; a failed leg reports null)
+    except Exception:
+        return None
+
+
 def _ledger_stage() -> dict | None:
     """Ingress-ledger overhead stage: the verifier scheduler's hot path
     (submit -> coalesce -> recover) timed with and without an ambient
@@ -1175,6 +1223,7 @@ def main() -> None:
     profile_bench = _profile_stage()
     ingest_bench = _ingest_stage()
     devstats_bench = _devstats_stage()
+    rejoin_bench = _rejoin_stage()
 
     best: dict = {}      # kind -> best stage result for that backend
     # kind -> {batch(str): {p50_ms, p99_ms}} — every stage's tails, not
@@ -1424,6 +1473,23 @@ def main() -> None:
         line.update(_provenance())
         print(json.dumps(line), flush=True)
         _append_history(line)
+    if rejoin_bench:
+        # parent-side stage: crash-and-rejoin over the virtual cluster
+        # with the checkpoint cadence on — both series lower-is-better,
+        # so a restart regressing to O(chain) replay (or a slow
+        # snapshot load) fails the round even when verifies/s holds
+        for metric, value, unit in (
+                ("rejoin_replayed_blocks",
+                 rejoin_bench["replayed_blocks"], "blocks"),
+                ("rejoin_seconds", rejoin_bench["rejoin_s"], "s")):
+            line = {"metric": metric, "value": value, "unit": unit,
+                    "snapshot_blk": rejoin_bench["snapshot_blk"],
+                    "height": rejoin_bench["height"],
+                    "platform_detail":
+                        _platform_detail(probe_state, best)}
+            line.update(_provenance())
+            print(json.dumps(line), flush=True)
+            _append_history(line)
     if ledger_bench:
         # parent-side stage: scheduler hot path with vs without the
         # ingress provenance binding — gated lower-is-better so

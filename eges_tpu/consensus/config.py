@@ -112,6 +112,14 @@ class NodeConfig:
     #                                     not O(chain).  Requires
     #                                     signed_votes for the cert check.
 
+    checkpoint_every: int = 0           # durable state-checkpoint cadence
+    #                                     in blocks (0 = off): every Nth
+    #                                     committed block writes a
+    #                                     root-verified snapshot sidecar so
+    #                                     a restart replays only the tail
+    #                                     past the newest checkpoint —
+    #                                     O(tail), not O(chain)
+
     # TPU-native addition: verify signatures in device batches of up to
     # this many rows (the reference has no analogue — it verifies one
     # cgo call at a time, crypto/secp256k1/secp256.go:105).

@@ -184,7 +184,14 @@ class GeecNode:
         # replay rather than looping against a byzantine serving peer
         self._fs: dict | None = None
         self._fs_done = False
+        # serving peers whose pages failed the pivot root check: never
+        # re-anchor a download on one (byzantine-server quarantine)
+        self._fs_blacklist: set[bytes] = set()
         self._snap_cache: tuple | None = None  # serving-side page cache
+        # per-origin token buckets for the snapshot-serving plane, so a
+        # flood of StateFetchReqs cannot turn this node into a DoS
+        # amplifier; bounded-by: SERVE_TOKENS_MAX (oldest evicted)
+        self._serve_tokens: dict[str, tuple[float, float]] = {}
         self.geec_txn_sink = None  # app-layer callback for confirmed geec txns
         self.txpool = None  # optional TxPool; proposals drain it
         #                     (property: attaching one wires the journal)
@@ -226,13 +233,66 @@ class GeecNode:
         # restart path: rebuild membership/trust-rand/working-block state
         # from the durable chain (blocks already canonical are final here;
         # the journal stays quiet — replayed history is not live protocol
-        # activity and would double-count in the observatory)
+        # activity and would double-count in the observatory).  When the
+        # chain anchored on a root-verified checkpoint sidecar carrying a
+        # consensus section, seed the soft state from it and replay only
+        # the tail past the anchor — O(tail), not O(chain).  A missing
+        # block below an anchorless pivot (fast-synced store) is skipped:
+        # the live node never ingested it either.
         self.journal.enabled = False
-        for n in range(1, chain.height() + 1):
-            self._ingest_block(chain.get_block_by_number(n), replay=True)
+        anchor = 0
+        cons = getattr(chain, "snapshot_consensus", None)
+        if cons is not None and getattr(chain, "snapshot_anchor", 0) > 0:
+            anchor = chain.snapshot_anchor
+            self._seed_from_checkpoint(cons)
+        replayed = 0
+        for n in range(anchor + 1, chain.height() + 1):
+            blk = chain.get_block_by_number(n)
+            if blk is None:
+                continue
+            self._ingest_block(blk, replay=True)
+            replayed += 1
         self.journal.enabled = True
         self.max_confirmed_block = chain.height()
         if self.coinbase in self.membership:
+            self.registered = True
+        if chain.height() > 0:
+            from eges_tpu.utils.metrics import DEFAULT as metrics
+            self.journal.record("statesync_restart", blk=chain.height(),
+                                snapshot_blk=anchor, replayed=replayed)
+            metrics.gauge("statesync.restart_replayed").set(replayed)
+
+    def _seed_from_checkpoint(self, cons: dict) -> None:
+        """Re-seed consensus soft state from a checkpoint's consensus
+        section.  Existing entries (the genesis bootstrap members added
+        above) are overwritten in place — routing them through
+        ``Membership.add`` would take its RENEWAL path and stack TTLs
+        the live run never granted."""
+        for (addr, referee, ip, port, joined, ttl, renewed) in \
+                cons.get("members", ()):
+            m = self.membership.get(addr)
+            if m is None:
+                self.membership.add(Member(addr=addr, ip=ip, port=port,
+                                           referee=referee,
+                                           joined_block=joined, ttl=ttl,
+                                           renewed_times=renewed))
+                m = self.membership.get(addr)
+                if m is None:
+                    continue
+            m.ip, m.port, m.referee = ip, port, referee
+            m.joined_block, m.ttl = joined, ttl
+            m.renewed_times = renewed
+        self.trust_rands.update(cons.get("trust_rands", ()))
+        self.empty_block_list = list(cons.get("empty_blocks", ()))
+        # the restored queue stays bounded-by: SYNC_STASH_MAX — a
+        # damaged sidecar must not inflate the unconfirmed window
+        for n in cons.get("unconfirmed", ()):
+            if len(self.unconfirmed) >= self.SYNC_STASH_MAX:
+                break
+            blk = self.chain.get_block_by_number(n)
+            if blk is not None:
+                self.unconfirmed.append(blk)
+        if cons.get("registered"):
             self.registered = True
 
     # ------------------------------------------------------------------
@@ -392,6 +452,8 @@ class GeecNode:
             ledger.charge(drops=1)
             self._log("oversized gossip dropped", nbytes=len(data))
             return
+        if not self._state_reply_fits(data):
+            return
         try:
             code, msg = M.unpack_gossip(data)
         except Exception as exc:
@@ -426,7 +488,10 @@ class GeecNode:
         elif code == M.GOSSIP_GET_STATE:
             self._serve_state_fetch(msg)
         elif code == M.GOSSIP_STATE_REPLY:
-            self._handle_state_chunk(msg)
+            # gossip replies carry no authenticated author; the pinned
+            # server check in the handler accepts them only when they
+            # answer the cursor this node actually asked for
+            self._handle_state_chunk(msg, author=b"")
         elif code == M.GOSSIP_TXNS:
             self._handle_txns(msg)
 
@@ -445,6 +510,8 @@ class GeecNode:
             ledger.charge(drops=1)
             self._log("oversized direct dropped", nbytes=len(data))
             return
+        if not self._state_reply_fits(data):
+            return
         try:
             code, author, msg = M.unpack_direct(data)
         except Exception as exc:
@@ -452,13 +519,33 @@ class GeecNode:
             self._log("malformed direct", nbytes=len(data), err=repr(exc))
             return
         try:
-            self._dispatch_direct(code, msg)
+            self._dispatch_direct(code, msg, author)
         except Exception as exc:
             # same contract as the gossip plane: corrupted-but-unpackable
             # payloads get rejected by the handler, not fatal
             self._log("direct handler rejected", code=code, err=repr(exc))
 
-    def _dispatch_direct(self, code: int, msg) -> None:
+    def _state_reply_fits(self, data: bytes) -> bool:
+        """Pre-decode byte cap for state-sync replies: a state page is
+        the one message class whose legitimate size dwarfs every other
+        frame, so the global INGRESS_MAX_BYTES budget would let a
+        byzantine server feed ~1 MiB of junk per datagram into the RLP
+        decoder.  Peek ONLY the leading message code (no body decode)
+        and drop oversized state replies before any account parses;
+        bounded-by: STATE_REPLY_MAX_BYTES."""
+        if len(data) <= self.STATE_REPLY_MAX_BYTES:
+            return True
+        from eges_tpu.core import rlp as rlp_mod
+        code = rlp_mod.peek_first_uint(data)
+        if code in (M.GOSSIP_STATE_REPLY, M.UDP_STATE):
+            from eges_tpu.utils.metrics import DEFAULT as metrics
+            metrics.counter("statesync.oversized_reply").inc()
+            ledger.charge(drops=1)
+            self._log("oversized state reply dropped", nbytes=len(data))
+            return False
+        return True
+
+    def _dispatch_direct(self, code: int, msg, author: bytes = b"") -> None:
         if code == M.UDP_ELECT:
             self._handle_elect_message(msg)
         elif code == M.UDP_EXAMINE_REPLY:
@@ -476,7 +563,7 @@ class GeecNode:
         elif code == M.UDP_GET_STATE:
             self._serve_state_fetch(msg)
         elif code == M.UDP_STATE:
-            self._handle_state_chunk(msg)
+            self._handle_state_chunk(msg, author=author)
 
     def on_geec_txn(self, payload: bytes) -> None:  # ingress-entry
         """UDP txn ingest (ref: consensus/geec/geec_api.go:28-41)."""
@@ -1267,6 +1354,18 @@ class GeecNode:
     #                          that the tail replay stays short
     STATE_PAGE_BYTES = 36_000  # per-reply account payload budget (UDP)
     STATE_PAGE_MAX = 512       # accounts per page cap
+    # byzantine-tolerance knobs for the live state download
+    STATE_REPLY_MAX_BYTES = 192_000  # pre-decode byte cap on one state
+    #                                  reply (FASTSYNC_MAX_ACCOUNTS caps
+    #                                  rows; this caps BYTES before RLP)
+    STATESYNC_MAX_REANCHORS = 3      # pivot/server re-anchors before the
+    #                                  sync aborts to full replay
+    STATESYNC_MAX_RETRIES = 64       # total fruitless ticks across the
+    #                                  whole download before clean abort
+    SERVE_RATE_PAGES_S = 4.0         # per-origin serving refill rate
+    SERVE_BURST = 8                  # per-origin serving burst
+    SERVE_TOKENS_MAX = 256           # tracked serving origins (oldest
+    #                                  evicted; bounds the bucket dict)
 
     def _request_backfill(self, target: int, start: int | None = None) -> None:
         """Start (or extend) a sync toward ``target``.
@@ -1521,19 +1620,140 @@ class GeecNode:
     def _fastsync_start(self, target: int) -> None:
         self._fs = {"target": target, "pivot": 0, "root": b"",
                     "accounts": [], "codes": [], "total": None,
-                    "headers": {}, "block": None, "progress": False}
+                    "headers": {}, "block": None, "progress": False,
+                    # byzantine-tolerance state: the pinned serving peer
+                    # (every page of one download comes from ONE server,
+                    # so a poisoned download is attributable), plus the
+                    # bounded re-anchor / total-retry budgets
+                    "server": None, "reanchors": 0, "retries": 0}
+        self._fastsync_load_staging()
         self._log("FASTSYNC start", gap=target - self.chain.height())
         self._fastsync_tick(retry=0)
+
+    def _fastsync_load_staging(self) -> None:
+        """Mid-sync crash resume: pages a previous process accepted and
+        staged to the store re-enter the download, so a crash at cursor
+        N resumes at N instead of 0.  Only a consistent prefix loads —
+        same pivot/root throughout, cursors contiguous from 0; the
+        first torn or inconsistent blob truncates the resume there."""
+        from eges_tpu.core import statesync as _ss
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+
+        fs = self._fs
+        try:
+            blobs = self.chain.store.load_sync_pages()
+        # analysis: allow-swallow(staging is an optimization; an unreadable log just restarts the download from cursor 0)
+        except Exception:
+            return
+        pages = 0
+        for blob in blobs:
+            try:
+                pivot, root, cursor, total, accounts, codes = \
+                    _ss.decode_page(blob)
+            except _ss.StateSyncError:
+                break  # torn staged tail: keep the consistent prefix
+            if pages == 0:
+                if cursor != 0:
+                    break
+                fs["pivot"], fs["root"] = pivot, root
+            elif (pivot != fs["pivot"] or root != fs["root"]
+                    or cursor != len(fs["accounts"])):
+                break
+            if (len(fs["accounts"]) + len(accounts)
+                    > self.FASTSYNC_MAX_ACCOUNTS):
+                break  # an overgrown staging log never resumes past the
+                       # same row budget the live download enforces
+            fs["accounts"].extend(accounts)
+            fs["codes"].extend(codes)
+            fs["total"] = total
+            pages += 1
+        if pages:
+            self.journal.record("statesync_resume", blk=fs["pivot"],
+                                pages=pages, rows=len(fs["accounts"]))
+            metrics.counter("statesync.resumes").inc()
+            self._log("FASTSYNC resume", pivot=fs["pivot"], pages=pages,
+                      rows=len(fs["accounts"]))
+
+    def _clear_sync_staging(self) -> None:
+        try:
+            self.chain.store.clear_sync_staging()
+        # analysis: allow-swallow(staging cleanup is best-effort; stale pages fail the consistency check on the next load)
+        except Exception:
+            pass
 
     def _fastsync_abort(self, why: str) -> None:
         """Fall back to full replay — once per session; a byzantine or
         pruned serving peer can delay a fast sync, never wedge it."""
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+
         fs, self._fs = self._fs, None
         self._fs_done = True
         self._cancel_timer("fastsync")
+        if fs is not None:
+            # drop the staged rows NOW: an armed timer or in-flight
+            # closure still holding ``fs`` must not pin up to
+            # FASTSYNC_MAX_ACCOUNTS rows until the next sync
+            fs["accounts"].clear()
+            fs["codes"].clear()
+            fs["headers"].clear()
+            fs["block"] = None
+        self._clear_sync_staging()
+        self.journal.record("statesync_abort", why=why)
+        metrics.counter("statesync.aborts").inc()
         self._log("FASTSYNC abandoned", why=why)
         if fs is not None:
             self._request_backfill(fs["target"])
+
+    def _fastsync_pick_server(self, retry: int):
+        """Serving-peer choice for the state download: the usual member
+        rotation, EXCLUDING peers that already served a poisoned page."""
+        peers = [m for m in self.membership.members()
+                 if m.addr != self.coinbase and m.ip
+                 and m.addr not in self._fs_blacklist]
+        if not peers:
+            return None
+        self._sync_rr = getattr(self, "_sync_rr", 0) + 1
+        return peers[(self._sync_rr + retry) % len(peers)]
+
+    def _fastsync_rotate_server(self, retry: int) -> None:
+        """The pinned server went quiet for a full stall ladder: move
+        the download to another peer.  Staged pages answer the OLD
+        server's pivot snapshot, so rotation with pages on hand
+        re-anchors the whole download (bounded by the re-anchor
+        budget); with nothing staged it just unpins."""
+        fs = self._fs
+        old = fs["server"]
+        self.journal.record(
+            "statesync_server_rotate", blk=fs["pivot"],
+            server=old.addr.hex()[:8] if old is not None else "",
+            retry=retry)
+        if fs["accounts"] or fs["pivot"]:
+            self._fastsync_reanchor("server quiet", blacklist=False)
+        else:
+            fs["server"] = None
+
+    def _fastsync_reanchor(self, why: str, *, blacklist: bool) -> None:
+        """Restart the download from cursor 0 on a fresh pivot/server,
+        optionally quarantining the current server first.  Budgeted:
+        crossing STATESYNC_MAX_REANCHORS aborts to full replay."""
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+
+        fs = self._fs
+        srv = fs["server"]
+        if blacklist and srv is not None:
+            self._fs_blacklist.add(srv.addr)
+        fs["reanchors"] += 1
+        metrics.counter("statesync.reanchors").inc()
+        self.journal.record("statesync_reanchor", blk=fs["pivot"],
+                            count=fs["reanchors"], why=why)
+        self._log("FASTSYNC reanchor", why=why, count=fs["reanchors"])
+        if fs["reanchors"] > self.STATESYNC_MAX_REANCHORS:
+            self._fastsync_abort("re-anchor budget exhausted")
+            return
+        fs.update(pivot=0, root=b"", accounts=[], codes=[], total=None,
+                  block=None, progress=False, server=None)
+        fs["headers"].clear()
+        self._clear_sync_staging()
 
     def _fastsync_tick(self, retry: int) -> None:
         fs = self._fs
@@ -1542,20 +1762,33 @@ class GeecNode:
         if fs["progress"]:
             retry = 0
             fs["progress"] = False
-        elif retry >= self.SYNC_MAX_STALL:
-            self._fastsync_abort("no serving peer")
-            return
+        else:
+            if retry > 0:
+                fs["retries"] += 1
+            if fs["retries"] >= self.STATESYNC_MAX_RETRIES:
+                # total-retry budget across the whole download, however
+                # many servers it rotated through: clean abort-to-replay
+                self._fastsync_abort("retry budget exhausted")
+                return
+            if retry >= self.SYNC_MAX_STALL:
+                self._fastsync_rotate_server(retry)
+                fs = self._fs
+                if fs is None:
+                    return
+                retry = 0
+        if fs["server"] is None:
+            fs["server"] = self._fastsync_pick_server(retry)
+            if fs["server"] is None:
+                self._fastsync_abort("no serving peer")
+                return
+        srv = fs["server"]
         req = M.StateFetchReq(block_num=fs["pivot"],
                               cursor=len(fs["accounts"]),
                               ip=self.cfg.consensus_ip,
                               port=self.cfg.consensus_port)
-        peer = self._pick_sync_peer(retry)
-        if peer is not None and retry % 3 != 2:
-            self.transport.send_direct(
-                peer.ip, peer.port,
-                M.pack_direct(M.UDP_GET_STATE, self.coinbase, req))
-        else:
-            self.transport.gossip(M.pack_gossip(M.GOSSIP_GET_STATE, req))
+        self.transport.send_direct(
+            srv.ip, srv.port,
+            M.pack_direct(M.UDP_GET_STATE, self.coinbase, req))
         if fs["pivot"]:
             # the pivot header (for the certified root) and the pivot
             # block (the new head) ride the existing sync lanes
@@ -1582,12 +1815,30 @@ class GeecNode:
                 else:
                     self.transport.gossip(
                         M.pack_gossip(M.GOSSIP_GET_BLOCKS, breq))
-        self._set_timer("fastsync", self.ccfg.validate_timeout_ms / 1e3,
+        # per-peer backoff: each fruitless retry against the pinned
+        # server stretches the re-ask interval (deterministic ladder)
+        delay = (self.ccfg.validate_timeout_ms / 1e3
+                 * min(retry + 1, 4))
+        self._set_timer("fastsync", delay,
                         lambda: self._fastsync_tick(retry + 1))
 
-    def _handle_state_chunk(self, reply: M.StateChunkReply) -> None:
+    def _handle_state_chunk(self, reply: M.StateChunkReply,
+                            author: bytes = b"") -> None:
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+
         fs = self._fs
         if fs is None:
+            return
+        srv = fs["server"]
+        if author and srv is not None and author != srv.addr:
+            # authenticated page from a peer this download is NOT
+            # anchored on: one interleaved poisoned page would fail the
+            # final root check and waste the whole download — reject it
+            # and bill the sender.  (Gossip replies carry no author and
+            # pass; the cursor/pivot checks below still gate them, and
+            # the root check backstops everything.)
+            metrics.counter("statesync.pages_rejected").inc()
+            ledger.charge(rejects=1)
             return
         if fs["pivot"] == 0:
             if reply.cursor != 0 or reply.block_num <= self.chain.height():
@@ -1598,26 +1849,52 @@ class GeecNode:
                 # server pruned our pivot and re-anchored: restart there
                 fs.update(pivot=reply.block_num, root=reply.root,
                           accounts=[], codes=[], total=None, block=None)
+                self._clear_sync_staging()
             else:
+                metrics.counter("statesync.pages_rejected").inc()
                 return
         if reply.cursor != len(fs["accounts"]):
-            return  # duplicate or out-of-order page; the tick re-asks
+            # duplicate or out-of-order page (benign under re-asks);
+            # the tick re-requests the cursor it actually needs
+            metrics.counter("statesync.pages_rejected").inc()
+            return
         if (len(fs["accounts"]) + len(reply.accounts)
                 > self.FASTSYNC_MAX_ACCOUNTS):
             # a malicious state server claiming an absurd account count
-            # cannot balloon the staging buffers: abort this sync; the
-            # next tick re-anchors against a different pivot/server
-            self._log("fastsync state too large, aborting",
+            # cannot balloon the staging buffers: quarantine it and
+            # re-anchor the download on another server (budgeted)
+            self._log("fastsync state too large",
                       staged=len(fs["accounts"]))
-            self._fs = None
+            self._fastsync_reanchor("state too large", blacklist=True)
+            if self._fs is not None:
+                self._fastsync_tick(retry=0)
             return
         fs["accounts"].extend(reply.accounts)
         fs["codes"].extend(reply.codes)
         fs["total"] = reply.total
         fs["progress"] = True
+        metrics.counter("statesync.pages_accepted").inc()
+        # fetching is ingress work too: bill the staged rows to the
+        # origin that delivered them (ambient bind at the perimeter)
+        ledger.charge(rows=len(reply.accounts), admits=1)
+        self._stage_sync_page(reply)
         self._fastsync_maybe_finish()
         if self._fs is not None:
             self._fastsync_tick(retry=0)  # next page immediately
+
+    def _stage_sync_page(self, reply: M.StateChunkReply) -> None:
+        """Persist one accepted page to the store's staging log (the
+        crash-resume source read back by ``_fastsync_load_staging``)."""
+        from eges_tpu.core import statesync as _ss
+
+        fs = self._fs
+        try:
+            self.chain.store.append_sync_page(_ss.encode_page(
+                fs["pivot"], fs["root"], reply.cursor, reply.total,
+                reply.accounts, reply.codes))
+        # analysis: allow-swallow(staging is an optimization; a page that failed to stage just re-downloads after a crash)
+        except Exception:
+            pass
 
     def _fastsync_take_blocks(self, blocks) -> None:
         """During a state download the block lanes only feed the pivot
@@ -1634,6 +1911,7 @@ class GeecNode:
 
     def _fastsync_maybe_finish(self) -> None:
         from eges_tpu.core import statesync as _ss
+        from eges_tpu.utils.metrics import DEFAULT as metrics
 
         fs = self._fs
         if (fs is None or fs["total"] is None
@@ -1646,18 +1924,43 @@ class GeecNode:
         if blk.hash != hdr.hash:
             fs["block"] = None  # block from a liar peer; re-fetch
             return
-        state = _ss.assemble(fs["accounts"], fs["codes"])
-        if state.root() != hdr.root:
+        state = None
+        try:
+            state = _ss.assemble(fs["accounts"], fs["codes"])
+        except Exception as exc:
+            # structurally-invalid pages (bad storage pairs, torn rows)
+            # are the same class of attack as a wrong balance: poison
+            self._log("fastsync assemble failed", err=repr(exc))
+        if state is None or state.root() != hdr.root:
             # pages were poisoned: certificates bound the header, the
-            # rebuilt tries disagree — nothing was adopted
-            self._fastsync_abort("state root mismatch vs certified header")
+            # rebuilt tries disagree — nothing was adopted.  Every page
+            # came from the pinned server, so the poisoning is
+            # attributable: quarantine it, bill the wasted rows to it,
+            # and re-anchor the download on an honest peer (budgeted;
+            # the re-anchor path aborts to full replay when exhausted)
+            srv = fs["server"]
+            label = srv.addr.hex()[:8] if srv is not None else "?"
+            self.journal.record("statesync_poisoned", blk=fs["pivot"],
+                                server=label, rows=len(fs["accounts"]))
+            metrics.counter("statesync.poisoned").inc()
+            self.ledger.charge(f"server:{label}",
+                               rejects=max(len(fs["accounts"]), 1))
+            self._fastsync_reanchor(
+                "state root mismatch vs certified header",
+                blacklist=True)
+            if self._fs is not None:
+                self._fastsync_tick(retry=0)
             return
         target = fs["target"]
         pivot = fs["pivot"]
+        rows = len(fs["accounts"])
         self.chain.adopt_snapshot(blk, state)
+        self._clear_sync_staging()
         self._fs = None
         self._fs_done = True
         self._cancel_timer("fastsync")
+        self.journal.record("statesync_adopted", blk=pivot,
+                            accounts=rows, target=target)
         self._log("FASTSYNC adopted", pivot=pivot,
                   root=hdr.root.hex()[:12], accounts=len(state),
                   target=target)
@@ -1673,7 +1976,17 @@ class GeecNode:
         slice, not a re-walk."""
         from eges_tpu.core import rlp as rlp_mod
         from eges_tpu.core import statesync as _ss
+        from eges_tpu.utils.metrics import DEFAULT as metrics
 
+        # serving is rate-limited per origin: snapshot pages are the
+        # most expensive reply this node produces, and an unthrottled
+        # serve loop would let one cheap StateFetchReq stream turn this
+        # node into a DoS amplifier against itself
+        origin = ledger.current_peer() or f"{req.ip}:{req.port}"
+        if not self._serve_tokens_take(origin):
+            metrics.counter("statesync.serve_throttled").inc()
+            ledger.charge(drops=1)
+            return
         height = self.chain.height()
         n, cursor = req.block_num, req.cursor
         blk = state = None
@@ -1718,6 +2031,26 @@ class GeecNode:
         else:
             self.transport.gossip(M.pack_gossip(M.GOSSIP_STATE_REPLY,
                                                 reply))
+        # serving is billable work driven by the requester
+        metrics.counter("statesync.pages_served").inc()
+        ledger.charge(rows=len(page), admits=1)
+
+    def _serve_tokens_take(self, origin: str) -> bool:
+        """Per-origin token bucket for the snapshot-serving plane, on
+        the node clock (virtual in sims, so deterministic).  The bucket
+        dict is bounded-by: SERVE_TOKENS_MAX (oldest origin evicted)."""
+        now = self.clock.now()
+        tokens, last = self._serve_tokens.get(
+            origin, (float(self.SERVE_BURST), now))
+        tokens = min(float(self.SERVE_BURST),
+                     tokens + (now - last) * self.SERVE_RATE_PAGES_S)
+        ok = tokens >= 1.0
+        if ok:
+            tokens -= 1.0
+        self._serve_tokens[origin] = (tokens, now)
+        while len(self._serve_tokens) > self.SERVE_TOKENS_MAX:
+            self._serve_tokens.pop(next(iter(self._serve_tokens)))
+        return ok
 
     def _handle_headers_reply(self, reply: M.HeadersReply) -> None:
         """Pin the verified skeleton: batch-verify every certificate in
@@ -1912,6 +2245,12 @@ class GeecNode:
         for n in list(self.pending_blocks):
             if n <= blk.number:
                 del self.pending_blocks[n]
+        if (not replay and self.cfg.checkpoint_every
+                and blk.number % self.cfg.checkpoint_every == 0):
+            # durable checkpoint cadence: every Nth committed block
+            # snapshots state + consensus soft state to the store's
+            # sidecar, so the NEXT restart replays only the tail
+            self._write_checkpoint(blk)
         if blk.number >= self.wb.blk_num:
             if not replay:
                 self._abort_proposal()
@@ -1919,6 +2258,35 @@ class GeecNode:
             if not replay:
                 self._drain_deferred()
                 self._try_propose()
+
+    def _write_checkpoint(self, blk: Block) -> None:
+        from eges_tpu.core import statesync as _ss
+        from eges_tpu.utils.metrics import DEFAULT as metrics
+
+        state = self.chain.state_at(blk.hash)
+        if state is None:
+            return  # state already pruned past the window; next cadence
+        cons = {
+            "members": [(m.addr, m.referee, m.ip, m.port, m.joined_block,
+                         m.ttl, m.renewed_times)
+                        for m in self.membership.members()],
+            "trust_rands": sorted(self.trust_rands.items()),
+            "empty_blocks": list(self.empty_block_list),
+            "unconfirmed": [b.number for b in self.unconfirmed],
+            "registered": self.registered,
+        }
+        try:
+            payload = _ss.encode_checkpoint(blk.hash, state, cons)
+            self.chain.store.put_snapshot(payload)
+        except Exception as exc:
+            # a failed checkpoint write must never stall consensus: the
+            # previous sidecar (or full replay) still restarts this node
+            self._log("checkpoint write failed", err=repr(exc))  # analysis: allow-swallow(checkpointing is a durability optimization; boot falls back to replay)
+            return
+        self.journal.record("statesync_checkpoint", blk=blk.number,
+                            nbytes=len(payload))
+        metrics.counter("statesync.checkpoints").inc()
+        metrics.gauge("statesync.checkpoint_bytes").set(len(payload))
 
     def _handle_confirmed_tail(self, confirmed_blk: Block) -> None:
         """Apply effects of all now-confirmed blocks (ref:

@@ -88,6 +88,23 @@ class MemoryStore:
     def tx_loc(self, txn_hash: bytes) -> int | None:
         return self._tx_loc.get(txn_hash)
 
+    # -- fast-sync page staging (mid-sync crash resume) ----------------
+    # One append-only slot of raw page blobs written as the live sync
+    # accepts pages, cleared on adoption/abort.  A node that crashes
+    # mid-download restarts, finds consistent staged pages, and resumes
+    # the download from the staged cursor instead of from zero.
+
+    def append_sync_page(self, blob: bytes) -> None:
+        if not hasattr(self, "_sync_pages"):
+            self._sync_pages: list[bytes] = []
+        self._sync_pages.append(blob)
+
+    def load_sync_pages(self) -> list[bytes]:
+        return list(getattr(self, "_sync_pages", ()))
+
+    def clear_sync_staging(self) -> None:
+        self._sync_pages = []
+
     def close(self) -> None:
         pass
 
@@ -220,6 +237,39 @@ class FileStore(MemoryStore):
             with open(path, "r+b") as f:
                 f.truncate(good_end)
 
+    def append_sync_page(self, blob: bytes) -> None:
+        """Durable sync-page staging: same [u32 len][blob] framing as
+        blocks.log, torn-tail tolerant on load.  Non-fsynced — staging
+        is an optimization; a lost tail just re-downloads those pages."""
+        super().append_sync_page(blob)
+        with open(os.path.join(self._dir, "sync_pages.log"), "ab") as f:
+            f.write(struct.pack("<I", len(blob)) + blob)
+            f.flush()
+
+    def load_sync_pages(self) -> list[bytes]:
+        path = os.path.join(self._dir, "sync_pages.log")
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return []
+        out: list[bytes] = []
+        pos = 0
+        while pos + 4 <= len(data):
+            (n,) = struct.unpack("<I", data[pos : pos + 4])
+            if pos + 4 + n > len(data):
+                break  # torn tail
+            out.append(data[pos + 4 : pos + 4 + n])
+            pos += 4 + n
+        return out
+
+    def clear_sync_staging(self) -> None:
+        super().clear_sync_staging()
+        try:
+            os.remove(os.path.join(self._dir, "sync_pages.log"))
+        except OSError:
+            pass
+
     def close(self) -> None:
         self._log.close()
         self._rlog.close()
@@ -319,18 +369,26 @@ class BlockChain:
         # against the pivot block it claims to be; see adopt_snapshot).
         start = 1
         snap_err = None
+        # O(tail) restart surface read by the owning GeecNode: the
+        # root-verified anchor height (0 = full replay) and the
+        # checkpoint's consensus soft-state section, if any
+        self.snapshot_anchor = 0
+        self.snapshot_consensus: dict | None = None
         snap_raw = self.store.get_snapshot()
         if snap_raw is not None:
             from eges_tpu.core import statesync as _ss
 
             try:
-                sh, sstate = _ss.decode_snapshot(snap_raw)
+                sh, sstate, scons = _ss.decode_checkpoint(snap_raw)
                 sblk = self.store.get_block(sh)
                 if (sblk is not None and 0 < sblk.number <= self._head.number
                         and sstate.root() == sblk.header.root):
                     self._remember_state(sblk.hash, sblk.number, sstate, ())
                     self.bloom_index.add(sblk.number, sblk.header.bloom)
                     start = sblk.number + 1
+                    self.snapshot_anchor = sblk.number
+                    # consensus section only trusted on the verified path
+                    self.snapshot_consensus = scons
                 else:
                     snap_err = "snapshot does not match its pivot block"
             except Exception as exc:  # corrupt sidecar

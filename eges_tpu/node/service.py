@@ -44,6 +44,13 @@ class ServiceConfig:
     rpc_port: int = 0                  # 0 = RPC disabled
     net_secret_hex: str = ""           # gossip-plane auth secret; ""
     #                                    derives one from the genesis hash
+    checkpoint_every: int = 256        # durable state-checkpoint cadence
+    #                                    (blocks): every Nth commit writes
+    #                                    a snapshot sidecar into the
+    #                                    datadir so a restart replays only
+    #                                    the tail past it; 0 disables.
+    #                                    An explicit NodeConfig value
+    #                                    overrides this service default.
     plaintext_gossip: bool = False     # disable the auth layer entirely
     allow_v1_peers: bool = False       # accept legacy v1 (symmetric)
     #                                    hellos on keyed nodes — mixed-
@@ -259,6 +266,11 @@ class NodeService:
         ncfg = dataclasses.replace(cfg.node or NodeConfig(),
                                    coinbase=self.coinbase,
                                    privkey=priv)
+        if ncfg.checkpoint_every == 0 and cfg.checkpoint_every:
+            # service-level durability default: periodic checkpoints
+            # into the datadir unless the node config pinned a cadence
+            ncfg = dataclasses.replace(
+                ncfg, checkpoint_every=cfg.checkpoint_every)
 
         self.clock = AsyncioClock(asyncio.get_event_loop())
         self.node = GeecNode(self.chain, self.clock, None, ncfg, chain_cfg,

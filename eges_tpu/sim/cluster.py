@@ -41,7 +41,7 @@ class SimCluster:
                  alloc: dict | None = None, txpool: bool = False,
                  fast_sync: set | None = None, defer: set | None = None,
                  mesh_devices: int | None = None, sched_config=None,
-                 columnar: bool = True):
+                 columnar: bool = True, checkpoint_every: int = 0):
         self.clock = SimClock()
         self.net = SimNet(self.clock, seed=seed, drop_rate=drop_rate)
         self.nodes: list[SimNode] = []
@@ -88,6 +88,7 @@ class SimCluster:
 
         self._deferred: set[int] = set(defer or ())
         self._ccfg = ccfg
+        self._genesis = genesis
         self._mine = mine
         self._txpool = txpool
         self._columnar = columnar
@@ -131,7 +132,8 @@ class SimCluster:
                 txn_size=txn_size, block_timeout_s=block_timeout_s,
                 total_nodes=n_nodes, failure_test=failure_test,
                 privkey=privs[i] if signed else b"",
-                fast_sync=bool(fast_sync and i in fast_sync))
+                fast_sync=bool(fast_sync and i in fast_sync),
+                checkpoint_every=checkpoint_every)
             node_clock = SkewedClock(self.clock)
             chain = BlockChain(genesis=genesis, verifier=verifier,
                                alloc=alloc)
@@ -204,6 +206,12 @@ class SimCluster:
         sn = self.nodes[i]
         assert sn.crashed, f"{sn.name} is not crashed"
         ncfg = sn.node.cfg
+        # the surviving store IS the datadir: rebuild the chain FROM it,
+        # exactly as a real process boot does, so a durable checkpoint
+        # sidecar anchors the state replay (O(tail) rejoin) instead of
+        # inheriting the dead node's in-memory snapshots
+        sn.chain = BlockChain(store=sn.chain.store, genesis=self._genesis,
+                              verifier=self.verifier, alloc=self._alloc)
         node = GeecNode(sn.chain, sn.clock, None, ncfg, self._ccfg,
                         mine=(self._mine[i] if self._mine is not None
                               else True),

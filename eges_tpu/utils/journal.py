@@ -110,6 +110,16 @@ EVENT_TYPES = frozenset({
     # into determinism-checked streams; chaos scenarios never enable
     # the plane
     "profiler_report",
+    # snapshot state sync (consensus/node.py + core/statesync.py):
+    # durable checkpoint written at the cadence boundary; O(tail)
+    # restart anchored on a root-verified checkpoint; mid-sync crash
+    # resume from staged pages; poisoned-page detection (final-root
+    # mismatch → serving peer blacklisted); download re-anchored on a
+    # fresh pivot/server; quiet-server rotation; bounded abort back to
+    # full replay; successful snapshot adoption
+    "statesync_checkpoint", "statesync_restart", "statesync_resume",
+    "statesync_poisoned", "statesync_reanchor", "statesync_server_rotate",
+    "statesync_abort", "statesync_adopted",
     # device-efficiency observatory (eges_tpu/utils/devstats.py): one
     # per-device delta of deterministic window/row/waste counts per
     # devstats tick — goodput numerators/denominators plus the

@@ -91,6 +91,15 @@ METRIC_FAMILIES = frozenset({
     # cancelled before execution, losers that ran to waste
     "verifier.hedge_cancelled", "verifier.hedge_wasted",
     "verifier.hedge_wins", "verifier.hedges",
+    # consensus/node.py — snapshot state sync: durable checkpoints,
+    # O(tail) restarts, byzantine-tolerant live sync, and the billed,
+    # bounded snapshot-serving plane
+    "statesync.aborts", "statesync.checkpoint_bytes",
+    "statesync.checkpoints", "statesync.oversized_reply",
+    "statesync.pages_accepted", "statesync.pages_rejected",
+    "statesync.pages_served", "statesync.poisoned",
+    "statesync.reanchors", "statesync.restart_replayed",
+    "statesync.resumes", "statesync.serve_throttled",
     # utils/timeseries.py + harness/collector.py — telemetry plane
     "telemetry.envelopes", "telemetry.samples",
     # harness/slo.py — burn-rate SLO engine
@@ -209,6 +218,21 @@ METRIC_HELP = {
         "Hedged duplicates that ran after the winner (wasted work).",
     "verifier.hedge_wins": "Straggling windows won by the hedge copy.",
     "verifier.hedges": "Speculative duplicate dispatches placed.",
+    "statesync.aborts": "Fast syncs aborted back to full block replay.",
+    "statesync.checkpoint_bytes": "Size of the newest durable checkpoint.",
+    "statesync.checkpoints": "Durable state checkpoints written.",
+    "statesync.oversized_reply": "State replies dropped by the pre-decode "
+                                 "byte cap.",
+    "statesync.pages_accepted": "State pages staged from serving peers.",
+    "statesync.pages_rejected": "State pages rejected (unsolicited, "
+                                "out-of-order, or unattributable).",
+    "statesync.pages_served": "State pages served to fetching peers.",
+    "statesync.poisoned": "Downloads rejected by the pivot root check.",
+    "statesync.reanchors": "Downloads re-anchored on a fresh pivot/server.",
+    "statesync.restart_replayed": "Tail blocks replayed on the last restart.",
+    "statesync.resumes": "Syncs resumed from crash-staged pages.",
+    "statesync.serve_throttled": "State fetches dropped by the per-peer "
+                                 "serve rate limit.",
     "telemetry.envelopes": "Telemetry envelopes ingested by the collector.",
     "telemetry.samples": "Registry samples taken by the telemetry sampler.",
     "slo.alerts_firing": "SLO objectives currently in the firing state.",

@@ -79,6 +79,8 @@ LOWER_IS_BETTER = frozenset({"cold_start_seconds", "commit_p99_ms",
                              "device_mem_peak_bytes",
                              "host_cpu_share_of_verify_pct",
                              "ledger_overhead_pct",
+                             "rejoin_replayed_blocks",
+                             "rejoin_seconds",
                              "sched_p99_window_ms",
                              "sched_queue_wait_p99_ms_bulk",
                              "sched_queue_wait_p99_ms_consensus"})

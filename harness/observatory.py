@@ -57,7 +57,11 @@ CONSUMED = ("election_started", "election_won", "election_lost",
             "fault_trigger", "fault_breaker", "verifier_mesh_dispatch",
             "verifier_aot_load", "telemetry_sample",
             "slo_pending", "slo_firing", "slo_resolved",
-            "profiler_report", "device_efficiency")
+            "profiler_report", "device_efficiency",
+            "statesync_checkpoint", "statesync_restart",
+            "statesync_resume", "statesync_poisoned",
+            "statesync_reanchor", "statesync_server_rotate",
+            "statesync_abort", "statesync_adopted")
 
 _SLO = ("slo_pending", "slo_firing", "slo_resolved")
 
@@ -136,6 +140,10 @@ def summarize(by_node: dict[str, list[dict]],
     # device-efficiency report counts per stream; the goodput/roofline
     # fold itself comes from devstats.assemble below
     devstats_reports: dict[str, int] = {}
+    # state-sync lifecycle (durable checkpoints, O(tail) restarts,
+    # byzantine-tolerant live sync): per-node counters, plus the tail
+    # bound of that node's newest restart
+    statesync: dict[str, dict] = {}
     # forward compatibility: journals written by a NEWER build may carry
     # event types this parser has never heard of — count and skip them
     # instead of letting a per-type branch trip over missing attrs
@@ -192,6 +200,32 @@ def summarize(by_node: dict[str, list[dict]],
                 d["rows"] += int(ev.get("rows", 0))
                 d["diverted"] += 1 if ev.get("diverted") else 0
                 d["_occ"] += float(ev.get("occupancy", 0.0))
+                continue
+            if typ.startswith("statesync_"):
+                d = statesync.setdefault(name, {
+                    "checkpoints": 0, "checkpoint_bytes": 0,
+                    "restarts": 0, "replayed": 0, "snapshot_blk": 0,
+                    "resumes": 0, "poisoned": 0, "reanchors": 0,
+                    "rotates": 0, "aborts": 0, "adopted": 0})
+                if typ == "statesync_checkpoint":
+                    d["checkpoints"] += 1
+                    d["checkpoint_bytes"] = int(ev.get("nbytes", 0))
+                elif typ == "statesync_restart":
+                    d["restarts"] += 1
+                    d["replayed"] = int(ev.get("replayed", 0))
+                    d["snapshot_blk"] = int(ev.get("snapshot_blk", 0))
+                elif typ == "statesync_resume":
+                    d["resumes"] += 1
+                elif typ == "statesync_poisoned":
+                    d["poisoned"] += 1
+                elif typ == "statesync_reanchor":
+                    d["reanchors"] += 1
+                elif typ == "statesync_server_rotate":
+                    d["rotates"] += 1
+                elif typ == "statesync_abort":
+                    d["aborts"] += 1
+                elif typ == "statesync_adopted":
+                    d["adopted"] += 1
                 continue
             if typ in _FAULTS:
                 faults.append((round(float(ev["ts"]), 6),
@@ -303,6 +337,8 @@ def summarize(by_node: dict[str, list[dict]],
         "devstats_reports": {
             name: devstats_reports[name]
             for name in sorted(devstats_reports)},
+        "statesync": {
+            name: dict(statesync[name]) for name in sorted(statesync)},
         "unknown_events": {
             typ: unknown_events[typ] for typ in sorted(unknown_events)},
         "anatomy": anatomy_mod.assemble(by_node),
@@ -798,6 +834,21 @@ def render(summary: dict, net: dict | None = None) -> str:
                 "      %12.6f  %s %s  burn fast %.2f / slow %.2f" % (
                     r["ts"], r["type"].removeprefix("slo_"),
                     r["objective"], r["burn_fast"], r["burn_slow"]))
+    if summary.get("statesync"):
+        out.append("  state sync (per node):")
+        for name, d in summary["statesync"].items():
+            out.append(
+                "    %-8s checkpoints %d (last %d B)  restarts %d "
+                "(anchor blk %d, replayed %d)" % (
+                    name, d["checkpoints"], d["checkpoint_bytes"],
+                    d["restarts"], d["snapshot_blk"], d["replayed"]))
+            if (d["adopted"] or d["resumes"] or d["poisoned"]
+                    or d["reanchors"] or d["rotates"] or d["aborts"]):
+                out.append(
+                    "    %-8s live sync: adopted %d  resumes %d  "
+                    "poisoned %d  reanchors %d  rotates %d  aborts %d"
+                    % ("", d["adopted"], d["resumes"], d["poisoned"],
+                       d["reanchors"], d["rotates"], d["aborts"]))
     if summary.get("unknown_events"):
         out.append("  unknown event types (skipped): " + "  ".join(
             "%s %d" % (typ, n)
